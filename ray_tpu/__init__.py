@@ -1,0 +1,156 @@
+"""ray_tpu — a TPU-native distributed compute & ML framework.
+
+Public core API, counterpart of the reference's `ray` package surface
+(`python/ray/_private/worker.py`: init :1106, get :2408, put :2517,
+wait :2580, remote :3022, get_actor :2711, kill :2746, cancel :2777).
+
+Import stays light: JAX and the ML libraries (`ray_tpu.train`, `.tune`,
+`.data`, `.parallel`, `.models`) load lazily so spawning a worker process
+costs milliseconds, not a JAX import.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from ray_tpu._private import constants, ids
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.worker import ObjectRef, get, put, wait
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "get_actor", "kill", "cancel", "method", "ObjectRef", "ActorHandle",
+    "available_resources", "cluster_resources", "get_runtime_context",
+    "exceptions", "__version__",
+]
+
+
+def _detect_tpu_chips() -> int:
+    """Count local TPU chips without importing JAX (the reference detects
+    GPUs via NVML-free heuristics similarly, _private/resource_spec.py)."""
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env is not None:
+        return int(env)
+    chips = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+    chips = [c for c in chips if not c.endswith("vfio")]
+    return len(chips)
+
+
+def init(num_cpus: int | None = None,
+         num_tpus: int | None = None,
+         resources: dict | None = None,
+         *,
+         ignore_reinit_error: bool = False,
+         namespace: str | None = None,
+         logging_level: str = "INFO",
+         **kwargs):
+    """Start a local ray_tpu session (driver mode).
+
+    Single-host today; the NodeServer keeps every interface process-shaped so
+    the same API fronts a multi-host deployment later (see node.py docstring).
+    """
+    if _worker.is_initialized():
+        if ignore_reinit_error:
+            return _worker.get_client()
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(pass ignore_reinit_error=True to allow)")
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+    if num_tpus is None:
+        num_tpus = _detect_tpu_chips()
+    total = {"CPU": float(num_cpus)}
+    if num_tpus:
+        total["TPU"] = float(num_tpus)
+    for k, v in (resources or {}).items():
+        total[k] = float(v)
+
+    from ray_tpu._private.node import NodeServer
+    _gc_stale_sessions()
+    session_dir = os.path.join(
+        constants.SHM_ROOT,
+        constants.SESSION_PREFIX + ids.new_node_id())
+    os.makedirs(session_dir, exist_ok=True)
+    node = NodeServer(total, session_dir, num_tpu_chips=int(num_tpus or 0))
+    return _worker.connect_driver_mode(node)
+
+
+def _gc_stale_sessions():
+    """Remove session dirs whose driver process is gone (crash leftovers)."""
+    import shutil
+    for d in glob.glob(os.path.join(constants.SHM_ROOT,
+                                    constants.SESSION_PREFIX + "*")):
+        pidfile = os.path.join(d, "driver.pid")
+        try:
+            with open(pidfile) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, 0)       # raises if the driver is dead
+        except (FileNotFoundError, ValueError, ProcessLookupError):
+            shutil.rmtree(d, ignore_errors=True)
+        except PermissionError:
+            pass                  # someone else's live session
+
+
+def shutdown():
+    if not _worker.is_initialized():
+        return
+    client = _worker.get_client()
+    if client.mode == "driver":
+        client.node.shutdown()
+    _worker.disconnect()
+
+
+def is_initialized() -> bool:
+    return _worker.is_initialized()
+
+
+def remote(*args, **kwargs):
+    """`@remote` decorator for functions and classes (reference:
+    worker.py:3022). Usable bare or with options:
+
+        @ray_tpu.remote
+        def f(x): ...
+
+        @ray_tpu.remote(num_cpus=2, num_tpus=1)
+        class Learner: ...
+    """
+    import inspect
+
+    def make(target, options):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        if not callable(target):
+            raise TypeError("@remote target must be a function or class")
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote() takes only keyword options")
+    return lambda target: make(target, kwargs)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort cancel of a pending task (reference: worker.py:2777).
+    Running tasks are not interrupted in v1."""
+    return _worker.get_client().control(
+        "cancel", {"object_id": ref._id, "force": force})
+
+
+def cluster_resources() -> dict:
+    return _worker.get_client().control("cluster_resources")
+
+
+def available_resources() -> dict:
+    return _worker.get_client().control("available_resources")
+
+
+def nodes() -> list:
+    res = cluster_resources()
+    return [{"NodeID": "local", "Alive": True, "Resources": res}]

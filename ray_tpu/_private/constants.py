@@ -1,0 +1,62 @@
+"""Session-wide constants for the ray_tpu core runtime.
+
+Counterpart of the reference's `python/ray/_private/ray_constants.py` plus the
+native config table (`src/ray/common/ray_config_def.h`): every tunable is
+env-overridable with the ``RAY_TPU_`` prefix, mirroring the reference's
+``RAY_<name>`` convention (ray_config.h:74).
+"""
+
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get("RAY_TPU_" + name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get("RAY_TPU_" + name, default))
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get("RAY_TPU_" + name, default)
+
+
+# Objects whose serialized envelope is at most this many bytes travel inline in
+# control messages; larger ones go to the shared-memory store (the reference
+# inlines <=100KB returns in the gRPC reply, core_worker.cc).
+INLINE_OBJECT_MAX_BYTES = _env_int("INLINE_OBJECT_MAX_BYTES", 100 * 1024)
+
+# Where shared-memory object files live (tmpfs). The reference mounts plasma
+# over /dev/shm (plasma/store.h); we use one file per object under a session
+# directory, which keeps ownership trivially correct (driver unlinks on exit).
+SHM_ROOT = _env_str("SHM_ROOT", "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
+
+SESSION_PREFIX = "ray_tpu_session_"
+
+# Worker pool sizing: hard cap on generic (non-actor) worker processes.
+MAX_WORKERS_CAP = _env_int("MAX_WORKERS_CAP", 32)
+
+# Seconds to wait for a spawned worker process to phone home before declaring
+# startup failure (reference: worker_register_timeout_seconds).
+WORKER_REGISTER_TIMEOUT_S = _env_float("WORKER_REGISTER_TIMEOUT_S", 60.0)
+
+# Default resource requests (reference: task default num_cpus=1; actors hold 0
+# lifetime CPUs unless explicitly requested — ray_option_utils.py).
+DEFAULT_TASK_NUM_CPUS = 1.0
+DEFAULT_ACTOR_LIFETIME_CPUS = 0.0
+
+# Buffer alignment inside serialized envelopes so zero-copy numpy views are
+# 64-byte aligned (plasma aligns to 64 as well).
+BUFFER_ALIGNMENT = 64
+
+# Polling granularity for blocking waits.
+WAIT_POLL_S = 0.01
+
+# How many task submissions a single client may have in flight before
+# submit blocks (simple backpressure; reference has per-lease backlogs).
+MAX_INFLIGHT_SUBMISSIONS = _env_int("MAX_INFLIGHT_SUBMISSIONS", 100000)
+
+# Env var handed to workers that were allocated TPU chips, mirroring how the
+# reference sets CUDA_VISIBLE_DEVICES from the resource assignment
+# (_private/utils.py:342-355).
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"

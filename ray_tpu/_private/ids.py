@@ -1,0 +1,41 @@
+"""Unique identifiers for objects/tasks/actors/jobs.
+
+Counterpart of the reference's `src/ray/common/id.h` (JobID/TaskID/ActorID/
+ObjectID). We use 16 random bytes rendered as hex; IDs are plain strings so
+they pickle cheaply and hash fast in Python dicts.
+"""
+
+import os
+import binascii
+
+
+def _rand_hex(nbytes: int = 16) -> str:
+    return binascii.hexlify(os.urandom(nbytes)).decode()
+
+
+def new_object_id() -> str:
+    return "obj_" + _rand_hex()
+
+
+def new_task_id() -> str:
+    return "task_" + _rand_hex(8)
+
+
+def new_actor_id() -> str:
+    return "actor_" + _rand_hex(8)
+
+
+def new_worker_id() -> str:
+    return "worker_" + _rand_hex(6)
+
+
+def new_placement_group_id() -> str:
+    return "pg_" + _rand_hex(6)
+
+
+def new_job_id() -> str:
+    return "job_" + _rand_hex(4)
+
+
+def new_node_id() -> str:
+    return "node_" + _rand_hex(6)

@@ -1,0 +1,1033 @@
+"""Driver-side node server: scheduler, object directory, worker pool.
+
+This process plays the role of three reference components at once, collapsed
+because a TPU host is one failure/scheduling domain:
+
+- the raylet's NodeManager + ClusterTaskManager (worker leasing, dependency
+  management, dispatch — src/ray/raylet/node_manager.h:117,
+  scheduling/cluster_task_manager.h),
+- the GCS tables it needs locally (named actors, KV, job info —
+  src/ray/gcs/gcs_server/gcs_server.h:78), and
+- the ownership-based object directory (which object lives where —
+  src/ray/core_worker/reference_count.h:61 + ownership_based_object_directory.h).
+
+Worker processes connect over a UNIX socket; the message set is
+`protocol.py`. The design keeps every interface process-shaped (submit /
+register_object / lease) so a multi-host deployment can split this class back
+into per-host daemons + a cluster store without changing callers — that split
+is the round-2+ path to the reference's 2000-node envelope (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import connection
+
+from ray_tpu._private import constants, ids, protocol
+from ray_tpu._private.object_store import Descriptor, ObjectStore
+from ray_tpu._private.serialization import dumps
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    PlacementGroupError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger("ray_tpu")
+
+_EPS = 1e-9
+
+
+def _fits(avail: dict, req: dict) -> bool:
+    return all(avail.get(k, 0.0) + _EPS >= v for k, v in req.items())
+
+
+def _sub(avail: dict, req: dict) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _add(avail: dict, req: dict) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass
+class _TaskState:
+    spec: protocol.TaskSpec
+    deps: set = field(default_factory=set)   # unresolved object ids
+    submitter: object = None                 # _WorkerConn for nested submits
+    retries_left: int = 0
+    retry_exceptions: bool = False
+    cancelled: bool = False
+
+
+@dataclass
+class _WorkerConn:
+    worker_id: str
+    conn: connection.Connection
+    proc: object = None                      # mp.Process | subprocess.Popen
+    kind: str = "generic"                    # "generic" | "actor"
+    idle: bool = True
+    current: _TaskState | None = None
+    known_functions: set = field(default_factory=set)
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    # resources temporarily released while the worker blocks in get()
+    released: dict = field(default_factory=dict)
+    alive: bool = True
+
+    def send(self, msg) -> bool:
+        with self.send_lock:
+            if self.conn is None:     # spawned but not yet registered
+                return False
+            try:
+                self.conn.send(msg)
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+
+
+@dataclass
+class _ActorState:
+    actor_id: str
+    creation_spec: protocol.TaskSpec
+    worker: _WorkerConn | None = None
+    ready: bool = False
+    dead: bool = False
+    death_cause: str = ""
+    queue: list = field(default_factory=list)    # pending _TaskState, FIFO
+    inflight: list = field(default_factory=list)
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    restarts_used: int = 0
+    max_task_retries: int = 0
+    name: str | None = None
+    resources: dict = field(default_factory=dict)
+    tpu_chips: list = field(default_factory=list)
+    method_meta: dict = field(default_factory=dict)  # for get_actor handles
+    pending_restart: bool = False
+
+
+@dataclass
+class _PlacementGroup:
+    pg_id: str
+    bundles: list            # list[dict]
+    strategy: str
+    available: list = None   # per-bundle remaining resources
+
+    def __post_init__(self):
+        if self.available is None:
+            self.available = [dict(b) for b in self.bundles]
+
+
+class NodeServer:
+    """One per session; lives in the driver process."""
+
+    def __init__(self, resources: dict, session_dir: str, num_tpu_chips: int):
+        self.session_dir = session_dir
+        self.node_id = ids.new_node_id()
+        self.store = ObjectStore(session_dir)
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.free_tpu_chips = list(range(num_tpu_chips))
+
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)   # object-ready notification
+
+        self.directory: dict[str, Descriptor] = {}
+        self.obj_waiting_tasks: dict[str, list[_TaskState]] = {}
+
+        self.pending: list[_TaskState] = []
+        self.workers: dict[str, _WorkerConn] = {}
+        self.actors: dict[str, _ActorState] = {}
+        self.named_actors: dict[str, str] = {}
+        self.placement_groups: dict[str, _PlacementGroup] = {}
+        self.kv: dict[tuple, bytes] = {}
+
+        self._task_errors: dict[str, str] = {}
+        self._shutdown = False
+        self._spawning = 0      # generic workers currently starting up
+        self._spawn_failures = 0  # consecutive startup failures
+
+        # Pidfile lets a later init() garbage-collect sessions whose driver
+        # crashed without shutdown (the reference GCs stale session dirs in
+        # _private/node.py similarly).
+        with open(os.path.join(session_dir, "driver.pid"), "w") as f:
+            f.write(str(os.getpid()))
+
+        self._authkey = os.urandom(16)
+        self._address = os.path.join(session_dir, "node.sock")
+        self._listener = connection.Listener(
+            family="AF_UNIX", address=self._address, authkey=self._authkey)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ray_tpu-accept", daemon=True)
+        self._accept_thread.start()
+        atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    # connection plumbing
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                # One bad handshake (EOF mid-connect, wrong authkey ->
+                # AuthenticationError) must not kill the accept loop; only
+                # shutdown ends it.
+                if self._shutdown:
+                    return
+                time.sleep(0.05)
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            reg = conn.recv()
+        except (EOFError, OSError):
+            return
+        if not isinstance(reg, protocol.RegisterWorker):
+            conn.close()
+            return
+        with self.lock:
+            w = self.workers.get(reg.worker_id)
+            if w is None:
+                # Late registration of a worker we spawned.
+                w = _WorkerConn(reg.worker_id, conn)
+                self.workers[reg.worker_id] = w
+            else:
+                w.conn = conn
+            w.alive = True
+            self.cv.notify_all()
+        self._reader_loop(w)
+
+    def _reader_loop(self, w: _WorkerConn):
+        while True:
+            try:
+                msg = w.conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_death(w)
+                return
+            try:
+                self._handle(w, msg)
+            except Exception:
+                logger.exception("error handling %r from %s", type(msg),
+                                 w.worker_id)
+
+    def _handle(self, w: _WorkerConn, msg):
+        if isinstance(msg, protocol.TaskDone):
+            self._on_task_done(w, msg)
+        elif isinstance(msg, protocol.PutRequest):
+            self.register_object(msg.object_id, msg.desc)
+        elif isinstance(msg, protocol.GetRequest):
+            threading.Thread(
+                target=self._serve_get, args=(w, msg), daemon=True).start()
+        elif isinstance(msg, protocol.WaitRequest):
+            threading.Thread(
+                target=self._serve_wait, args=(w, msg), daemon=True).start()
+        elif isinstance(msg, protocol.SubmitRequest):
+            try:
+                self.submit(msg.spec, submitter=w)
+                w.send(protocol.SubmitReply(msg.req_id, ok=True))
+            except Exception as e:
+                w.send(protocol.SubmitReply(msg.req_id, ok=False,
+                                            error=repr(e)))
+        elif isinstance(msg, protocol.ActorCallRequest):
+            try:
+                result = self._control(msg.method, msg.payload, w)
+                w.send(protocol.ActorCallReply(msg.req_id, result=result))
+            except Exception as e:
+                w.send(protocol.ActorCallReply(msg.req_id, error=repr(e)))
+        else:
+            logger.warning("unknown message %r", type(msg))
+
+    # ------------------------------------------------------------------
+    # control-plane RPCs (named actors, KV, kill, ...)
+    # ------------------------------------------------------------------
+
+    def _control(self, method: str, payload, w):
+        if method == "get_actor":
+            return self.get_named_actor(payload)
+        if method == "kill_actor":
+            return self.kill_actor(payload["actor_id"],
+                                   no_restart=payload.get("no_restart", True))
+        if method == "kv_put":
+            ns, key, val = payload
+            with self.lock:
+                self.kv[(ns, key)] = val
+            return True
+        if method == "kv_get":
+            ns, key = payload
+            with self.lock:
+                return self.kv.get((ns, key))
+        if method == "kv_del":
+            ns, key = payload
+            with self.lock:
+                return self.kv.pop((ns, key), None) is not None
+        if method == "kv_list":
+            ns, prefix = payload
+            with self.lock:
+                return [k for (n, k) in self.kv if n == ns
+                        and k.startswith(prefix)]
+        if method == "cluster_resources":
+            with self.lock:
+                return dict(self.total_resources)
+        if method == "available_resources":
+            with self.lock:
+                return dict(self.available)
+        if method == "create_pg":
+            return self.create_placement_group(**payload)
+        if method == "remove_pg":
+            return self.remove_placement_group(payload)
+        if method == "cancel":
+            return self.cancel(payload["object_id"], payload.get("force", False))
+        if method == "actor_state":
+            with self.lock:
+                a = self.actors.get(payload)
+                if a is None:
+                    return None
+                return {"ready": a.ready, "dead": a.dead,
+                        "cause": a.death_cause}
+        raise ValueError(f"unknown control method {method}")
+
+    # ------------------------------------------------------------------
+    # object directory
+    # ------------------------------------------------------------------
+
+    def register_object(self, object_id: str, desc: Descriptor):
+        with self.lock:
+            self.directory[object_id] = desc
+            waiting = self.obj_waiting_tasks.pop(object_id, ())
+            for t in waiting:
+                t.deps.discard(object_id)
+            self.cv.notify_all()
+        if waiting:
+            self._schedule()
+
+    def put_value(self, value) -> str:
+        oid = ids.new_object_id()
+        desc = self.store.put(oid, value)
+        self.register_object(oid, desc)
+        return oid
+
+    def get_locations(self, object_ids, timeout=None) -> dict:
+        """Block until every id has a descriptor; driver-side fast path."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while True:
+                missing = [o for o in object_ids if o not in self.directory]
+                if not missing:
+                    return {o: self.directory[o] for o in object_ids}
+                if deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for {missing[:3]}...")
+                    self.cv.wait(rem)
+                else:
+                    self.cv.wait(1.0)
+
+    def wait_objects(self, object_ids, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while True:
+                ready = [o for o in object_ids if o in self.directory]
+                if len(ready) >= num_returns:
+                    break
+                if deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self.cv.wait(min(rem, 1.0))
+                else:
+                    self.cv.wait(1.0)
+            ready_set = set(ready[:max(num_returns, 0)] if len(ready) >
+                            num_returns else ready)
+            ready_list = [o for o in object_ids if o in ready_set]
+            not_ready = [o for o in object_ids if o not in ready_set]
+            return ready_list, not_ready
+
+    def _serve_get(self, w: _WorkerConn, msg: protocol.GetRequest):
+        # Release the blocked worker's resources so nested tasks can run
+        # (the reference releases the worker's lease while it blocks in get).
+        with self.lock:
+            if w.current is not None and not w.released:
+                held = dict(w.current.spec.resources)
+                if held:
+                    _add(self.available, held)
+                    w.released = held
+        try:
+            locs = self.get_locations(msg.object_ids, msg.timeout)
+            reply = protocol.GetReply(msg.req_id, locs)
+        except GetTimeoutError:
+            reply = protocol.GetReply(msg.req_id, {}, timed_out=True)
+        with self.lock:
+            if w.released:
+                _sub(self.available, w.released)  # may dip below zero briefly
+                w.released = {}
+        w.send(reply)
+        self._schedule()
+
+    def _serve_wait(self, w: _WorkerConn, msg: protocol.WaitRequest):
+        ready, not_ready = self.wait_objects(
+            msg.object_ids, msg.num_returns, msg.timeout)
+        w.send(protocol.WaitReply(msg.req_id, ready, not_ready))
+
+    # ------------------------------------------------------------------
+    # task submission + scheduling
+    # ------------------------------------------------------------------
+
+    def submit(self, spec: protocol.TaskSpec, submitter=None):
+        t = _TaskState(spec=spec, submitter=submitter,
+                       retries_left=spec.max_retries,
+                       retry_exceptions=spec.retry_exceptions)
+        with self.lock:
+            for kind, v in list(spec.args) + list(spec.kwargs.values()):
+                if kind == "ref" and v not in self.directory:
+                    t.deps.add(v)
+                    self.obj_waiting_tasks.setdefault(v, []).append(t)
+            if spec.actor_creation:
+                _name = (spec.runtime_env or {}).get("_name")
+                if _name and _name in self.named_actors:
+                    raise ValueError(f"actor name {_name!r} already taken")
+                a = _ActorState(
+                    actor_id=spec.actor_id, creation_spec=spec,
+                    max_concurrency=(spec.runtime_env or {}).get(
+                        "_max_concurrency", 1),
+                    max_restarts=(spec.runtime_env or {}).get(
+                        "_max_restarts", 0),
+                    max_task_retries=(spec.runtime_env or {}).get(
+                        "_max_task_retries", 0),
+                    name=(spec.runtime_env or {}).get("_name"),
+                    resources=dict(spec.resources),
+                    method_meta=(spec.runtime_env or {}).get(
+                        "_method_meta", {}),
+                )
+                self.actors[spec.actor_id] = a
+                if a.name:
+                    self.named_actors[a.name] = spec.actor_id
+                self.pending.append(t)
+            elif spec.actor_id is not None:
+                a = self.actors.get(spec.actor_id)
+                if a is None or a.dead:
+                    cause = a.death_cause if a else "unknown actor"
+                    self._store_error(
+                        spec.return_ids,
+                        ActorDiedError(f"actor {spec.actor_id} is dead: "
+                                       f"{cause}"))
+                    return
+                a.queue.append(t)
+            else:
+                self.pending.append(t)
+        self._schedule()
+
+    def _schedule(self):
+        """Dispatch every runnable task. Called after any state change."""
+        to_send = []   # (worker, message) executed outside the lock
+        with self.lock:
+            if self._shutdown:
+                return
+            # --- generic + actor-creation tasks ---
+            still = []
+            want_spawn = 0
+            # `sim` tracks how much concurrency the resource pool could
+            # actually absorb, so we never spawn more workers than could
+            # run at once (reference: prestart-on-backlog is similarly
+            # resource-capped, node_manager.cc:1885).
+            sim = dict(self.available)
+            for t in self.pending:
+                if t.cancelled:
+                    continue
+                if t.deps:
+                    still.append(t)
+                    continue
+                if t.spec.actor_creation:
+                    disp = self._try_dispatch_actor_creation(t, to_send)
+                else:
+                    disp = self._try_dispatch_generic(t, to_send)
+                    if disp:
+                        _sub(sim, t.spec.resources)
+                    elif disp is None:   # resources fit but no idle worker
+                        if _fits(sim, t.spec.resources):
+                            _sub(sim, t.spec.resources)
+                            want_spawn += 1
+                        still.append(t)
+                        continue
+                if not disp:
+                    still.append(t)
+            self.pending = still
+            # --- actor method calls ---
+            for a in self.actors.values():
+                self._pump_actor(a, to_send)
+            # --- worker pool scale-up ---
+            # `_spawning` counts workers from Popen until registration (or
+            # failure); without it every schedule pass would re-spawn for the
+            # same pending tasks while the first worker is still importing.
+            n_generic = sum(1 for w in self.workers.values()
+                            if w.kind == "generic" and w.alive)
+            can = constants.MAX_WORKERS_CAP - n_generic - self._spawning
+            for _ in range(max(0, min(want_spawn - self._spawning, can))):
+                self._spawning += 1
+                threading.Thread(target=self._spawn_generic_worker,
+                                 daemon=True).start()
+        for w, msg in to_send:
+            if not w.send(msg):
+                self._on_worker_death(w)
+
+    def _try_dispatch_generic(self, t: _TaskState, to_send):
+        """True=dispatched, False=resources don't fit, None=no idle worker."""
+        req = t.spec.resources
+        pg = self.placement_groups.get(t.spec.placement_group_id or "")
+        if pg is not None:
+            if not any(_fits(b, req) for b in pg.available):
+                return False
+        elif not _fits(self.available, req):
+            return False
+        if req.get("TPU", 0) > 0:
+            # TPU tasks need TPU_VISIBLE_CHIPS in the environment BEFORE the
+            # process initializes JAX (the reference's CUDA_VISIBLE_DEVICES
+            # is equally process-birth-scoped for safety), so they run on a
+            # dedicated fresh worker that retires afterwards, not the pool.
+            n_tpu = int(req["TPU"])
+            if len(self.free_tpu_chips) < n_tpu:
+                return False
+            self._take_resources(t, pg)
+            t.tpu_chips = self.free_tpu_chips[:n_tpu]
+            del self.free_tpu_chips[:n_tpu]
+            threading.Thread(target=self._spawn_tpu_worker, args=(t,),
+                             daemon=True).start()
+            return True
+        worker = next((w for w in self.workers.values()
+                       if w.kind == "generic" and w.idle and w.alive), None)
+        if worker is None:
+            return None
+        self._take_resources(t, pg)
+        t.tpu_chips = []
+        worker.idle = False
+        worker.current = t
+        to_send.append((worker, self._push_msg(worker, t)))
+        return True
+
+    def _take_resources(self, t: _TaskState, pg):
+        req = t.spec.resources
+        if pg is not None:
+            for b in pg.available:
+                if _fits(b, req):
+                    _sub(b, req)
+                    break
+        else:
+            _sub(self.available, req)
+
+    def _spawn_tpu_worker(self, t: _TaskState):
+        worker_id = ids.new_worker_id()
+        w = _WorkerConn(worker_id, None, proc=None, kind="tpu",
+                        idle=False, alive=False)
+        with self.lock:
+            self.workers[worker_id] = w
+        w.proc = self._spawn_proc(
+            worker_id, self._worker_env(chips=t.tpu_chips))
+        if not self._await_registration(w):
+            with self.lock:
+                self._release_task_resources(t)
+                self.workers.pop(worker_id, None)
+            self._store_error(
+                t.spec.return_ids,
+                WorkerCrashedError("TPU worker failed to start"))
+            return
+        with self.lock:
+            w.current = t
+            msg = self._push_msg(w, t)
+        w.send(msg)
+
+    def _push_msg(self, worker: _WorkerConn, t: _TaskState):
+        spec = t.spec
+        if spec.function_id in worker.known_functions:
+            spec = protocol.TaskSpec(**{**spec.__dict__, "function_blob": None})
+        else:
+            worker.known_functions.add(spec.function_id)
+        locs = {}
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "ref":
+                locs[v] = self.directory[v]
+        return protocol.PushTask(spec=spec, arg_locations=locs)
+
+    def _try_dispatch_actor_creation(self, t: _TaskState, to_send):
+        a = self.actors[t.spec.actor_id]
+        req = a.resources
+        pg = self.placement_groups.get(t.spec.placement_group_id or "")
+        if pg is not None:
+            ok = any(_fits(b, req) for b in pg.available)
+        else:
+            ok = _fits(self.available, req)
+        if not ok:
+            return False
+        if pg is not None:
+            for b in pg.available:
+                if _fits(b, req):
+                    _sub(b, req)
+                    break
+        else:
+            _sub(self.available, req)
+        n_tpu = int(req.get("TPU", 0))
+        if n_tpu and len(self.free_tpu_chips) >= n_tpu:
+            a.tpu_chips = self.free_tpu_chips[:n_tpu]
+            del self.free_tpu_chips[:n_tpu]
+        threading.Thread(target=self._spawn_actor_worker, args=(a, t),
+                         daemon=True).start()
+        return True
+
+    def _pump_actor(self, a: _ActorState, to_send):
+        if a.dead or not a.ready or a.worker is None or not a.worker.alive:
+            return
+        while a.queue and len(a.inflight) < a.max_concurrency:
+            t = a.queue[0]
+            if t.deps:
+                break   # preserve submission order per actor
+            if t.cancelled:
+                a.queue.pop(0)
+                continue
+            a.queue.pop(0)
+            a.inflight.append(t)
+            to_send.append((a.worker, self._push_msg(a.worker, t)))
+
+    # ------------------------------------------------------------------
+    # worker processes
+    # ------------------------------------------------------------------
+
+    def _worker_env(self, chips=None):
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        if chips:
+            env[constants.TPU_VISIBLE_CHIPS_ENV] = ",".join(map(str, chips))
+            env["TPU_PROCESS_BOUNDS"] = ""
+        else:
+            # Workers must not grab the host's TPU runtime by default: only
+            # tasks that requested TPU resources see chips (the reference
+            # hides GPUs the same way via CUDA_VISIBLE_DEVICES="").
+            env["JAX_PLATFORMS"] = env.get("RAY_TPU_WORKER_JAX_PLATFORMS",
+                                           "cpu")
+        return env
+
+    def _spawn_proc(self, worker_id, env):
+        # subprocess (not mp.Process) so we control the child env exactly and
+        # never inherit the driver's TPU runtime handles/locks.
+        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+               self._address, worker_id]
+        env = dict(env)
+        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        # The driver may import ray_tpu off sys.path (uninstalled checkout);
+        # children must find the same package (reference: workers inherit the
+        # driver's load path via the worker command line, services.py).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pypath = env.get("PYTHONPATH", "")
+        if pkg_root not in pypath.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + pypath) if pypath \
+                else pkg_root
+        return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
+
+    def _spawn_generic_worker(self):
+        worker_id = ids.new_worker_id()
+        # Record the worker BEFORE Popen so a fast-registering child finds
+        # its slot in _serve_conn instead of racing us into a duplicate.
+        w = _WorkerConn(worker_id, None, proc=None, kind="generic",
+                        idle=False, alive=False)
+        with self.lock:
+            self.workers[worker_id] = w
+        w.proc = self._spawn_proc(worker_id, self._worker_env())
+        ok = self._await_registration(w)
+        with self.lock:
+            self._spawning -= 1
+            if ok:
+                w.idle = True
+                self._spawn_failures = 0
+            else:
+                self.workers.pop(worker_id, None)
+                self._spawn_failures += 1
+                if self._spawn_failures >= 3:
+                    # Startup is systematically broken (bad env, missing
+                    # package): fail queued work instead of a respawn storm.
+                    failed, self.pending = self.pending, []
+                    for t in failed:
+                        if not t.spec.actor_creation:
+                            self._store_error(
+                                t.spec.return_ids,
+                                WorkerCrashedError(
+                                    "worker processes repeatedly failed to "
+                                    "start; check worker logs"))
+        self._schedule()
+
+    def _spawn_actor_worker(self, a: _ActorState, creation_task: _TaskState):
+        worker_id = ids.new_worker_id()
+        w = _WorkerConn(worker_id, None, proc=None, kind="actor",
+                        idle=False, alive=False)
+        with self.lock:
+            self.workers[worker_id] = w
+        w.proc = self._spawn_proc(
+            worker_id, self._worker_env(chips=a.tpu_chips))
+        if not self._await_registration(w):
+            self._fail_actor(a, "actor worker failed to start")
+            return
+        to_send = []
+        with self.lock:
+            a.worker = w
+            w.current = creation_task
+            a.inflight.append(creation_task)
+            to_send.append((w, self._push_msg(w, creation_task)))
+        for w2, msg in to_send:
+            w2.send(msg)
+
+    def _await_registration(self, w: _WorkerConn) -> bool:
+        deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
+        with self.cv:
+            while not w.alive:
+                rem = deadline - time.monotonic()
+                if rem <= 0 or self._shutdown:
+                    return False
+                if w.proc is not None and w.proc.poll() is not None:
+                    return False
+                self.cv.wait(min(rem, 0.2))
+        return True
+
+    # ------------------------------------------------------------------
+    # completion + failure
+    # ------------------------------------------------------------------
+
+    def _on_task_done(self, w: _WorkerConn, msg: protocol.TaskDone):
+        retire = None
+        with self.lock:
+            t = w.current if (w.current and w.current.spec.task_id ==
+                              msg.task_id) else None
+            a = None
+            if t is None:
+                # actor task completing (possibly out of submission order
+                # when max_concurrency > 1)
+                for cand in self.actors.values():
+                    for inf in cand.inflight:
+                        if inf.spec.task_id == msg.task_id:
+                            a, t = cand, inf
+                            break
+                    if a:
+                        break
+            if t is None:
+                logger.warning("TaskDone for unknown task %s", msg.task_id)
+                return
+            spec = t.spec
+            if a is None and spec.actor_id is not None:
+                a = self.actors.get(spec.actor_id)
+            # Retry on application error if requested.
+            if (msg.error and t.retry_exceptions and t.retries_left > 0
+                    and not spec.actor_creation):
+                t.retries_left -= 1
+                self._requeue_after_failure(w, t, a)
+                return
+            for oid, desc in zip(spec.return_ids, msg.return_descs):
+                self.directory[oid] = desc
+                for dep_t in self.obj_waiting_tasks.pop(oid, ()):
+                    dep_t.deps.discard(oid)
+            self.cv.notify_all()
+            if a is not None:
+                if t in a.inflight:
+                    a.inflight.remove(t)
+                if spec.actor_creation:
+                    if msg.error:
+                        a.dead = True
+                        a.death_cause = "constructor raised"
+                        self._release_actor_resources(a)
+                        failed, a.queue = a.queue, []
+                        for qt in failed:
+                            self._store_error(
+                                qt.spec.return_ids,
+                                ActorDiedError(
+                                    f"actor {a.actor_id} constructor raised"))
+                    else:
+                        a.ready = True
+                if a.worker is w:
+                    w.current = None
+            else:
+                w.current = None
+                if not w.released:
+                    self._release_task_resources(t)
+                w.released = {}
+                if w.kind == "tpu":
+                    # Dedicated TPU workers retire with their task: the TPU
+                    # runtime can't be re-scoped in a live process.
+                    w.idle = False
+                    w.alive = False
+                    retire = w
+                else:
+                    w.idle = True
+        if retire is not None:
+            retire.send(protocol.KillWorker())
+            with self.lock:
+                self.workers.pop(retire.worker_id, None)
+        self._schedule()
+
+    def _requeue_after_failure(self, w, t, a):
+        """Re-run a failed task (called under lock)."""
+        if a is not None:
+            if t in a.inflight:
+                a.inflight.remove(t)
+            a.queue.insert(0, t)
+            if a.worker is w:
+                w.current = None
+        else:
+            w.idle = True
+            w.current = None
+            if not w.released:
+                self._release_task_resources(t)
+            w.released = {}
+            self.pending.append(t)
+
+    def _release_task_resources(self, t: _TaskState):
+        pg = self.placement_groups.get(t.spec.placement_group_id or "")
+        if pg is not None:
+            # return to the first bundle with headroom vs its spec
+            for b, orig in zip(pg.available, pg.bundles):
+                if all(b.get(k, 0) + v <= orig.get(k, 0) + _EPS
+                       for k, v in t.spec.resources.items()):
+                    _add(b, t.spec.resources)
+                    break
+            else:
+                if pg.available:
+                    _add(pg.available[0], t.spec.resources)
+        else:
+            _add(self.available, t.spec.resources)
+        chips = getattr(t, "tpu_chips", None)
+        if chips:
+            self.free_tpu_chips.extend(chips)
+
+    def _release_actor_resources(self, a: _ActorState):
+        pg = self.placement_groups.get(
+            a.creation_spec.placement_group_id or "")
+        if pg is not None and pg.available:
+            _add(pg.available[0], a.resources)
+        elif pg is None:
+            _add(self.available, a.resources)
+        if a.tpu_chips:
+            self.free_tpu_chips.extend(a.tpu_chips)
+            a.tpu_chips = []
+
+    def _store_error(self, return_ids, exc):
+        """Store `exc` as the value of every return id (under or out of lock)."""
+        for oid in return_ids:
+            desc = self.store.put(oid, exc)
+            self.directory[oid] = desc
+        with self.lock:
+            for oid in return_ids:
+                for dep_t in self.obj_waiting_tasks.pop(oid, ()):
+                    dep_t.deps.discard(oid)
+            self.cv.notify_all()
+
+    def _on_worker_death(self, w: _WorkerConn):
+        with self.lock:
+            if not w.alive and w.current is None:
+                return
+            w.alive = False
+            w.idle = False
+            t = w.current
+            w.current = None
+            actor = next((a for a in self.actors.values()
+                          if a.worker is w), None)
+        if actor is not None:
+            self._on_actor_worker_death(actor)
+        elif t is not None:
+            with self.lock:
+                if not w.released:
+                    self._release_task_resources(t)
+                w.released = {}
+                if t.retries_left > 0:
+                    t.retries_left -= 1
+                    self.pending.append(t)
+                    retry = True
+                else:
+                    retry = False
+            if not retry:
+                self._store_error(
+                    t.spec.return_ids,
+                    WorkerCrashedError(
+                        f"worker died while running {t.spec.function_desc}"))
+        self._schedule()
+
+    def _on_actor_worker_death(self, a: _ActorState):
+        with self.lock:
+            a.ready = False
+            a.worker = None
+            inflight, a.inflight = a.inflight, []
+            can_restart = (not a.dead and
+                           (a.max_restarts == -1 or
+                            a.restarts_used < a.max_restarts))
+            if can_restart:
+                a.restarts_used += 1
+                # Return the dead incarnation's resources/chips; the
+                # re-queued creation task re-subtracts them on dispatch.
+                self._release_actor_resources(a)
+                # retry in-flight tasks if allowed, else fail them
+                retry_tasks, fail_tasks = [], []
+                for t in inflight:
+                    if t.spec.actor_creation:
+                        continue
+                    if a.max_task_retries != 0:
+                        retry_tasks.append(t)
+                    else:
+                        fail_tasks.append(t)
+                a.queue[:0] = retry_tasks
+                creation = _TaskState(spec=a.creation_spec)
+                self.pending.append(creation)
+            else:
+                a.dead = True
+                a.death_cause = a.death_cause or "worker process died"
+                fail_tasks = [t for t in inflight
+                              if not t.spec.actor_creation]
+                fail_tasks.extend(a.queue)
+                a.queue = []
+                self._release_actor_resources(a)
+        for t in fail_tasks:
+            self._store_error(
+                t.spec.return_ids,
+                ActorDiedError(f"actor {a.actor_id} died"
+                               f" ({a.death_cause or 'restarting'})"))
+        self._schedule()
+
+    def _fail_actor(self, a: _ActorState, cause: str):
+        with self.lock:
+            a.dead = True
+            a.death_cause = cause
+            tasks = list(a.inflight) + list(a.queue)
+            a.inflight, a.queue = [], []
+            self._release_actor_resources(a)
+        for t in tasks:
+            self._store_error(t.spec.return_ids, ActorDiedError(cause))
+        # creation return id too
+        self._store_error(a.creation_spec.return_ids, ActorDiedError(cause))
+
+    # ------------------------------------------------------------------
+    # actor control
+    # ------------------------------------------------------------------
+
+    def get_named_actor(self, name: str):
+        with self.lock:
+            actor_id = self.named_actors.get(name)
+            if actor_id is None:
+                return None
+            a = self.actors.get(actor_id)
+            if a is None or a.dead:
+                return None
+            return {"actor_id": actor_id, "method_meta": a.method_meta,
+                    "creation_return": a.creation_spec.return_ids[0]}
+
+    def kill_actor(self, actor_id: str, no_restart=True):
+        with self.lock:
+            a = self.actors.get(actor_id)
+            if a is None:
+                return False
+            if no_restart:
+                a.dead = True
+                a.death_cause = "killed via kill()"
+                if a.name:
+                    self.named_actors.pop(a.name, None)
+            w = a.worker
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.terminate()
+            except OSError:
+                pass
+        return True
+
+    def cancel(self, object_id: str, force: bool = False):
+        with self.lock:
+            for t in self.pending:
+                if object_id in t.spec.return_ids:
+                    t.cancelled = True
+                    self.pending.remove(t)
+                    self._store_error(t.spec.return_ids,
+                                      TaskCancelledError("task cancelled"))
+                    return True
+            for a in self.actors.values():
+                for t in a.queue:
+                    if object_id in t.spec.return_ids:
+                        t.cancelled = True
+                        a.queue.remove(t)
+                        self._store_error(t.spec.return_ids,
+                                          TaskCancelledError("task cancelled"))
+                        return True
+        return False
+
+    # ------------------------------------------------------------------
+    # placement groups (single-node: pure resource accounting; the 2PC
+    # prepare/commit of the reference (placement_group_resource_manager.h)
+    # becomes relevant with multi-host support)
+    # ------------------------------------------------------------------
+
+    def create_placement_group(self, bundles, strategy="PACK", name=""):
+        total = {}
+        for b in bundles:
+            _add(total, b)
+        with self.lock:
+            if not _fits(self.available, total):
+                raise PlacementGroupError(
+                    f"infeasible placement group: need {total}, "
+                    f"available {self.available}")
+            _sub(self.available, total)
+            pg_id = ids.new_placement_group_id()
+            self.placement_groups[pg_id] = _PlacementGroup(
+                pg_id, [dict(b) for b in bundles], strategy)
+        return pg_id
+
+    def remove_placement_group(self, pg_id: str):
+        with self.lock:
+            pg = self.placement_groups.pop(pg_id, None)
+            if pg is None:
+                return False
+            total = {}
+            for b in pg.bundles:
+                _add(total, b)
+            _add(self.available, total)
+        self._schedule()
+        return True
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+
+    def shutdown(self):
+        with self.lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            workers = list(self.workers.values())
+        for w in workers:
+            w.send(protocol.KillWorker())
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 3.0
+        for w in workers:
+            if w.proc is None:
+                continue
+            try:
+                while w.proc.poll() is None and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                if w.proc.poll() is None:
+                    w.proc.terminate()
+                    try:
+                        w.proc.wait(1.0)
+                    except Exception:
+                        w.proc.kill()
+            except OSError:
+                pass
+        self.store.close()
+        shutil.rmtree(self.session_dir, ignore_errors=True)
+        atexit.unregister(self.shutdown)

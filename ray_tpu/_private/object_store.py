@@ -1,0 +1,138 @@
+"""Shared-memory object store ("plasma-lite").
+
+Counterpart of the reference's plasma store (`src/ray/object_manager/plasma/`,
+`store.h:55`): one node-local store holding immutable serialized objects that
+any process on the host can map zero-copy. Design differences, on purpose:
+
+- One tmpfs-backed file per object under /dev/shm/<session>/ instead of one
+  dlmalloc arena: ownership and cleanup become trivial (driver unlinks the
+  session dir), at the cost of a file create per large object. The interface
+  (`create/seal/get/delete/contains`) matches plasma's client verbs
+  (plasma/client.h) so a C++ slab allocator can replace the backend without
+  touching callers.
+- Small objects never touch the store; they ride inline in control messages
+  (the reference similarly returns small task outputs inline in the gRPC
+  reply and keeps them in the in-process memory store,
+  store_provider/memory_store/).
+
+Any process may create an object (workers write results directly — same as
+plasma, where workers hold a store client); the *directory* of which objects
+exist lives with the driver node (ownership, reference count) — the
+counterpart of the ownership-based object directory
+(ownership_based_object_directory.h).
+"""
+
+import mmap
+import os
+import threading
+from dataclasses import dataclass
+
+from ray_tpu._private import serialization
+from ray_tpu._private.constants import INLINE_OBJECT_MAX_BYTES
+from ray_tpu.exceptions import ObjectLostError
+
+
+@dataclass(frozen=True)
+class Descriptor:
+    """Location of a sealed object's bytes. Either inline or file-backed."""
+    object_id: str
+    size: int
+    inline: bytes | None = None  # set iff the object is small
+    path: str | None = None      # set iff the object lives in the store dir
+
+
+class ObjectStore:
+    """Per-process handle to the session's shared object directory on tmpfs."""
+
+    def __init__(self, session_dir: str):
+        self._dir = os.path.join(session_dir, "objects")
+        os.makedirs(self._dir, exist_ok=True)
+        # Keep mmaps alive while deserialized views may reference them.
+        # obj_id -> (mmap, file size). Never evicted within a session in v1;
+        # the eviction/spilling policy slot is here (reference: eviction_policy.h).
+        self._maps: dict[str, mmap.mmap] = {}
+        self._lock = threading.Lock()
+
+    # -- write path ---------------------------------------------------------
+
+    def put(self, object_id: str, value) -> Descriptor:
+        """Serialize `value`; small -> inline descriptor, large -> shm file."""
+        size, meta, buffers = serialization.serialized_size(value)
+        if size <= INLINE_OBJECT_MAX_BYTES:
+            out = bytearray(size)
+            n = serialization.write_envelope(memoryview(out), meta, buffers)
+            return Descriptor(object_id, n, inline=bytes(out[:n]))
+        path = os.path.join(self._dir, object_id)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb+") as f:
+            f.truncate(size)
+            with mmap.mmap(f.fileno(), size) as m:
+                n = serialization.write_envelope(memoryview(m), meta, buffers)
+        if n != size:
+            with open(tmp, "rb+") as f:
+                f.truncate(n)
+        os.rename(tmp, path)  # atomic seal: object visible only when complete
+        return Descriptor(object_id, n, path=path)
+
+    def put_serialized(self, object_id: str, payload: bytes) -> Descriptor:
+        """Store an already-serialized envelope (e.g. received over DCN)."""
+        if len(payload) <= INLINE_OBJECT_MAX_BYTES:
+            return Descriptor(object_id, len(payload), inline=payload)
+        path = os.path.join(self._dir, object_id)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.rename(tmp, path)
+        return Descriptor(object_id, len(payload), path=path)
+
+    # -- read path ----------------------------------------------------------
+
+    def get(self, desc: Descriptor):
+        """Deserialize the object a descriptor points at (zero-copy mmap)."""
+        if desc.inline is not None:
+            return serialization.loads(desc.inline)
+        with self._lock:
+            m = self._maps.get(desc.object_id)
+            if m is None:
+                try:
+                    with open(desc.path, "rb") as f:
+                        m = mmap.mmap(f.fileno(), desc.size,
+                                      access=mmap.ACCESS_READ)
+                except FileNotFoundError:
+                    raise ObjectLostError(
+                        f"object {desc.object_id} missing from store "
+                        f"({desc.path})") from None
+                self._maps[desc.object_id] = m
+        return serialization.loads(m)
+
+    def raw_bytes(self, desc: Descriptor) -> bytes:
+        """The serialized envelope (for forwarding across nodes)."""
+        if desc.inline is not None:
+            return desc.inline
+        with open(desc.path, "rb") as f:
+            return f.read()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def delete(self, desc: Descriptor) -> None:
+        with self._lock:
+            m = self._maps.pop(desc.object_id, None)
+        if m is not None:
+            try:
+                m.close()
+            except BufferError:
+                pass  # live views reference it; the mmap dies with the process
+        if desc.path is not None:
+            try:
+                os.unlink(desc.path)
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            maps, self._maps = self._maps, {}
+        for m in maps.values():
+            try:
+                m.close()
+            except BufferError:
+                pass

@@ -1,0 +1,150 @@
+"""Control-plane message types between driver node and worker processes.
+
+Counterpart of the reference's protobuf contracts (`src/ray/protobuf/
+common.proto` TaskSpec, `core_worker.proto` PushTask, `node_manager.proto`
+RequestWorkerLease). We use plain dataclasses over a length-framed pickle
+channel (multiprocessing.connection); the field set intentionally mirrors the
+reference's TaskSpec so a future gRPC/C++ transport can adopt it 1:1.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu._private.object_store import Descriptor
+
+
+@dataclass
+class TaskSpec:
+    """Everything needed to run one task invocation (common.proto TaskSpec)."""
+    task_id: str
+    # Function: either a cached id (worker looks up its function table) plus
+    # optional serialized bytes on first use (function_manager.py pattern).
+    function_id: str
+    function_blob: bytes | None  # cloudpickled callable; None if cached
+    function_desc: str           # human-readable "module.fn" for errors/logs
+    # Positional/keyword args: values are either ("v", inline_envelope_bytes)
+    # or ("ref", object_id) — top-level ObjectRefs are resolved before the
+    # task runs, like the reference's dependency_resolver.h.
+    args: list = field(default_factory=list)
+    kwargs: dict = field(default_factory=dict)
+    num_returns: int = 1
+    return_ids: list = field(default_factory=list)
+    resources: dict = field(default_factory=dict)
+    # Actor fields
+    actor_id: str | None = None          # target actor for method calls
+    actor_creation: bool = False         # this spec constructs the actor
+    method_name: str | None = None
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    runtime_env: dict | None = None
+    placement_group_id: str | None = None
+    # Name shown in state API / dashboards.
+    name: str = ""
+
+
+# ---- driver -> worker -----------------------------------------------------
+
+@dataclass
+class PushTask:
+    """Dispatch one task to a leased worker (core_worker.proto PushTask).
+
+    `arg_locations` maps object_id -> Descriptor for every ref argument, so
+    the worker can mmap dependencies without a round trip.
+    """
+    spec: TaskSpec
+    arg_locations: dict[str, Descriptor] = field(default_factory=dict)
+
+
+@dataclass
+class KillWorker:
+    graceful: bool = True
+
+
+# ---- worker -> driver -----------------------------------------------------
+
+@dataclass
+class RegisterWorker:
+    worker_id: str
+    pid: int
+
+
+@dataclass
+class TaskDone:
+    """Task finished; returns are sealed. Error is a serialized TaskError
+    envelope stored as the return value (reference stores error objects in
+    plasma the same way)."""
+    task_id: str
+    return_descs: list  # list[Descriptor], parallel to spec.return_ids
+    error: bool = False
+    # For actor creation tasks: advertises readiness.
+    actor_ready: bool = False
+
+
+@dataclass
+class PutRequest:
+    """Worker already wrote the object into the store; register it."""
+    object_id: str
+    desc: Descriptor
+
+
+@dataclass
+class GetRequest:
+    """Blocking fetch of object locations; driver replies GetReply when all
+    are ready (or timeout). Issuing worker's CPU resources are released while
+    blocked, as in the reference (worker blocked-on-get releases its lease)."""
+    req_id: int
+    object_ids: list
+    timeout: float | None = None
+
+
+@dataclass
+class GetReply:
+    req_id: int
+    locations: dict          # object_id -> Descriptor
+    timed_out: bool = False
+
+
+@dataclass
+class WaitRequest:
+    req_id: int
+    object_ids: list
+    num_returns: int
+    timeout: float | None = None
+    fetch_local: bool = True
+
+
+@dataclass
+class WaitReply:
+    req_id: int
+    ready: list
+    not_ready: list
+
+
+@dataclass
+class SubmitRequest:
+    """Nested task/actor submission from inside a worker."""
+    req_id: int
+    spec: TaskSpec
+
+
+@dataclass
+class SubmitReply:
+    req_id: int
+    ok: bool = True
+    error: str | None = None
+
+
+@dataclass
+class ActorCallRequest:
+    """Generic control-plane RPC: named-actor lookup, kill, KV ops, etc.
+    `method` selects a NodeServer handler; `payload` is method-specific."""
+    req_id: int
+    method: str
+    payload: Any = None
+
+
+@dataclass
+class ActorCallReply:
+    req_id: int
+    result: Any = None
+    error: str | None = None

@@ -1,0 +1,214 @@
+"""Process-global client state + the ObjectRef type.
+
+Counterpart of the reference's `python/ray/_private/worker.py` global
+`Worker` (the object `ray.init` populates and every API call goes through)
+— but here the "core worker" has two concrete shapes sharing one interface:
+
+- `DriverClient`: in-process calls straight into the NodeServer (the driver
+  embeds its node, so `get`/`put` skip any socket hop);
+- `WorkerClient`: the socket channel of `worker_main.WorkerRuntime`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+from ray_tpu._private import ids
+from ray_tpu.exceptions import RayTpuError, TaskError
+
+
+class ObjectRef:
+    """A future for a task return or `put` value (reference: ObjectRef in
+    `python/ray/includes/object_ref.pxi`). Identity is the object id string."""
+
+    __slots__ = ("_id",)
+
+    def __init__(self, object_id: str):
+        self._id = object_id
+
+    def hex(self) -> str:
+        return self._id
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id})"
+
+    def future(self):
+        """concurrent.futures.Future view (reference: ObjectRef.future)."""
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _poll():
+            try:
+                fut.set_result(get(self))
+            except BaseException as e:
+                fut.set_exception(e)
+        threading.Thread(target=_poll, daemon=True).start()
+        return fut
+
+
+class BaseClient:
+    mode = "none"
+
+    def get(self, refs, timeout=None):
+        raise NotImplementedError
+
+    def put(self, value) -> str:
+        raise NotImplementedError
+
+    def wait(self, object_ids, num_returns, timeout, fetch_local):
+        raise NotImplementedError
+
+    def submit(self, spec) -> None:
+        raise NotImplementedError
+
+    def control(self, method: str, payload=None):
+        raise NotImplementedError
+
+
+class DriverClient(BaseClient):
+    mode = "driver"
+
+    def __init__(self, node):
+        self.node = node
+        self.job_id = ids.new_job_id()
+
+    def get_values(self, object_ids, timeout=None):
+        locs = self.node.get_locations(object_ids, timeout)
+        return [self.node.store.get(locs[o]) for o in object_ids]
+
+    def put(self, value):
+        return self.node.put_value(value)
+
+    def put_serialized(self, payload: bytes) -> str:
+        oid = ids.new_object_id()
+        desc = self.node.store.put_serialized(oid, payload)
+        self.node.register_object(oid, desc)
+        return oid
+
+    def wait(self, object_ids, num_returns, timeout, fetch_local):
+        return self.node.wait_objects(object_ids, num_returns, timeout)
+
+    def submit(self, spec):
+        self.node.submit(spec)
+
+    def control(self, method, payload=None):
+        return self.node._control(method, payload, None)
+
+
+class WorkerClient(BaseClient):
+    mode = "worker"
+
+    def __init__(self, runtime):
+        self.rt = runtime
+
+    def get_values(self, object_ids, timeout=None):
+        return self.rt.get_objects(object_ids, timeout)
+
+    def put(self, value):
+        return self.rt.put_object(value)
+
+    def put_serialized(self, payload: bytes) -> str:
+        from ray_tpu._private import protocol
+        oid = ids.new_object_id()
+        desc = self.rt.store.put_serialized(oid, payload)
+        self.rt.send(protocol.PutRequest(oid, desc))
+        return oid
+
+    def wait(self, object_ids, num_returns, timeout, fetch_local):
+        return self.rt.wait_objects(object_ids, num_returns, timeout,
+                                    fetch_local)
+
+    def submit(self, spec):
+        self.rt.submit_spec(spec)
+
+    def control(self, method, payload=None):
+        return self.rt.control(method, payload)
+
+
+_global_client: BaseClient | None = None
+_init_lock = threading.Lock()
+
+
+def get_client() -> BaseClient:
+    if _global_client is None:
+        raise RayTpuError(
+            "ray_tpu.init() has not been called in this process")
+    return _global_client
+
+
+def is_initialized() -> bool:
+    return _global_client is not None
+
+
+def connect_driver_mode(node) -> DriverClient:
+    global _global_client
+    _global_client = DriverClient(node)
+    return _global_client
+
+
+def connect_worker_mode(runtime) -> WorkerClient:
+    global _global_client
+    _global_client = WorkerClient(runtime)
+    return _global_client
+
+
+def disconnect():
+    global _global_client
+    _global_client = None
+
+
+# ---------------------------------------------------------------------------
+# get / put / wait over the global client
+# ---------------------------------------------------------------------------
+
+def _raise_if_error(value):
+    if isinstance(value, TaskError):
+        raise value.as_instanceof_cause()
+    if isinstance(value, RayTpuError):
+        raise value
+    return value
+
+
+def get(refs, *, timeout: float | None = None):
+    client = get_client()
+    single = isinstance(refs, ObjectRef)
+    ref_list: Sequence[ObjectRef] = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get() expects ObjectRef(s), got {type(r).__name__}")
+    values = client.get_values([r._id for r in ref_list], timeout)
+    values = [_raise_if_error(v) for v in values]
+    return values[0] if single else values
+
+
+def put(value) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return ObjectRef(get_client().put(value))
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None,
+         fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    ref_list = list(refs)
+    if len(set(r._id for r in ref_list)) != len(ref_list):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns > len(ref_list):
+        raise ValueError("num_returns exceeds the number of refs")
+    ready, not_ready = get_client().wait(
+        [r._id for r in ref_list], num_returns, timeout, fetch_local)
+    by_id = {r._id: r for r in ref_list}
+    return [by_id[i] for i in ready], [by_id[i] for i in not_ready]

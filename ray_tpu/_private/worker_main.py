@@ -1,0 +1,264 @@
+"""Worker process entry point.
+
+Counterpart of the reference's worker main + Cython `execute_task` callback
+(`python/ray/_private/workers/default_worker.py` + `_raylet.pyx:1245`): a
+process that registers with its node, receives pushed tasks, resolves
+dependencies from the shared-memory store, runs user code, and seals results.
+
+The same process hosts either a pool ("generic") worker or a dedicated actor;
+actors with `max_concurrency > 1` run methods on a thread pool (the
+reference's threaded actor concurrency groups).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing import connection
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu.exceptions import RayTpuError, TaskError
+
+
+class WorkerRuntime:
+    """Per-worker state + the client channel back to the node server."""
+
+    def __init__(self, address: str, worker_id: str, authkey: bytes):
+        self.worker_id = worker_id
+        self.conn = connection.Client(address, family="AF_UNIX",
+                                      authkey=authkey)
+        session_dir = os.path.dirname(address)
+        self.store = ObjectStore(session_dir)
+        self.functions: dict[str, object] = {}
+        self.actor_instance = None
+        self.actor_id: str | None = None
+        self.task_queue: queue.Queue = queue.Queue()
+        self._req_id = 0
+        self._req_lock = threading.Lock()
+        self._replies: dict[int, object] = {}
+        self._reply_cv = threading.Condition()
+        self._send_lock = threading.Lock()
+        self._executor: ThreadPoolExecutor | None = None
+        self._current_task_ids = threading.local()
+        self.shutdown = False
+
+    # ---- channel ----------------------------------------------------------
+
+    def send(self, msg):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _next_req_id(self) -> int:
+        with self._req_lock:
+            self._req_id += 1
+            return self._req_id
+
+    def request(self, make_msg):
+        """Send a request carrying a fresh req_id; block for the reply."""
+        req_id = self._next_req_id()
+        self.send(make_msg(req_id))
+        with self._reply_cv:
+            while req_id not in self._replies:
+                self._reply_cv.wait(1.0)
+                if self.shutdown:
+                    raise RuntimeError("worker shutting down")
+            return self._replies.pop(req_id)
+
+    def reader_loop(self):
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                os._exit(0)
+            if isinstance(msg, protocol.PushTask):
+                self.task_queue.put(msg)
+            elif isinstance(msg, protocol.KillWorker):
+                self.shutdown = True
+                self.task_queue.put(None)
+                with self._reply_cv:
+                    self._reply_cv.notify_all()
+            elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
+                                  protocol.SubmitReply,
+                                  protocol.ActorCallReply)):
+                with self._reply_cv:
+                    self._replies[msg.req_id] = msg
+                    self._reply_cv.notify_all()
+
+    # ---- object access (used by the ray_tpu client API in worker mode) ----
+
+    def get_objects(self, object_ids, timeout=None):
+        reply = self.request(lambda rid: protocol.GetRequest(
+            rid, list(object_ids), timeout))
+        if reply.timed_out:
+            from ray_tpu.exceptions import GetTimeoutError
+            raise GetTimeoutError(f"get() timed out: {object_ids[:3]}")
+        out = []
+        for oid in object_ids:
+            value = self.store.get(reply.locations[oid])
+            out.append(value)
+        return out
+
+    def put_object(self, value) -> str:
+        from ray_tpu._private import ids
+        oid = ids.new_object_id()
+        desc = self.store.put(oid, value)
+        self.send(protocol.PutRequest(oid, desc))
+        return oid
+
+    def wait_objects(self, object_ids, num_returns, timeout, fetch_local):
+        reply = self.request(lambda rid: protocol.WaitRequest(
+            rid, list(object_ids), num_returns, timeout, fetch_local))
+        return reply.ready, reply.not_ready
+
+    def submit_spec(self, spec):
+        reply = self.request(lambda rid: protocol.SubmitRequest(rid, spec))
+        if not reply.ok:
+            raise RayTpuError(f"submit failed: {reply.error}")
+
+    def control(self, method, payload=None):
+        reply = self.request(lambda rid: protocol.ActorCallRequest(
+            rid, method, payload))
+        if reply.error is not None:
+            raise RayTpuError(reply.error)
+        return reply.result
+
+    # ---- execution --------------------------------------------------------
+
+    def current_task_id(self):
+        return getattr(self._current_task_ids, "task_id", None)
+
+    def _resolve_fn(self, spec: protocol.TaskSpec):
+        fn = self.functions.get(spec.function_id)
+        if fn is None:
+            if spec.function_blob is None:
+                raise RayTpuError(
+                    f"function {spec.function_desc} not cached and no blob")
+            fn = serialization.loads_message(spec.function_blob)
+            self.functions[spec.function_id] = fn
+        return fn
+
+    def _resolve_args(self, spec, arg_locations):
+        def one(kind, v):
+            if kind == "ref":
+                value = self.store.get(arg_locations[v])
+            else:
+                value = serialization.loads(v)
+            return value
+        args = [one(k, v) for k, v in spec.args]
+        kwargs = {name: one(k, v) for name, (k, v) in spec.kwargs.items()}
+        # Error propagation: a dependency that failed short-circuits this
+        # task, surfacing the ORIGINAL error (reference: RayTaskError values
+        # poison downstream tasks).
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, (TaskError, RayTpuError)):
+                raise _DepFailed(v)
+        return args, kwargs
+
+    def run_task(self, push: protocol.PushTask):
+        spec = push.spec
+        chips = os.environ.get("TPU_VISIBLE_CHIPS")
+        self._current_task_ids.task_id = spec.task_id
+        try:
+            is_actor_method = (spec.actor_id is not None
+                               and not spec.actor_creation)
+            fn = None if is_actor_method else self._resolve_fn(spec)
+            args, kwargs = self._resolve_args(spec, push.arg_locations)
+            if spec.actor_creation:
+                cls = fn
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_id = spec.actor_id
+                result = None
+                values = [None] * spec.num_returns
+            elif spec.actor_id is not None:
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                values = self._split_returns(result, spec.num_returns)
+            else:
+                result = fn(*args, **kwargs)
+                values = self._split_returns(result, spec.num_returns)
+            error = False
+        except _DepFailed as df:
+            values = [df.cause] * spec.num_returns
+            error = True
+        except BaseException as e:
+            tb = traceback.format_exc()
+            te = TaskError(type(e).__name__, str(e), tb, cause=e)
+            values = [te] * spec.num_returns
+            error = True
+        finally:
+            self._current_task_ids.task_id = None
+        descs = []
+        for oid, value in zip(spec.return_ids, values):
+            try:
+                descs.append(self.store.put(oid, value))
+            except BaseException as e:   # unpicklable return, etc.
+                tb = traceback.format_exc()
+                te = TaskError(type(e).__name__,
+                               f"failed to serialize result: {e}", tb)
+                descs.append(self.store.put(oid, te))
+                error = True
+        self.send(protocol.TaskDone(
+            task_id=spec.task_id, return_descs=descs, error=error,
+            actor_ready=spec.actor_creation and not error))
+
+    @staticmethod
+    def _split_returns(result, num_returns):
+        if num_returns == 1:
+            return [result]
+        out = list(result)
+        if len(out) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(out)} values")
+        return out
+
+    def main_loop(self):
+        max_concurrency = 1
+        while not self.shutdown:
+            push = self.task_queue.get()
+            if push is None:
+                break
+            spec = push.spec
+            if spec.actor_creation:
+                max_concurrency = (spec.runtime_env or {}).get(
+                    "_max_concurrency", 1)
+                if max_concurrency > 1:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=max_concurrency,
+                        thread_name_prefix="actor-method")
+                self.run_task(push)
+            elif self._executor is not None:
+                self._executor.submit(self.run_task, push)
+            else:
+                self.run_task(push)
+        os._exit(0)
+
+
+class _DepFailed(Exception):
+    def __init__(self, cause):
+        self.cause = cause
+
+
+def main():
+    address, worker_id = sys.argv[1], sys.argv[2]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    rt = WorkerRuntime(address, worker_id, authkey)
+    rt.send(protocol.RegisterWorker(worker_id, os.getpid()))
+
+    # Install this runtime as the process-global client so user code can call
+    # ray_tpu.get/put/remote/... inside tasks (nested submission).
+    from ray_tpu._private import worker as worker_mod
+    worker_mod.connect_worker_mode(rt)
+
+    threading.Thread(target=rt.reader_loop, daemon=True,
+                     name="ray_tpu-worker-reader").start()
+    rt.main_loop()
+
+
+if __name__ == "__main__":
+    main()

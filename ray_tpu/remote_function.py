@@ -1,0 +1,107 @@
+"""@remote functions.
+
+Counterpart of the reference's `python/ray/remote_function.py`
+(`RemoteFunction`, `_remote` :245): wraps a user callable, carries default
+task options, and turns `.remote(...)` calls into TaskSpec submissions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import functools
+
+import cloudpickle
+
+from ray_tpu._private import ids, protocol, serialization
+from ray_tpu._private.constants import (
+    DEFAULT_TASK_NUM_CPUS,
+    INLINE_OBJECT_MAX_BYTES,
+)
+from ray_tpu._private.worker import ObjectRef, get_client
+
+
+def _resources_from_options(o: dict, default_cpus: float) -> dict:
+    res = dict(o.get("resources") or {})
+    num_cpus = o.get("num_cpus")
+    num_tpus = o.get("num_tpus", o.get("num_gpus"))  # num_gpus accepted as
+    # an alias to ease porting reference-API code onto TPU resources.
+    res["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    mem = o.get("memory")
+    if mem:
+        res["memory"] = float(mem)
+    return res
+
+
+def _encode_args(args, kwargs):
+    """Top-level ObjectRefs become ("ref", id); other values are serialized
+    inline, spilling to the object store above the inline cap (the reference
+    promotes >100KB args to plasma in `_raylet.pyx` submit_task)."""
+    def enc(v):
+        if isinstance(v, ObjectRef):
+            return ("ref", v._id)
+        blob = serialization.dumps(v)
+        if len(blob) > INLINE_OBJECT_MAX_BYTES:
+            # Reuse the envelope we just built instead of re-serializing.
+            return ("ref", get_client().put_serialized(blob))
+        return ("v", blob)
+    return [enc(a) for a in args], {k: enc(v) for k, v in kwargs.items()}
+
+
+class RemoteFunction:
+    def __init__(self, function, options: dict | None = None):
+        self._function = function
+        self._options = dict(options or {})
+        functools.update_wrapper(self, function)
+        self._pickled: bytes | None = None
+        self._function_id: str | None = None
+
+    def _materialize(self):
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._function, protocol=5)
+            self._function_id = ("fn_" +
+                                 hashlib.sha1(self._pickled).hexdigest()[:16])
+        return self._pickled, self._function_id
+
+    def options(self, **opts) -> "RemoteFunction":
+        new = RemoteFunction(self._function, {**self._options, **opts})
+        new._pickled, new._function_id = self._materialize()
+        return new
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"remote function {self._function.__name__} cannot be called "
+            f"directly; use .remote()")
+
+    def remote(self, *args, **kwargs):
+        blob, function_id = self._materialize()
+        o = self._options
+        num_returns = int(o.get("num_returns", 1))
+        task_id = ids.new_task_id()
+        return_ids = [ids.new_object_id() for _ in range(num_returns)]
+        enc_args, enc_kwargs = _encode_args(args, kwargs)
+        pg_id = None
+        strategy = o.get("scheduling_strategy")
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg_id = strategy.placement_group.id
+        spec = protocol.TaskSpec(
+            task_id=task_id,
+            function_id=function_id,
+            function_blob=blob,
+            function_desc=getattr(self._function, "__qualname__",
+                                  str(self._function)),
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=_resources_from_options(o, DEFAULT_TASK_NUM_CPUS),
+            max_retries=int(o.get("max_retries", 0)),
+            retry_exceptions=bool(o.get("retry_exceptions", False)),
+            runtime_env=o.get("runtime_env"),
+            placement_group_id=pg_id,
+            name=o.get("name") or getattr(self._function, "__name__", ""),
+        )
+        get_client().submit(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs

@@ -1,0 +1,59 @@
+"""Placement groups (reference: `python/ray/util/placement_group.py`).
+
+On one TPU host a placement group is a resource reservation with per-bundle
+accounting. The TPU-specific strategies map ICI topology: STRICT_PACK means
+"same ICI domain" per SURVEY.md §7.1; multi-host atomicity (the reference's
+2PC, placement_group_resource_manager.h:46-99) arrives with the multi-node
+control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.worker import ObjectRef
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class PlacementGroup:
+    id: str
+    bundles: list = field(default_factory=list)
+    strategy: str = "PACK"
+
+    def ready(self) -> ObjectRef:
+        """ObjectRef resolving when the reservation is committed. Creation
+        is synchronous on a single node, so this resolves immediately."""
+        return _worker.put(True)
+
+    @property
+    def bundle_specs(self):
+        return list(self.bundles)
+
+    def wait(self, timeout_seconds: float | None = None) -> bool:
+        return True
+
+
+def placement_group(bundles, strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; "
+                         f"one of {VALID_STRATEGIES}")
+    norm = []
+    for b in bundles:
+        if not isinstance(b, dict) or not b:
+            raise ValueError("each bundle must be a non-empty dict")
+        norm.append({k: float(v) for k, v in b.items()})
+    pg_id = _worker.get_client().control(
+        "create_pg", {"bundles": norm, "strategy": strategy, "name": name})
+    return PlacementGroup(pg_id, norm, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    _worker.get_client().control("remove_pg", pg.id)
+
+
+def get_current_placement_group() -> PlacementGroup | None:
+    return None

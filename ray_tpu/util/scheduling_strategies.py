@@ -1,0 +1,20 @@
+"""Scheduling strategies (reference: `python/ray/util/scheduling_strategies.py`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Schedule a task/actor inside a placement group reservation."""
+    placement_group: object
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node (single-node sessions: advisory only for now)."""
+    node_id: str
+    soft: bool = False

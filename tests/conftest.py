@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+SPMD tests run on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` — the counterpart of the
+reference's one-host multi-raylet ``Cluster`` fixture trick
+(`python/ray/cluster_utils.py:99`): fake resources let a laptop test
+multi-device logic (SURVEY.md §4.2).
+"""
+
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ray_session():
+    """One shared local session for all tests (worker spawn is ~2s on the
+    1-CPU CI box, so tests share a pool like the reference's
+    ray_start_regular fixture, conftest.py:410)."""
+    import ray_tpu
+    # num_tpus=2 fakes two chips (resources are scheduler numbers, like the
+    # reference's Cluster.add_node(num_gpus=8) on a laptop, SURVEY.md §4).
+    ray_tpu.init(num_cpus=4, num_tpus=2, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,145 @@
+"""Actor tests, modeled on the reference's `python/ray/tests/test_actor.py`:
+lifecycle, ordering, named actors, restarts, kill, concurrency."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.actor import wait_for_actor_ready
+from ray_tpu.exceptions import ActorDiedError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.x = start
+
+    def incr(self, n=1):
+        self.x += n
+        return self.x
+
+    def value(self):
+        return self.x
+
+    def crash(self):
+        import os
+        os._exit(1)
+
+
+def test_actor_basic(ray_session):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_method_ordering(ray_session):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_handle_passed_to_task(ray_session):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def bump(counter, n):
+        return ray_tpu.get(counter.incr.remote(n))
+
+    assert ray_tpu.get(bump.remote(c, 42)) == 42
+
+
+def test_named_actor(ray_session):
+    Counter.options(name="the-counter").remote(5)
+    h = ray_tpu.get_actor("the-counter")
+    assert ray_tpu.get(h.incr.remote()) == 6
+
+
+def test_get_actor_missing(ray_session):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no-such-actor")
+
+
+def test_duplicate_actor_name_rejected(ray_session):
+    Counter.options(name="dup-name").remote()
+    with pytest.raises(Exception):
+        h = Counter.options(name="dup-name").remote()
+        wait_for_actor_ready(h, timeout=30)
+
+
+def test_actor_constructor_error(ray_session):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor fails")
+
+        def ping(self):
+            return "pong"
+
+    b = Bad.remote()
+    with pytest.raises((RuntimeError, ActorDiedError)):
+        ray_tpu.get(b.ping.remote(), timeout=60)
+
+
+def test_actor_death_fails_pending(ray_session):
+    c = Counter.remote(0)
+    assert ray_tpu.get(c.incr.remote()) == 1
+    c.crash.remote()
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.value.remote(), timeout=60)
+
+
+def test_actor_restart(ray_session):
+    # max_task_retries stays 0 so the crashing call itself is NOT replayed
+    # on the restarted instance (replaying it would crash-loop, same as the
+    # reference).
+    c = Counter.options(max_restarts=2).remote(0)
+    assert ray_tpu.get(c.incr.remote()) == 1
+    c.crash.remote()
+    # After restart state resets to the constructor args (reference
+    # semantics: restarted actors rerun __init__).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(c.incr.remote(), timeout=30) >= 1
+            break
+        except ActorDiedError:
+            time.sleep(0.5)
+    else:
+        pytest.fail("actor never came back")
+
+
+def test_kill_actor(ray_session):
+    c = Counter.remote(0)
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.incr.remote(), timeout=60)
+
+
+def test_actor_max_concurrency(ray_session):
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.options(max_concurrency=4).remote()
+    t0 = time.time()
+    refs = [s.nap.remote(1.0) for _ in range(4)]
+    ray_tpu.get(refs, timeout=60)
+    # 4 overlapping 1s naps should take well under 4s.
+    assert time.time() - t0 < 3.5
+
+
+def test_method_num_returns(ray_session):
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "a", "b"
+
+    s = Splitter.remote()
+    a, b = s.pair.remote()
+    assert ray_tpu.get([a, b]) == ["a", "b"]

@@ -1,0 +1,231 @@
+"""Core task/object API tests.
+
+Modeled on the reference's `python/ray/tests/test_basic.py` /
+`test_advanced.py` coverage: put/get roundtrips, task graphs, error
+propagation, multiple returns, nested tasks, wait semantics.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskCancelledError
+
+
+def test_put_get_roundtrip(ray_session):
+    for value in [1, "x", None, {"a": [1, 2]}, (1, 2), b"bytes", 3.5,
+                  {1, 2, 3}]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_put_get_numpy_zero_copy(ray_session):
+    arr = np.arange(500_000, dtype=np.float64)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(arr, out)
+    # Large arrays come back as read-only views over shared memory,
+    # like the reference's plasma-backed arrays.
+    assert not out.flags.writeable
+
+
+def test_simple_task(ray_session):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_kwargs_and_defaults(ray_session):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_task_dependency_chain(ray_session):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 11
+
+
+def test_task_fanout_fanin(ray_session):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    refs = [sq.remote(i) for i in range(10)]
+    assert ray_tpu.get(total.remote(*refs)) == sum(i * i for i in range(10))
+
+
+def test_num_returns(ray_session):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_error_propagation_type_preserved(ray_session):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("missing")
+
+    with pytest.raises(KeyError):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_poisons_downstream(ray_session):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ValueError, match="root cause"):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_large_arg_promoted_to_store(ray_session):
+    payload = np.random.default_rng(0).standard_normal(300_000)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(np.sum(x))
+
+    assert ray_tpu.get(total.remote(payload)) == pytest.approx(
+        float(np.sum(payload)))
+
+
+def test_nested_task_submission(ray_session):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 100
+
+    assert ray_tpu.get(parent.remote(1)) == 102
+
+
+def test_get_timeout(ray_session):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait_basic(ray_session):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(3)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=2)
+    assert ready == [f] and not_ready == [s]
+
+
+def test_wait_rejects_duplicates(ray_session):
+    r = ray_tpu.put(1)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([r, r])
+
+
+def test_max_retries_on_crash(ray_session):
+    import os as _os
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(marker_dir):
+        # die the first time, succeed on retry (crash, not exception)
+        import os
+        marker = os.path.join(marker_dir, "attempted")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        assert ray_tpu.get(flaky.remote(d), timeout=60) == "recovered"
+
+
+def test_retry_exceptions(ray_session):
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def sometimes(marker_dir):
+        import os
+        marker = os.path.join(marker_dir, "n")
+        n = len(os.listdir(marker_dir))
+        open(marker + str(n), "w").close()
+        if n < 2:
+            raise RuntimeError("transient")
+        return n
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        assert ray_tpu.get(sometimes.remote(d), timeout=60) == 2
+
+
+def test_cancel_pending(ray_session):
+    @ray_tpu.remote
+    def blocked(x):
+        return x
+
+    dep = ray_tpu.ObjectRef("obj_never_materializes")
+    ref = blocked.remote(dep)
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_cluster_resources(ray_session):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+
+
+def test_tpu_task_gets_chips(ray_session):
+    @ray_tpu.remote(num_tpus=1)
+    def which_chips():
+        import os
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    chips = ray_tpu.get(which_chips.remote(), timeout=120)
+    assert chips is not None and chips != ""
+    # chip + TPU resource return to the pool afterwards
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("TPU") == 2.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources()["TPU"] == 2.0
+
+
+def test_object_ref_future(ray_session):
+    @ray_tpu.remote
+    def v():
+        return 7
+
+    assert v.remote().future().result(timeout=30) == 7

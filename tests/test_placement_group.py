@@ -1,0 +1,41 @@
+"""Placement group reservation tests (reference:
+`python/ray/tests/test_placement_group.py`)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import PlacementGroupError
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_reserves_resources(ray_session):
+    before = ray_tpu.available_resources()["CPU"]
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+    assert ray_tpu.available_resources()["CPU"] == before - 2
+    remove_placement_group(pg)
+    assert ray_tpu.available_resources()["CPU"] == before
+
+
+def test_pg_infeasible(ray_session):
+    with pytest.raises(PlacementGroupError):
+        placement_group([{"CPU": 1000}])
+
+
+def test_task_in_pg(ray_session):
+    pg = placement_group([{"CPU": 2}])
+
+    @ray_tpu.remote
+    def where():
+        return "in-pg"
+
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+    ref = where.options(num_cpus=1, scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(ref, timeout=60) == "in-pg"
+    remove_placement_group(pg)
+
+
+def test_pg_ready(ray_session):
+    pg = placement_group([{"CPU": 1}])
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    remove_placement_group(pg)
