@@ -626,15 +626,22 @@ class NodeServer:
                self._address, worker_id]
         env = dict(env)
         env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
-        # The driver may import ray_tpu off sys.path (uninstalled checkout);
-        # children must find the same package (reference: workers inherit the
-        # driver's load path via the worker command line, services.py).
+        # Workers must resolve the same modules as the driver: cloudpickle
+        # serializes module-level functions by reference, so the driver's
+        # full sys.path (which includes the uninstalled checkout and the
+        # user's script dir) is propagated (reference: workers inherit the
+        # driver's load path / working_dir runtime env, services.py).
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
+        entries = [pkg_root] + [p for p in sys.path if p]
         pypath = env.get("PYTHONPATH", "")
-        if pkg_root not in pypath.split(os.pathsep):
-            env["PYTHONPATH"] = (pkg_root + os.pathsep + pypath) if pypath \
-                else pkg_root
+        entries += [p for p in pypath.split(os.pathsep) if p]
+        seen, uniq = set(), []
+        for p in entries:
+            if p not in seen:
+                seen.add(p)
+                uniq.append(p)
+        env["PYTHONPATH"] = os.pathsep.join(uniq)
         return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
 
     def _spawn_generic_worker(self):

@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pipe`` axis.
+
+Absent from the reference (SURVEY.md §2.4 — no pipeline parallelism
+anywhere); TPU-native version expresses stages as a sharded leading
+dimension and moves activations between neighboring mesh positions with
+`jax.lax.ppermute`, so the schedule compiles to ICI neighbor transfers that
+overlap with stage compute.
+
+Schedule: M microbatches through S stages takes M + S - 1 ticks; every
+device runs the stage function every tick (bubbles compute on garbage and
+are masked out), which keeps the program SPMD — the XLA-friendly tradeoff.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack a list of per-stage pytrees into one pytree with a leading
+    stage axis (shard it over ``pipe``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _pipe_loop(stacked_params, x_micro, stage_fn, axis_name):
+    """Inside shard_map. stacked_params leaves: [1, ...] (this stage's
+    slice); x_micro: [M, mb, ...] microbatches (replicated)."""
+    params = jax.tree.map(lambda p: p[0], stacked_params)
+    s_count = lax.psum(1, axis_name)
+    s = lax.axis_index(axis_name)
+    m = x_micro.shape[0]
+    perm = [(i, i + 1) for i in range(s_count - 1)]  # forward, no wrap
+
+    out_buf = jnp.zeros(
+        (m,) + jax.eval_shape(stage_fn, params, x_micro[0]).shape,
+        x_micro.dtype)
+    act0 = jnp.zeros_like(x_micro[0])
+
+    def tick(carry, t):
+        act_in, out_buf = carry
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(s == 0, x_micro[mb_idx], act_in)
+        act_out = stage_fn(params, inp)
+        out_idx = jnp.clip(t - (s_count - 1), 0, m - 1)
+        is_out = jnp.logical_and(t >= s_count - 1, s == s_count - 1)
+        out_buf = jnp.where(
+            is_out, out_buf.at[out_idx].set(act_out), out_buf)
+        act_next = lax.ppermute(act_out, axis_name, perm)
+        return (act_next, out_buf), None
+
+    (_, out_buf), _ = lax.scan(tick, (act0, out_buf),
+                               jnp.arange(m + s_count - 1))
+    # Only the last stage holds real outputs; psum broadcasts them (other
+    # stages contribute zeros).
+    return lax.psum(out_buf, axis_name)
+
+
+def pipeline_apply(stage_fn, per_stage_params: list, x, *,
+                   mesh: Mesh, num_microbatches: int,
+                   axis_name: str = "pipe"):
+    """Run `x` through S pipeline stages of `stage_fn`.
+
+    stage_fn(params, microbatch) -> microbatch-shaped output; every stage
+    must be shape-preserving in v1 (transformer blocks are).
+    """
+    s_count = mesh.shape.get(axis_name, 1)
+    if len(per_stage_params) != max(s_count, 1):
+        raise ValueError(
+            f"{len(per_stage_params)} stages vs mesh {axis_name}="
+            f"{s_count}")
+    if x.shape[0] % num_microbatches:
+        raise ValueError("batch not divisible by num_microbatches")
+    if s_count == 1:
+        out = x
+        for p in per_stage_params:
+            out = stage_fn(p, out)
+        return out
+
+    stacked = stack_stage_params(per_stage_params)
+    x_micro = x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                        + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked)
+    fn = jax.shard_map(
+        functools.partial(_pipe_loop, stage_fn=stage_fn,
+                          axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    out_micro = fn(stacked, x_micro)
+    return out_micro.reshape(x.shape[:1] + out_micro.shape[2:])

@@ -10,11 +10,17 @@ multi-device logic (SURVEY.md §4.2).
 import os
 
 # Must happen before any jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the TPU PJRT plugin and overrides the
+# platform even when JAX_PLATFORMS=cpu is in the env; the config knob wins.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

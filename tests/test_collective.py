@@ -1,0 +1,46 @@
+"""Host-side collective group tests (reference:
+`python/ray/util/collective/tests/`)."""
+
+import numpy as np
+
+import ray_tpu
+
+
+def _rank_fn(rank, world):
+    from ray_tpu.util import collective as col
+    col.init_collective_group(world, rank, group_name="g1")
+    out = col.allreduce(np.full(4, rank + 1.0), group_name="g1")
+    gathered = col.allgather(np.array([rank]), group_name="g1")
+    bcast = col.broadcast(np.array([rank * 10.0]), src_rank=2,
+                          group_name="g1")
+    return out, [int(g[0]) for g in gathered], float(bcast[0])
+
+
+def test_collective_allreduce_allgather_broadcast(ray_session):
+    world = 3
+    fn = ray_tpu.remote(_rank_fn)
+    refs = [fn.remote(r, world) for r in range(world)]
+    results = ray_tpu.get(refs, timeout=180)
+    expect_sum = sum(r + 1.0 for r in range(world))
+    for out, gathered, bcast in results:
+        np.testing.assert_allclose(out, np.full(4, expect_sum))
+        assert gathered == [0, 1, 2]
+        assert bcast == 20.0
+
+
+def test_collective_send_recv(ray_session):
+    def sender():
+        from ray_tpu.util import collective as col
+        g = col.init_collective_group(2, 0, group_name="p2p")
+        g.send(np.array([7.0]), dst=1)
+        return True
+
+    def receiver():
+        from ray_tpu.util import collective as col
+        g = col.init_collective_group(2, 1, group_name="p2p")
+        return float(g.recv(src=0)[0])
+
+    s = ray_tpu.remote(sender).remote()
+    r = ray_tpu.remote(receiver).remote()
+    assert ray_tpu.get(r, timeout=120) == 7.0
+    assert ray_tpu.get(s, timeout=120)
