@@ -536,7 +536,8 @@ class NodeServer:
         with self.lock:
             self.workers[worker_id] = w
         w.proc = self._spawn_proc(
-            worker_id, self._worker_env(chips=t.tpu_chips))
+            worker_id, self._worker_env(chips=t.tpu_chips,
+                                        runtime_env=t.spec.runtime_env))
         if not self._await_registration(w):
             with self.lock:
                 self._release_task_resources(t)
@@ -605,7 +606,7 @@ class NodeServer:
     # worker processes
     # ------------------------------------------------------------------
 
-    def _worker_env(self, chips=None):
+    def _worker_env(self, chips=None, runtime_env=None):
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
         if chips:
@@ -615,8 +616,16 @@ class NodeServer:
             # Workers must not grab the host's TPU runtime by default: only
             # tasks that requested TPU resources see chips (the reference
             # hides GPUs the same way via CUDA_VISIBLE_DEVICES="").
+            # RAY_TPU_WORKER_FORCE_CPU drives worker_site/sitecustomize.py,
+            # which blocks accelerator plugin registration pre-jax-import.
             env["JAX_PLATFORMS"] = env.get("RAY_TPU_WORKER_JAX_PLATFORMS",
                                            "cpu")
+            if env["JAX_PLATFORMS"] == "cpu":
+                env["RAY_TPU_WORKER_FORCE_CPU"] = "1"
+        # Per-task/actor env overrides (reference: runtime_env env_vars,
+        # _private/runtime_env/).
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[str(k)] = str(v)
         return env
 
     def _spawn_proc(self, worker_id, env):
@@ -633,7 +642,9 @@ class NodeServer:
         # driver's load path / working_dir runtime env, services.py).
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        entries = [pkg_root] + [p for p in sys.path if p]
+        worker_site = os.path.join(pkg_root, "ray_tpu", "_private",
+                                   "worker_site")
+        entries = [worker_site, pkg_root] + [p for p in sys.path if p]
         pypath = env.get("PYTHONPATH", "")
         entries += [p for p in pypath.split(os.pathsep) if p]
         seen, uniq = set(), []
@@ -682,7 +693,8 @@ class NodeServer:
         with self.lock:
             self.workers[worker_id] = w
         w.proc = self._spawn_proc(
-            worker_id, self._worker_env(chips=a.tpu_chips))
+            worker_id, self._worker_env(chips=a.tpu_chips,
+                                        runtime_env=a.creation_spec.runtime_env))
         if not self._await_registration(w):
             self._fail_actor(a, "actor worker failed to start")
             return

@@ -1,0 +1,44 @@
+"""Worker-process site hook.
+
+This directory is prepended to every worker's PYTHONPATH so this module
+shadows any platform sitecustomize (e.g. the TPU image's PJRT registration
+hook, which force-sets jax_platforms and would make CPU-only pool workers
+grab — or hang on — the TPU runtime).
+
+- CPU workers (RAY_TPU_WORKER_FORCE_CPU=1): skip platform registration
+  entirely; JAX honors JAX_PLATFORMS=cpu.
+- TPU workers: chain-exec the next sitecustomize.py found on sys.path so
+  the accelerator plugin registers exactly as it would in the driver.
+
+This is the counterpart of the reference hiding GPUs from non-GPU workers
+via CUDA_VISIBLE_DEVICES="" (_private/utils.py:342-355) — but on TPU the
+runtime is process-exclusive, so exclusion must happen before any jax
+import, hence a site hook rather than an env var alone.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+
+if os.environ.get("RAY_TPU_WORKER_FORCE_CPU") == "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Belt and braces: neutralize common accelerator-registration triggers
+    # for any grandchild processes too.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+else:
+    for _p in list(sys.path):
+        if not _p:
+            continue
+        try:
+            if os.path.abspath(_p) == _here:
+                continue
+        except OSError:
+            continue
+        _cand = os.path.join(_p, "sitecustomize.py")
+        if os.path.exists(_cand):
+            with open(_cand) as _f:
+                _code = _f.read()
+            exec(compile(_code, _cand, "exec"),
+                 {"__name__": "sitecustomize", "__file__": _cand})
+            break

@@ -1,0 +1,205 @@
+"""Flagship model: GPT-style decoder-only transformer, TPU-first.
+
+Design choices that matter on TPU:
+
+- **bfloat16 activations, float32 params/optimizer** — MXU-native compute
+  with stable accumulation (einsums accumulate in f32 via
+  ``preferred_element_type``).
+- **One stacked layer pytree + ``lax.scan``** over layers: compile time is
+  O(1) in depth and XLA pipelines the loop body.
+- **Logical sharding axes on every parameter** (`ray_tpu.parallel.sharding`
+  vocabulary): the same definition runs 1-chip, DP, FSDP, TP (megatron
+  column/row split), and SP (ring attention over the ``seq`` axis) purely by
+  changing the MeshSpec.
+- **`jax.checkpoint` on the block** to trade FLOPs for HBM.
+
+The reference has no model zoo of its own (models live in user code /
+RLlib's catalog, `rllib/models/catalog.py`); this model is the framework's
+train/serve/bench workhorse, counterpart of the reference release
+benchmarks' ResNet/GPT-2 workloads (`release/air_tests/air_benchmarks/`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.ring_attention import reference_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304        # multiple of 128 for MXU-friendly vocab
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    dtype: str = "bfloat16"
+    remat: bool = True
+    attn_impl: str = "auto"        # auto | ring | flash | xla
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def small(**kw) -> GPTConfig:
+    return GPTConfig(**{**dict(vocab_size=512, d_model=128, n_layers=2,
+                               n_heads=4, d_ff=512, max_seq_len=128), **kw})
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+def param_logical_axes(cfg: GPTConfig):
+    """Pytree of logical-axis tuples, mirroring init_params' structure.
+    Leading layer-stack axis is unsharded (None)."""
+    layer = {
+        "ln1_scale": (None, "embed"),
+        "ln2_scale": (None, "embed"),
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "heads"),
+        "wv": (None, "embed", "heads"),
+        "wo": (None, "heads", "embed"),
+        "w_up": (None, "embed", "mlp"),
+        "w_gate": (None, "embed", "mlp"),
+        "w_down": (None, "mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "final_ln_scale": ("embed",),
+        "layers": layer,
+    }
+
+
+def init_params(rng, cfg: GPTConfig):
+    """float32 master params; cast to cfg.dtype at use sites."""
+    k_emb, k_pos, k_layers = jax.random.split(rng, 3)
+    d, h, f, L = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in)))
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "ln1_scale": jnp.ones((L, d), jnp.float32),
+        "ln2_scale": jnp.ones((L, d), jnp.float32),
+        "wq": norm(ks[0], (L, d, h), d),
+        "wk": norm(ks[1], (L, d, h), d),
+        "wv": norm(ks[2], (L, d, h), d),
+        "wo": norm(ks[3], (L, h, d), h) / np.sqrt(2 * L),
+        "w_up": norm(ks[4], (L, d, f), d),
+        "w_gate": norm(ks[5], (L, d, f), d),
+        "w_down": norm(ks[6], (L, f, d), f) / np.sqrt(2 * L),
+    }
+    return {
+        "embed": norm(k_emb, (cfg.vocab_size, d), 1.0) * 0.02,
+        "pos_embed": norm(k_pos, (cfg.max_seq_len, d), 1.0) * 0.01,
+        "final_ln_scale": jnp.ones((d,), jnp.float32),
+        "layers": layers,
+    }
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _attention(q, k, v, cfg: GPTConfig, mesh: Mesh | None):
+    impl = cfg.attn_impl
+    if impl == "auto":
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            impl = "ring"
+        else:
+            impl = "flash"
+    if impl == "ring":
+        return ring_attention(q, k, v, mesh, causal=True)
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
+    """One transformer block. x: [B, T, D] activations in cfg.dtype;
+    lp: this layer's param slice (f32, cast here)."""
+    adt = cfg.activation_dtype()
+    b, t, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+
+    h = _rms_norm(x, lp["ln1_scale"].astype(adt))
+    q = jnp.einsum("btd,dh->bth", h, lp["wq"].astype(adt),
+                   preferred_element_type=jnp.float32).astype(adt)
+    k = jnp.einsum("btd,dh->bth", h, lp["wk"].astype(adt),
+                   preferred_element_type=jnp.float32).astype(adt)
+    v = jnp.einsum("btd,dh->bth", h, lp["wv"].astype(adt),
+                   preferred_element_type=jnp.float32).astype(adt)
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nh, hd)
+    v = v.reshape(b, t, nh, hd)
+    att = _attention(q, k, v, cfg, mesh).reshape(b, t, nh * hd)
+    att = jnp.einsum("bth,hd->btd", att, lp["wo"].astype(adt),
+                     preferred_element_type=jnp.float32).astype(adt)
+    x = x + att
+
+    h = _rms_norm(x, lp["ln2_scale"].astype(adt))
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(adt),
+                    preferred_element_type=jnp.float32).astype(adt)
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(adt),
+                      preferred_element_type=jnp.float32).astype(adt)
+    ff = jax.nn.silu(gate) * up
+    down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(adt),
+                      preferred_element_type=jnp.float32).astype(adt)
+    return x + down
+
+
+def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+    adt = cfg.activation_dtype()
+    t = tokens.shape[1]
+    x = params["embed"].astype(adt)[tokens]
+    x = x + params["pos_embed"].astype(adt)[:t][None]
+
+    block = partial(_block, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, lp):
+        return block(x, lp), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def loss_fn(params, batch, cfg: GPTConfig, mesh: Mesh | None = None):
+    """Next-token cross entropy. batch: {"tokens": [B, T]} — token t
+    predicts token t+1."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -1,0 +1,113 @@
+"""ResNet in flax.linen — benchmark workhorse.
+
+Counterpart workload of the reference's MLPerf-style ResNet-50 Train
+benchmark (`release/air_tests/air_benchmarks/mlperf-train/
+resnet50_ray_air.py:199-201`) and the BASELINE.md milestone config
+"ResNet-18 CIFAR-10 (2 workers, DP, CPU-runnable)". Written TPU-first:
+NHWC layout (TPU conv-native), bfloat16 compute / float32 params & BN
+statistics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        dt = jnp.dtype(self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dt,
+                       param_dtype=jnp.float32)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, dtype=dt, param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = bn()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = bn()(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        dt = jnp.dtype(self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dt,
+                       param_dtype=jnp.float32)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, dtype=dt, param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = bn()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: type = ResNetBlock
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: str = "bfloat16"
+    small_inputs: bool = True   # CIFAR stem (3x3, no maxpool)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        dt = jnp.dtype(self.dtype)
+        x = x.astype(dt)
+        if self.small_inputs:
+            x = nn.Conv(self.num_filters, (3, 3), use_bias=False, dtype=dt,
+                        param_dtype=jnp.float32)(x)
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), (2, 2), use_bias=False,
+                        dtype=dt, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=dt, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i,
+                                   strides=strides, dtype=self.dtype)(
+                                       x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x
+
+
+def resnet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock,
+                  num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, small_inputs=False, **kw)

@@ -1,0 +1,159 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference has no custom kernels of its own (its GPU fast paths live in
+torch/NCCL); on TPU the memory-bound op worth hand-scheduling is attention:
+O(T^2) scores never touch HBM — K/V blocks stream through VMEM while
+per-row running softmax statistics live in VMEM scratch across the
+sequential kv grid dimension.
+
+Layout: [B, T, H, D] public API (matching `ray_tpu.parallel.ring_attention`
+so models switch impls freely). Internally [B*H, T, D], grid
+(BH, T/block_q, T/block_kv) with the kv dimension innermost/sequential and
+batch/query dimensions parallel.
+
+Backward pass: `jax.custom_vjp` recomputes attention with the O(T^2) XLA
+path (flash backward kernel is a later milestone); forward-dominated
+workloads (inference, serving) get the full win now.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.parallel.ring_attention import reference_attention
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool,
+                  block_q: int, block_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_kv
+    # Under causal masking, kv blocks strictly above the diagonal band
+    # contribute nothing; predicate the whole body away.
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)           # [bq, D]
+        k = k_ref[0].astype(jnp.float32)           # [bkv, D]
+        s = jax.lax.dot_general(
+            q * sm_scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bkv]
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [bq, bkv]
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=1,
+                                                     keepdims=True)
+        m_scr[:, :1] = m_new
+        v = v_ref[0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, D]
+        acc_scr[:] = acc_scr[:] * corr + pv
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_bhtd(q, k, v, *, sm_scale: float, causal: bool, block_q: int,
+                block_kv: int, interpret: bool):
+    """q,k,v: [BH, T, D] with T divisible by both block sizes."""
+    bh, t, d = q.shape
+    grid = (bh, t // block_q, t // block_kv)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # m (col 0 used)
+            pltpu.VMEM((block_q, 128), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _supported(t: int, block_q: int, block_kv: int) -> bool:
+    return t % block_q == 0 and t % block_kv == 0 and t >= block_q
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_kv: int = 128):
+    """[B, T, H, D] attention; falls back to the XLA path off-TPU-unfriendly
+    shapes. Differentiable (backward = recomputed XLA attention)."""
+    return _flash_forward_impl(q, k, v, causal, block_q, block_kv)
+
+
+def _flash_forward_impl(q, k, v, causal, block_q, block_kv):
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_kv = min(block_kv, t)
+    if not _supported(t, block_q, block_kv):
+        return reference_attention(q, k, v, causal=causal)
+    interpret = jax.default_backend() != "tpu"
+    # Pad head_dim to the 128-lane tile; zero columns change nothing
+    # (scores: zero contributions; output: sliced off).
+    d_pad = max(128 if d < 128 else d, d)
+    if d_pad != d:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad - d)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
+    bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d_pad)
+    out = _flash_bhtd(bhtd(q), bhtd(k), bhtd(v), sm_scale=d ** -0.5,
+                      causal=causal, block_q=block_q, block_kv=block_kv,
+                      interpret=interpret)
+    out = out.reshape(b, h, t, d_pad).transpose(0, 2, 1, 3)
+    return out[..., :d]
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv):
+    return _flash_forward_impl(q, k, v, causal, block_q, block_kv), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_kv, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

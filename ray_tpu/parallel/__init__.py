@@ -15,8 +15,10 @@ from ray_tpu.parallel.mesh import (
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
+    global_from_local,
     logical_to_spec,
     named_sharding,
+    replicate_tree,
     replicated,
     shard_batch,
     tree_shardings,
@@ -26,8 +28,9 @@ from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "AXIS_ORDER", "BATCH_AXES", "MeshSpec", "dp_mesh", "single_device_mesh",
-    "DEFAULT_RULES", "constrain", "logical_to_spec", "named_sharding",
-    "replicated", "shard_batch", "tree_shardings",
+    "DEFAULT_RULES", "constrain", "global_from_local", "logical_to_spec",
+    "named_sharding", "replicate_tree", "replicated", "shard_batch",
+    "tree_shardings",
     "reference_attention", "ring_attention",
     "pipeline_apply", "stack_stage_params",
 ]

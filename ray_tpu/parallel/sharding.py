@@ -102,3 +102,28 @@ def shard_batch(batch, mesh: Mesh):
 
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, PartitionSpec())
+
+
+def global_from_local(mesh: Mesh, local_batch, rules: dict | None = None):
+    """Build a global batch-sharded array from each process's local shard —
+    the multi-host ingest path (each host feeds its own data; the global
+    array spans all processes). Works single-process too, so train loops
+    don't branch on world size."""
+    spec = logical_to_spec(("batch",), rules, mesh)
+
+    def place(arr):
+        full_spec = PartitionSpec(*(list(spec) + [None] * (arr.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, full_spec), arr)
+    return jax.tree.map(place, local_batch)
+
+
+def replicate_tree(mesh: Mesh, tree):
+    """Replicate host values onto every device of a (possibly multi-host)
+    mesh."""
+    import numpy as np
+
+    def place(arr):
+        return jax.make_array_from_process_local_data(
+            replicated(mesh), np.asarray(arr))
+    return jax.tree.map(place, tree)

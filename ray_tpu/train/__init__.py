@@ -1,0 +1,32 @@
+"""ray_tpu.train — distributed training (Train-equivalent).
+
+Reference surface covered (SURVEY.md §2.5): trainer + config dataclasses +
+session API + checkpointing; the torch/NCCL backend seam
+(`train/torch/config.py:113`) is replaced by `jax.distributed.initialize`
++ mesh SPMD.
+"""
+
+from ray_tpu.train import session
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
+
+# Session facade re-exports (reference: ray.air.session / ray.train.*)
+report = session.report
+get_checkpoint = session.get_checkpoint
+get_dataset_shard = session.get_dataset_shard
+get_world_size = session.get_world_size
+get_world_rank = session.get_world_rank
+get_mesh_spec = session.get_mesh_spec
+
+__all__ = [
+    "JaxTrainer", "Result", "TrainingFailedError", "Checkpoint",
+    "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
+    "session", "report", "get_checkpoint", "get_dataset_shard",
+    "get_world_size", "get_world_rank", "get_mesh_spec",
+]

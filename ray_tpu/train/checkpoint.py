@@ -1,0 +1,96 @@
+"""Checkpoint artifact.
+
+Counterpart of the reference's `air/checkpoint.py:66` (`Checkpoint` —
+interconvertible dict / directory / URI :449-735) and
+`train/torch/torch_checkpoint.py`. TPU-native storage: pytrees of jax/numpy
+arrays are written with orbax (`PyTreeCheckpointer`), everything else with
+pickle, so sharded params round-trip losslessly and restore can reshard
+onto a different mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+
+import jax
+import numpy as np
+
+_ORBAX_SUBDIR = "pytree"
+_PICKLE_FILE = "data.pkl"
+_counter_lock = threading.Lock()
+_counter = 0
+
+
+def _next_tmpdir() -> str:
+    global _counter
+    with _counter_lock:
+        _counter += 1
+        n = _counter
+    d = os.path.join(tempfile.gettempdir(),
+                     f"ray_tpu_ckpt_{os.getpid()}_{n}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _is_array_tree(value) -> bool:
+    leaves = jax.tree.leaves(value)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+
+class Checkpoint:
+    """A directory-backed checkpoint. Construct with `from_dict` /
+    `from_directory`; read with `to_dict` / `to_directory` / `as_directory`.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        d = _next_tmpdir()
+        arrays = {k: v for k, v in data.items() if _is_array_tree(v)}
+        rest = {k: v for k, v in data.items() if k not in arrays}
+        if arrays:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            host_arrays = jax.tree.map(np.asarray, arrays)
+            ckptr.save(os.path.join(d, _ORBAX_SUBDIR), host_arrays)
+        with open(os.path.join(d, _PICKLE_FILE), "wb") as f:
+            pickle.dump(rest, f, protocol=5)
+        return cls(d)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    # -- accessors ----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        out = {}
+        orbax_path = os.path.join(self.path, _ORBAX_SUBDIR)
+        if os.path.isdir(orbax_path):
+            import orbax.checkpoint as ocp
+            out.update(ocp.PyTreeCheckpointer().restore(orbax_path))
+        pkl = os.path.join(self.path, _PICKLE_FILE)
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                out.update(pickle.load(f))
+        return out
+
+    def to_directory(self, path: str) -> str:
+        if os.path.abspath(path) != os.path.abspath(self.path):
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"

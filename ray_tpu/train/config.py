@@ -1,0 +1,64 @@
+"""Train configuration dataclasses.
+
+Counterparts of the reference's `air/config.py` (ScalingConfig :91,
+RunConfig :704, FailureConfig :523, CheckpointConfig :574) with TPU-native
+extensions: `ScalingConfig.mesh` carries the full parallelism layout
+(MeshSpec) instead of just a worker count, because on TPU the partitioning
+IS the configuration.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    """How many worker processes and what each one sees.
+
+    num_workers: processes (one per TPU host in multi-host deployments;
+    the reference's worker == one GPU, ours == one host of chips, because
+    JAX is SPMD per process).
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict = field(default_factory=dict)
+    placement_strategy: str = "STRICT_PACK"
+    # TPU-native: logical mesh over ALL workers' devices; None = pure DP.
+    mesh: object | None = None      # ray_tpu.parallel.MeshSpec
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker)
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None          # None = keep all
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0                   # -1 = unlimited restarts
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

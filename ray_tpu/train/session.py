@@ -1,0 +1,96 @@
+"""Worker-side training session.
+
+Counterpart of the reference's `train/_internal/session.py` (report :426 —
+user loop in a thread, results handed to the actor's main thread through a
+bounded queue + semaphore, :141-149) and the `air/session.py` facade
+(report :42, get_checkpoint :96, get_dataset_shard :358).
+
+Same concurrency shape here: `train_loop_per_worker` runs in a daemon
+thread inside the TrainWorker actor; `report()` blocks the loop until the
+driver has consumed the result (lockstep reporting, so iteration counts
+align across workers).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+_local = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_size: int
+    world_rank: int
+    local_rank: int
+    node_rank: int
+    trial_name: str
+    checkpoint: object | None          # ray_tpu.train.Checkpoint | None
+    dataset_shards: dict
+    result_queue: queue.Queue          # size 1: lockstep with the driver
+    consumed: threading.Semaphore
+    stop_event: threading.Event
+    mesh_spec: object | None = None
+
+
+def _ctx() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "ray_tpu.train.session functions may only be called inside "
+            "train_loop_per_worker")
+    return ctx
+
+
+def _install(ctx: TrainContext):
+    _local.ctx = ctx
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """Hand metrics (and optionally a checkpoint) to the trainer. Blocks
+    until the driver consumed the previous report (reference: semaphore in
+    session.py:288) so all workers step in lockstep."""
+    ctx = _ctx()
+    if ctx.stop_event.is_set():
+        raise SystemExit(0)   # driver asked the loop to wind down
+    ctx.result_queue.put({"metrics": dict(metrics),
+                          "checkpoint": checkpoint})
+    ctx.consumed.acquire()
+
+
+def get_checkpoint():
+    """The checkpoint to resume from, if the trainer restored one."""
+    return _ctx().checkpoint
+
+
+def get_world_size() -> int:
+    return _ctx().world_size
+
+
+def get_world_rank() -> int:
+    return _ctx().world_rank
+
+
+def get_local_rank() -> int:
+    return _ctx().local_rank
+
+
+def get_node_rank() -> int:
+    return _ctx().node_rank
+
+
+def get_trial_name() -> str:
+    return _ctx().trial_name
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer (reference:
+    session.get_dataset_shard backed by Data streaming_split)."""
+    return _ctx().dataset_shards.get(name)
+
+
+def get_mesh_spec():
+    """The ScalingConfig's MeshSpec (TPU-native extension)."""
+    return _ctx().mesh_spec

@@ -1,0 +1,140 @@
+"""Train worker actors.
+
+Counterpart of the reference's `train/_internal/worker_group.py:100`
+(WorkerGroup of plain `ray.remote` actors) + `backend_executor.py:45`
+(start :104, start_training :342) + the torch rendezvous
+(`train/torch/config.py:70-121`) — whose TPU-native replacement is
+`jax.distributed.initialize(coordinator, num_processes, process_id)`
+followed by mesh construction (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+
+
+class TrainWorker:
+    """Actor hosting one training process. The user loop runs in a daemon
+    thread; the actor thread serves `next_result` (reference pattern:
+    session.py:81)."""
+
+    def __init__(self, rank: int, world_size: int, trial_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.trial_name = trial_name
+        self.thread: threading.Thread | None = None
+        self.ctx: session_mod.TrainContext | None = None
+        self.error: str | None = None
+        self.finished = False
+
+    def setup_distributed(self, coordinator: str, num_processes: int,
+                          process_id: int):
+        """TPU-native rendezvous (replaces dist.init_process_group)."""
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+        return jax.device_count()
+
+    def device_info(self):
+        import jax
+        return {"backend": jax.default_backend(),
+                "local": jax.local_device_count(),
+                "global": jax.device_count()}
+
+    def start_training(self, train_loop, config: dict,
+                       checkpoint=None, dataset_shards: dict | None = None,
+                       mesh_spec=None):
+        self.ctx = session_mod.TrainContext(
+            world_size=self.world_size,
+            world_rank=self.rank,
+            local_rank=0,
+            node_rank=self.rank,
+            trial_name=self.trial_name,
+            checkpoint=checkpoint,
+            dataset_shards=dataset_shards or {},
+            result_queue=queue.Queue(maxsize=1),
+            consumed=threading.Semaphore(0),
+            stop_event=threading.Event(),
+        )
+        self.ctx.mesh_spec = mesh_spec
+
+        import inspect
+        try:
+            takes_config = bool(
+                inspect.signature(train_loop).parameters)
+        except (TypeError, ValueError):
+            takes_config = True
+
+        def run():
+            session_mod._install(self.ctx)
+            try:
+                if takes_config:
+                    train_loop(config)
+                else:
+                    train_loop()
+                self.finished = True
+            except SystemExit:
+                self.finished = True
+            except BaseException:
+                self.error = traceback.format_exc()
+            finally:
+                # Sentinel unblocks the driver's pending next_result.
+                self.ctx.result_queue.put(None)
+
+        self.thread = threading.Thread(target=run, daemon=True,
+                                       name="train-loop")
+        self.thread.start()
+        return True
+
+    def next_result(self):
+        """Blocks until the train loop reports, finishes, or errors.
+        Returns {"metrics":..., "checkpoint":...} | {"done": True} |
+        {"error": traceback_str}."""
+        item = self.ctx.result_queue.get()
+        if item is None:
+            if self.error:
+                return {"error": self.error}
+            return {"done": True}
+        # Let the loop proceed with its next step while the driver digests
+        # this one (bounded pipelining, queue size 1).
+        self.ctx.consumed.release()
+        return item
+
+    def shutdown_loop(self):
+        if self.ctx is not None:
+            self.ctx.stop_event.set()
+            self.ctx.consumed.release()
+        return True
+
+
+def make_worker_group(num_workers: int, resources: dict, trial_name: str,
+                      placement_group=None, env_vars: dict | None = None):
+    """Spawn the actor group (one placement-group bundle per worker)."""
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+    opts = dict(resources or {})
+    num_cpus = opts.pop("CPU", 1.0)
+    num_tpus = opts.pop("TPU", 0.0)
+    cls = ray_tpu.remote(TrainWorker)
+    workers = []
+    for rank in range(num_workers):
+        o = dict(num_cpus=num_cpus, resources=opts,
+                 runtime_env={"env_vars": dict(env_vars or {})})
+        if num_tpus:
+            o["num_tpus"] = num_tpus
+        if placement_group is not None:
+            o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=placement_group,
+                placement_group_bundle_index=rank)
+        workers.append(cls.options(**o).remote(
+            rank, num_workers, trial_name))
+    return workers
