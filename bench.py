@@ -1,0 +1,93 @@
+"""Flagship benchmark: GPT train-step throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no committed throughput numbers (BASELINE.md —
+"harness only"); its north star is "ResNet-50 / GPT wall-clock at >= NCCL
+DDP parity". DDP-over-NCCL training of dense transformers lands at ~40% MFU
+on A100-class setups, so `vs_baseline` reports measured MFU / 0.40: >= 1.0
+means the TPU path beats the reference's realistic efficiency envelope.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+# bf16 peak FLOPs per chip by device kind (jax device_kind substrings).
+_PEAK_FLOPS = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),   # v5 litepod
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+_BASELINE_MFU = 0.40
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS:
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import spmd
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = gpt.GPTConfig(vocab_size=50304, d_model=1024, n_layers=12,
+                            n_heads=16, d_ff=4096, max_seq_len=1024,
+                            attn_impl="flash")
+        batch_size, steps, warmup = 8, 20, 3
+    else:   # CPU smoke mode so the benchmark is runnable anywhere
+        cfg = gpt.small()
+        batch_size, steps, warmup = 4, 5, 1
+
+    devices = jax.devices()
+    mesh = MeshSpec(data=-1).build(devices)
+    state, step_fn, shard_tokens = spmd.make_gpt_trainer(cfg, mesh)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          (batch_size, cfg.max_seq_len + 1), np.int32)
+    batch = shard_tokens({"inputs": tokens[:, :-1].copy(),
+                          "targets": tokens[:, 1:].copy()})
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    # device_get (not just block_until_ready) so remote-tunnel backends
+    # can't report completion before execution finishes.
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * cfg.max_seq_len
+    tok_s = tokens_per_step * steps / dt
+    flops_tok = spmd.train_flops_per_token(cfg, cfg.max_seq_len)
+    mfu = tok_s * flops_tok / (peak_flops(devices[0]) * len(devices))
+    vs_baseline = mfu / _BASELINE_MFU if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "gpt_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -609,6 +609,14 @@ class NodeServer:
     def _worker_env(self, chips=None, runtime_env=None):
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
+        # Per-task/actor env overrides first (reference: runtime_env
+        # env_vars, _private/runtime_env/) so an explicit JAX_PLATFORMS
+        # override is visible to the FORCE_CPU decision below.
+        overrides = {
+            str(k): str(v)
+            for k, v in ((runtime_env or {}).get("env_vars") or {}).items()
+        }
+        env.update(overrides)
         if chips:
             env[constants.TPU_VISIBLE_CHIPS_ENV] = ",".join(map(str, chips))
             env["TPU_PROCESS_BOUNDS"] = ""
@@ -618,14 +626,11 @@ class NodeServer:
             # hides GPUs the same way via CUDA_VISIBLE_DEVICES="").
             # RAY_TPU_WORKER_FORCE_CPU drives worker_site/sitecustomize.py,
             # which blocks accelerator plugin registration pre-jax-import.
-            env["JAX_PLATFORMS"] = env.get("RAY_TPU_WORKER_JAX_PLATFORMS",
-                                           "cpu")
+            if "JAX_PLATFORMS" not in overrides:
+                env["JAX_PLATFORMS"] = env.get(
+                    "RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
             if env["JAX_PLATFORMS"] == "cpu":
                 env["RAY_TPU_WORKER_FORCE_CPU"] = "1"
-        # Per-task/actor env overrides (reference: runtime_env env_vars,
-        # _private/runtime_env/).
-        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
-            env[str(k)] = str(v)
         return env
 
     def _spawn_proc(self, worker_id, env):

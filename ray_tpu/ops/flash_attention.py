@@ -130,9 +130,9 @@ def _flash_forward_impl(q, k, v, causal, block_q, block_kv):
     if not _supported(t, block_q, block_kv):
         return reference_attention(q, k, v, causal=causal)
     interpret = jax.default_backend() != "tpu"
-    # Pad head_dim to the 128-lane tile; zero columns change nothing
-    # (scores: zero contributions; output: sliced off).
-    d_pad = max(128 if d < 128 else d, d)
+    # Pad head_dim up to a multiple of the 128-lane tile; zero columns
+    # change nothing (scores: zero contributions; output: sliced off).
+    d_pad = -(-d // 128) * 128
     if d_pad != d:
         pad = [(0, 0), (0, 0), (0, 0), (0, d_pad - d)]
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))

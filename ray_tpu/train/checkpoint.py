@@ -6,10 +6,15 @@ interconvertible dict / directory / URI :449-735) and
 arrays are written with orbax (`PyTreeCheckpointer`), everything else with
 pickle, so sharded params round-trip losslessly and restore can reshard
 onto a different mesh.
+
+Dict checkpoints are held in memory (host numpy snapshots) until persisted:
+no tmpdir per report() (which leaked disk for the life of the run) and no
+same-host assumption when a worker ships a checkpoint to the driver.
 """
 
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
 import shutil
@@ -23,6 +28,7 @@ _ORBAX_SUBDIR = "pytree"
 _PICKLE_FILE = "data.pkl"
 _counter_lock = threading.Lock()
 _counter = 0
+_tmpdirs: list[str] = []
 
 
 def _next_tmpdir() -> str:
@@ -33,7 +39,14 @@ def _next_tmpdir() -> str:
     d = os.path.join(tempfile.gettempdir(),
                      f"ray_tpu_ckpt_{os.getpid()}_{n}")
     os.makedirs(d, exist_ok=True)
+    _tmpdirs.append(d)
     return d
+
+
+@atexit.register
+def _cleanup_tmpdirs():
+    for d in _tmpdirs:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _is_array_tree(value) -> bool:
@@ -43,28 +56,25 @@ def _is_array_tree(value) -> bool:
 
 
 class Checkpoint:
-    """A directory-backed checkpoint. Construct with `from_dict` /
+    """A dict- or directory-backed checkpoint. Construct with `from_dict` /
     `from_directory`; read with `to_dict` / `to_directory` / `as_directory`.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str | None = None, *, _data: dict | None = None):
         self.path = path
+        self._data = _data
 
     # -- constructors -------------------------------------------------------
 
     @classmethod
     def from_dict(cls, data: dict) -> "Checkpoint":
-        d = _next_tmpdir()
-        arrays = {k: v for k, v in data.items() if _is_array_tree(v)}
-        rest = {k: v for k, v in data.items() if k not in arrays}
-        if arrays:
-            import orbax.checkpoint as ocp
-            ckptr = ocp.PyTreeCheckpointer()
-            host_arrays = jax.tree.map(np.asarray, arrays)
-            ckptr.save(os.path.join(d, _ORBAX_SUBDIR), host_arrays)
-        with open(os.path.join(d, _PICKLE_FILE), "wb") as f:
-            pickle.dump(rest, f, protocol=5)
-        return cls(d)
+        # Snapshot arrays to host numpy now: detaches from device buffers
+        # (donation-safe) and makes the object picklable across processes.
+        snap = {
+            k: (jax.tree.map(np.asarray, v) if _is_array_tree(v) else v)
+            for k, v in data.items()
+        }
+        return cls(_data=snap)
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -73,6 +83,8 @@ class Checkpoint:
     # -- accessors ----------------------------------------------------------
 
     def to_dict(self) -> dict:
+        if self._data is not None:
+            return dict(self._data)
         out = {}
         orbax_path = os.path.join(self.path, _ORBAX_SUBDIR)
         if os.path.isdir(orbax_path):
@@ -85,12 +97,28 @@ class Checkpoint:
         return out
 
     def to_directory(self, path: str) -> str:
-        if os.path.abspath(path) != os.path.abspath(self.path):
+        if self._data is not None:
+            os.makedirs(path, exist_ok=True)
+            arrays = {k: v for k, v in self._data.items()
+                      if _is_array_tree(v)}
+            rest = {k: v for k, v in self._data.items() if k not in arrays}
+            if arrays:
+                import orbax.checkpoint as ocp
+                ocp.PyTreeCheckpointer().save(
+                    os.path.join(path, _ORBAX_SUBDIR), arrays)
+            with open(os.path.join(path, _PICKLE_FILE), "wb") as f:
+                pickle.dump(rest, f, protocol=5)
+        elif os.path.abspath(path) != os.path.abspath(self.path):
             shutil.copytree(self.path, path, dirs_exist_ok=True)
         return path
 
     def as_directory(self) -> str:
+        if self._data is not None:
+            # Materialize once; the dir lives until process exit.
+            self.path = self.to_directory(_next_tmpdir())
+            self._data = None
         return self.path
 
     def __repr__(self):
-        return f"Checkpoint({self.path})"
+        kind = "dict" if self._data is not None else self.path
+        return f"Checkpoint({kind})"

@@ -19,7 +19,6 @@ from __future__ import annotations
 import logging
 import os
 import shutil
-import socket
 import time
 from dataclasses import dataclass, field
 
@@ -52,14 +51,6 @@ class Result:
     @property
     def best_checkpoints(self):
         return [(self.checkpoint, self.metrics)] if self.checkpoint else []
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 class JaxTrainer:
@@ -98,19 +89,23 @@ class JaxTrainer:
                 shards[name] = ds
         return shards
 
-    def _start_workers(self, trial_name: str, checkpoint):
+    def _create_workers(self, trial_name: str):
         sc = self.scaling
         res = sc.worker_resources()
         pg = placement_group([dict(res) for _ in range(sc.num_workers)],
                              strategy=sc.placement_strategy)
-        env_vars = {}
         workers = make_worker_group(sc.num_workers, res, trial_name,
-                                    placement_group=pg, env_vars=env_vars)
+                                    placement_group=pg, env_vars={})
+        return workers, pg
+
+    def _setup_workers(self, workers, checkpoint):
+        sc = self.scaling
         for w in workers:
             wait_for_actor_ready(w, timeout=180)
         if sc.num_workers > 1:
-            port = _free_port()
-            coordinator = f"127.0.0.1:{port}"
+            # Rendezvous address probed on worker 0's host, not the driver.
+            coordinator = ray_tpu.get(
+                workers[0].get_coordinator_address.remote(), timeout=60)
             ray_tpu.get([w.setup_distributed.remote(
                 coordinator, sc.num_workers, i)
                 for i, w in enumerate(workers)], timeout=300)
@@ -121,7 +116,6 @@ class JaxTrainer:
                 dataset_shards=self._make_shards(i, sc.num_workers),
                 mesh_spec=sc.mesh)
             for i, w in enumerate(workers)], timeout=300)
-        return workers, pg
 
     def _teardown(self, workers, pg):
         for w in workers:
@@ -159,9 +153,14 @@ class JaxTrainer:
         kept: list = []
 
         while True:
-            workers, pg = self._start_workers(trial_name, latest_ckpt)
+            workers, pg = None, None
             error = None
             try:
+                # Creation/setup failures (actor-ready timeout, rendezvous
+                # errors) must hit the same teardown + FailureConfig path as
+                # mid-training failures, not leak the placement group.
+                workers, pg = self._create_workers(trial_name)
+                self._setup_workers(workers, latest_ckpt)
                 while True:
                     results = ray_tpu.get(
                         [w.next_result.remote() for w in workers])
@@ -178,10 +177,13 @@ class JaxTrainer:
                     if head.get("checkpoint") is not None:
                         latest_ckpt = self._persist_checkpoint(
                             head["checkpoint"], storage, len(history), kept)
-            except ray_tpu.exceptions.RayTpuError as e:
+            except (ray_tpu.exceptions.RayTpuError, TimeoutError) as e:
                 error = f"worker group failed: {e!r}"
             finally:
-                self._teardown(workers, pg)
+                if workers is not None:
+                    self._teardown(workers, pg)
+                elif pg is not None:
+                    remove_placement_group(pg)
 
             if error is None:
                 return Result(

@@ -33,6 +33,20 @@ class TrainWorker:
         self.error: str | None = None
         self.finished = False
 
+    def get_coordinator_address(self) -> str:
+        """Pick the rendezvous address ON THIS WORKER's host (rank 0) — the
+        reference does the same on the rank-0 torch worker
+        (`train/torch/config.py:113`); probing on the driver would hand out
+        a port only valid when driver and worker 0 share a machine."""
+        import socket
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        host = os.environ.get("RAY_TPU_NODE_IP") or socket.gethostbyname(
+            socket.gethostname())
+        return f"{host}:{port}"
+
     def setup_distributed(self, coordinator: str, num_processes: int,
                           process_id: int):
         """TPU-native rendezvous (replaces dist.init_process_group)."""

@@ -62,6 +62,7 @@ class _RendezvousActor:
         self.world = world_size
         self.lock = threading.Lock()
         self.slots: dict[int, object] = {}
+        self.mailbox: dict[tuple, object] = {}
         self.barrier = threading.Barrier(world_size)
         self.result = None
 
@@ -70,15 +71,15 @@ class _RendezvousActor:
             self.slots[rank] = value
         i = self.barrier.wait()
         if i == 0:
+            # Snapshot + clear between the two barriers: no rank can be
+            # depositing for the next round until everyone passes the
+            # second barrier, and nobody passes the *next* round's first
+            # barrier until all have read this round's result.
             ordered = [self.slots[r] for r in sorted(self.slots)]
+            self.slots = {}
             self.result = combine(ordered)
         self.barrier.wait()
-        res = self.result
-        i2 = self.barrier.wait()
-        if i2 == 0:
-            self.slots = {}
-            self.result = None
-        return res
+        return self.result
 
     def allreduce(self, rank, arr, op):
         return self._exchange(rank, arr, _REDUCE_OPS[op])
@@ -101,7 +102,7 @@ class _RendezvousActor:
 
     def put_p2p(self, dst, tag, arr):
         with self.lock:
-            self.slots[("p2p", dst, tag)] = arr
+            self.mailbox[(dst, tag)] = arr
         return True
 
     def take_p2p(self, dst, tag, timeout=60.0):
@@ -109,8 +110,8 @@ class _RendezvousActor:
         deadline = time.time() + timeout
         while time.time() < deadline:
             with self.lock:
-                if ("p2p", dst, tag) in self.slots:
-                    return self.slots.pop(("p2p", dst, tag))
+                if (dst, tag) in self.mailbox:
+                    return self.mailbox.pop((dst, tag))
             time.sleep(0.005)
         raise TimeoutError(f"recv timeout (dst={dst}, tag={tag})")
 
