@@ -1,0 +1,44 @@
+"""ray_tpu.data — distributed datasets with streaming execution.
+
+Counterpart of the reference's Ray Data (`python/ray/data/`, SURVEY.md
+§2.7): lazy logical plans, fused map stages over tasks/actor pools,
+two-phase exchanges for shuffle/sort/groupby, and `iter_batches` feeding
+`jax.device_put` for TPU ingest.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
+    DataIterator,
+    Dataset,
+    GroupedData,
+    TaskPoolStrategy,
+)
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    from_torch,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "ActorPoolStrategy", "TaskPoolStrategy", "BlockAccessor",
+    "BlockMetadata", "Block", "DataContext", "DataIterator", "Dataset",
+    "GroupedData",
+    "from_arrow", "from_huggingface", "from_items", "from_numpy",
+    "from_pandas", "from_torch", "range", "range_tensor",
+    "read_binary_files", "read_csv", "read_datasource", "read_json",
+    "read_numpy", "read_parquet", "read_text",
+]

@@ -1,0 +1,239 @@
+"""All-to-all (barrier) operators: repartition, shuffle, sort, groupby.
+
+Counterpart of the reference's exchange ops (`_internal/shuffle.py`,
+`push_based_shuffle.py`, `sort.py`, `fast_repartition.py`). Two-phase
+exchange: map-side partition tasks write shard lists to the object store;
+reduce-side tasks fetch their shard index from each list (worker->store->
+worker; the driver only moves refs and tiny boundary samples, never data).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+
+
+def _store(block):
+    """Put the block from the worker; return (block_ref, meta) — only refs
+    and metadata ever reach the driver."""
+    meta = BlockAccessor.for_block(block).metadata()
+    return ray_tpu.put(block), meta
+
+
+# -- map side ---------------------------------------------------------------
+
+def _split_task(block, n, assignment_seed):
+    """Split one block into n shards. assignment_seed None -> contiguous
+    chunks; int -> random destination per row (shuffle)."""
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    if assignment_seed is None:
+        bounds = np.linspace(0, rows, n + 1).astype(int)
+        return [acc.slice(int(bounds[i]), int(bounds[i + 1]))
+                for i in range(n)]
+    rng = np.random.default_rng(assignment_seed)
+    dest = rng.integers(0, n, rows)
+    return [acc.take(np.nonzero(dest == i)[0]) for i in range(n)]
+
+
+def _range_split_task(block, bounds):
+    """Order-preserving split: bounds is a list of (lo, hi) local row
+    ranges, one per output partition (empty ranges allowed)."""
+    acc = BlockAccessor.for_block(block)
+    return [acc.slice(lo, hi) for lo, hi in bounds]
+
+
+def _boundary_split_task(block, boundaries, key, descending):
+    acc = BlockAccessor.for_block(block)
+    col = acc.to_numpy()[key]
+    dest = np.searchsorted(np.asarray(boundaries), col, side="right")
+    n = len(boundaries) + 1
+    if descending:
+        dest = (n - 1) - dest
+    return [acc.take(np.nonzero(dest == i)[0]) for i in range(n)]
+
+
+def _hash_split_task(block, n, key):
+    acc = BlockAccessor.for_block(block)
+    col = acc.to_numpy()[key]
+    if col.dtype.kind in "OUS":
+        # Deterministic across processes (Python's hash() is per-process
+        # randomized for str, which would scatter equal keys).
+        import zlib
+        dest = np.asarray(
+            [zlib.crc32(str(x).encode()) % n for x in col])
+    else:
+        dest = (col.astype(np.int64, copy=False) % n + n) % n
+    return [acc.take(np.nonzero(dest == i)[0]) for i in range(n)]
+
+
+def _sample_task(block, key, k):
+    acc = BlockAccessor.for_block(block)
+    col = acc.to_numpy()[key]
+    if len(col) == 0:
+        return col
+    idx = np.linspace(0, len(col) - 1, min(k, len(col))).astype(int)
+    return np.sort(col)[idx]
+
+
+# -- reduce side ------------------------------------------------------------
+
+def _fetch_shards(shard_list_refs, index):
+    return [ray_tpu.get(r)[index] for r in shard_list_refs]
+
+
+def _concat_task(shard_list_refs, index, shuffle_seed=None, sort_key=None,
+                 descending=False):
+    block = concat_blocks(_fetch_shards(shard_list_refs, index))
+    acc = BlockAccessor.for_block(block)
+    if shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        block = acc.take(rng.permutation(acc.num_rows()))
+    if sort_key is not None:
+        cols = BlockAccessor.for_block(block).to_numpy()
+        order = np.argsort(cols[sort_key], kind="stable")
+        if descending:
+            order = order[::-1]
+        block = BlockAccessor.for_block(block).take(order)
+    return _store(block)
+
+
+def _groupby_task(shard_list_refs, index, key, aggs):
+    """Per-partition pandas groupby (equal keys are co-located by the hash
+    exchange, so per-partition aggregation is exact)."""
+    import pandas as pd
+    block = concat_blocks(_fetch_shards(shard_list_refs, index))
+    df = BlockAccessor.for_block(block).to_pandas()
+    if df.empty:
+        return _store({})
+    gb = df.groupby(key, sort=True)
+    pieces = {}
+    for col, how, out_name in aggs:
+        if how == "count":
+            pieces[out_name] = gb.size()
+        else:
+            pieces[out_name] = getattr(gb[col], how)()
+    out = pd.DataFrame(pieces).reset_index()
+    return _store(out)
+
+
+# -- driver-side assembly ---------------------------------------------------
+
+def _collect(task_refs):
+    """Each task returns (block_ref, meta) — tiny driver-side fetch."""
+    return [ray_tpu.get(r, timeout=600) for r in task_refs]
+
+
+def _exchange(blocks, n_out, split_fn, split_args, concat_fn, concat_args):
+    """Generic 2-phase exchange skeleton."""
+    split = ray_tpu.remote(split_fn)
+    # shard-list refs stay refs: reduce tasks fetch them from the store.
+    shard_list_refs = [split.remote(ref, *split_args(i))
+                       for i, (ref, _) in enumerate(blocks)]
+    concat = ray_tpu.remote(concat_fn)
+    out = [concat.remote(list(shard_list_refs), i, *concat_args(i))
+           for i in range(n_out)]
+    return _collect(out)
+
+
+def run(op, blocks):
+    kind = op.kind
+    o = op.options
+    if kind == "repartition":
+        # Order-preserving: output partition p owns global row range
+        # [p*total/n, (p+1)*total/n); each input block contributes the
+        # intersection with its own global range.
+        n = o["num_blocks"]
+        total = sum(m.num_rows for _, m in blocks)
+        gbounds = np.linspace(0, total, n + 1).astype(int)
+        per_block_bounds = []
+        off = 0
+        for _, m in blocks:
+            local = []
+            for p in range(n):
+                lo = min(max(int(gbounds[p]) - off, 0), m.num_rows)
+                hi = min(max(int(gbounds[p + 1]) - off, 0), m.num_rows)
+                local.append((lo, hi))
+            per_block_bounds.append(local)
+            off += m.num_rows
+        return _exchange(
+            blocks, n, _range_split_task,
+            lambda i: (per_block_bounds[i],),
+            _concat_task, lambda i: (None, None, False))
+    if kind == "random_shuffle":
+        n = o.get("num_blocks") or max(len(blocks), 1)
+        seed = o.get("seed")
+        if seed is None:
+            # Fresh entropy per unseeded shuffle: epochs must differ.
+            seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+        return _exchange(blocks, n, _split_task,
+                         lambda i: (n, seed + i),
+                         _concat_task,
+                         lambda i: (seed + 31 * i + 7, None, False))
+    if kind == "sort":
+        key, desc = o["key"], o.get("descending", False)
+        n = max(len(blocks), 1)
+        sample = ray_tpu.remote(_sample_task)
+        samples = ray_tpu.get(
+            [sample.remote(ref, key, 16) for ref, _ in blocks], timeout=600)
+        nonempty = [s for s in samples if len(s)]
+        allv = np.sort(np.concatenate(nonempty)) if nonempty else []
+        if len(allv) == 0 or n == 1:
+            boundaries = []
+        else:
+            idx = np.linspace(0, len(allv) - 1, n + 1).astype(int)[1:-1]
+            boundaries = list(np.unique(allv[idx]))
+        return _exchange(
+            blocks, len(boundaries) + 1,
+            _boundary_split_task, lambda i: (boundaries, key, desc),
+            _concat_task, lambda i: (None, key, desc))
+    if kind == "groupby_agg":
+        key, aggs = o["key"], o["aggs"]
+        n = min(max(len(blocks), 1), 8)
+        out = _exchange(blocks, n, _hash_split_task, lambda i: (n, key),
+                        _groupby_task, lambda i: (key, aggs))
+        return [(r, m) for r, m in out if m.num_rows > 0]
+    raise ValueError(kind)
+
+
+# -- zip --------------------------------------------------------------------
+
+def zip_streams(left, right):
+    """Row-aligned zip: rechunk right to match left's block layout, then
+    column-concat per block (reference: `zip_operator.py`)."""
+    total_left = sum(m.num_rows for _, m in left)
+    total_right = sum(m.num_rows for _, m in right)
+    if total_left != total_right:
+        raise ValueError(
+            f"zip requires equal row counts, got {total_left} vs "
+            f"{total_right}")
+    ztask = ray_tpu.remote(_zip_task)
+    right_refs = [r for r, _ in right]
+    right_rows = [m.num_rows for _, m in right]
+    out = []
+    start = 0
+    for (lref, lmeta) in left:
+        out.append(ztask.remote(lref, right_refs, right_rows, start,
+                                lmeta.num_rows))
+        start += lmeta.num_rows
+    return _collect(out)
+
+
+def _zip_task(lblock, right_refs, right_rows, start, n):
+    rights = []
+    off = 0
+    for ref, rn in zip(right_refs, right_rows):
+        lo, hi = max(start - off, 0), min(start + n - off, rn)
+        if lo < hi:
+            rblock = ray_tpu.get(ref)
+            rights.append(BlockAccessor.for_block(rblock).slice(lo, hi))
+        off += rn
+    rcat = concat_blocks(rights)
+    lcols = BlockAccessor.for_block(lblock).to_numpy()
+    rcols = BlockAccessor.for_block(rcat).to_numpy()
+    merged = dict(lcols)
+    for k, v in rcols.items():
+        merged[k if k not in merged else f"{k}_1"] = v
+    return _store(merged)

@@ -1,0 +1,312 @@
+"""Streaming execution of a logical plan over ray_tpu tasks/actors.
+
+Counterpart of the reference's streaming executor
+(`_internal/execution/streaming_executor.py:49` + operator classes under
+`execution/operators/`). Shape of the design:
+
+- Consecutive map-type ops are FUSED into one task payload (reference:
+  operator fusion rule), so a read->map_batches->filter chain is one
+  process-hop per block.
+- Execution is a pull-driven generator pipeline: each stage consumes the
+  previous stage's (ref, meta) stream and keeps at most `max_in_flight`
+  tasks outstanding — bounded pipelining IS the backpressure (reference:
+  streaming_executor_state.py resource budgets; ours is expressed in task
+  slots instead of bytes because the object store is node-local tmpfs).
+- Every stored object is a pair (block, BlockMetadata) so metadata is
+  always available with the ref.
+- All-to-all ops (shuffle/sort/repartition/groupby) are barriers, as in the
+  reference's exchange ops.
+
+Actor compute (`ActorPoolStrategy`) runs the same fused payload inside a
+pool of stateful actors — the TPU batch-inference path where the model
+loads once per actor (reference: `actor_pool_map_operator.py:34`).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor, BlockMetadata, concat_blocks
+
+_DEFAULT_IN_FLIGHT = 8
+
+
+# ---------------------------------------------------------------------------
+# fused map chains
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ChainStage:
+    kind: str                 # map_batches | map | filter | flat_map | write
+    fn: object
+    fn_constructor_args: tuple
+    fn_args: tuple
+    fn_kwargs: dict
+    batch_size: int | None
+    batch_format: str | None
+    is_callable_class: bool
+
+
+def _make_stage(op: plan_mod.MapOp) -> _ChainStage:
+    return _ChainStage(op.kind, op.fn, op.fn_constructor_args, op.fn_args,
+                       op.fn_kwargs, op.batch_size, op.batch_format,
+                       op.is_callable_class)
+
+
+def _instantiate(stage: _ChainStage, cache: dict):
+    """Callable classes are constructed once per process/actor and cached
+    (the whole point of actor compute: load the model once). Keyed by
+    identity (module, qualname, ctor args), NOT id(): cloudpickle ships a
+    fresh class object per task for by-value-pickled classes, so id() would
+    miss every time (reconstructing the model per block) and leak stale
+    instances."""
+    if not stage.is_callable_class:
+        return stage.fn
+    key = (getattr(stage.fn, "__module__", ""),
+           getattr(stage.fn, "__qualname__", repr(stage.fn)),
+           repr(stage.fn_constructor_args))
+    if key not in cache:
+        cache[key] = stage.fn(*stage.fn_constructor_args)
+    return cache[key]
+
+
+def _apply_stage(stage: _ChainStage, block, cache: dict):
+    acc = BlockAccessor.for_block(block)
+    fn = _instantiate(stage, cache)
+    if stage.kind == "map_batches":
+        n = acc.num_rows()
+        bs = stage.batch_size or max(n, 1)
+        out = []
+        for s in range(0, max(n, 1), bs):
+            sub = BlockAccessor.for_block(
+                acc.slice(s, min(s + bs, n))) if n else acc
+            batch = sub.to_batch(stage.batch_format)
+            res = fn(batch, *stage.fn_args, **stage.fn_kwargs)
+            out.append(BlockAccessor.batch_to_block(res))
+        return concat_blocks(out)
+    if stage.kind == "map":
+        rows = [fn(r, *stage.fn_args, **stage.fn_kwargs)
+                for r in acc.iter_rows()]
+        return BlockAccessor.batch_to_block(rows)
+    if stage.kind == "filter":
+        keep = [i for i, r in enumerate(acc.iter_rows())
+                if fn(r, *stage.fn_args, **stage.fn_kwargs)]
+        return acc.take(keep)
+    if stage.kind == "flat_map":
+        rows = []
+        for r in acc.iter_rows():
+            rows.extend(fn(r, *stage.fn_args, **stage.fn_kwargs))
+        return BlockAccessor.batch_to_block(rows)
+    if stage.kind == "write":
+        fn(block, *stage.fn_args, **stage.fn_kwargs)
+        return block
+    raise ValueError(stage.kind)
+
+
+def _run_chain(stages: list, item, _cache={}):
+    """Task body: item is either a bare block (resolved from a block ref)
+    or a ReadTask thunk. Returns (block_ref, meta): the block itself is
+    `put` into the store FROM THE WORKER, so the driver only ever touches
+    refs + metadata — dataset bytes never funnel through the driver."""
+    if callable(item):                      # read task
+        block = item()
+        files = getattr(item, "input_files", None)
+    else:
+        block = item
+        files = None
+    for stage in stages:
+        block = _apply_stage(stage, block, _cache)
+    meta = BlockAccessor.for_block(block).metadata(files)
+    return ray_tpu.put(block), meta
+
+
+class _MapWorker:
+    """Actor hosting a fused chain; constructor caches live for the actor's
+    lifetime (reference: `actor_pool_map_operator.py` _MapWorker)."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def ready(self):
+        return True
+
+    def apply(self, stages, item):
+        return _run_chain(stages, item, self._cache)
+
+
+# ---------------------------------------------------------------------------
+# stage streams
+# ---------------------------------------------------------------------------
+
+def _submit_arg(item):
+    """(ref, meta) pairs submit as the bare top-level ref (the scheduler
+    resolves it to the stored (block, meta) pair); ReadTask thunks submit
+    as-is."""
+    return item[0] if isinstance(item, tuple) else item
+
+
+def _task_map_stream(inputs, stages, op: plan_mod.MapOp | None):
+    """Submit one task per input with a bounded window; yield refs in order."""
+    fn = ray_tpu.remote(_run_chain)
+    opts = {}
+    if op is not None:
+        if op.num_cpus is not None:
+            opts["num_cpus"] = op.num_cpus
+        if op.num_tpus is not None:
+            opts["num_tpus"] = op.num_tpus
+    if opts:
+        fn = fn.options(**opts)
+    from ray_tpu.data.context import DataContext
+    ctx_max = (DataContext.get_current().max_tasks_per_operator
+               or _DEFAULT_IN_FLIGHT)
+    window: list = []
+    for item in inputs:
+        window.append(fn.remote(stages, _submit_arg(item)))
+        if len(window) >= ctx_max:
+            yield _result(window.pop(0))
+    for ref in window:
+        yield _result(ref)
+
+
+def _actor_map_stream(inputs, stages, op: plan_mod.MapOp):
+    from ray_tpu.data.dataset import ActorPoolStrategy
+    strat: ActorPoolStrategy = op.compute
+    size = strat.size or strat.min_size or 2
+    opts = {}
+    if op.num_cpus is not None:
+        opts["num_cpus"] = op.num_cpus
+    if op.num_tpus is not None:
+        opts["num_tpus"] = op.num_tpus
+    cls = ray_tpu.remote(_MapWorker)
+    if opts:
+        cls = cls.options(**opts)
+    actors = [cls.remote() for _ in range(size)]
+    try:
+        ray_tpu.get([a.ready.remote() for a in actors], timeout=120)
+        per_actor = max(1, strat.max_tasks_in_flight_per_actor)
+        window: list = []
+        rr = itertools.cycle(range(size))
+        for item in inputs:
+            actor = actors[next(rr)]
+            window.append(actor.apply.remote(stages, _submit_arg(item)))
+            if len(window) >= size * per_actor:
+                yield _result(window.pop(0))
+        for ref in window:
+            yield _result(ref)
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def _result(task_ref):
+    """A chain task's return IS (block_ref, meta) — tiny; the block stays
+    in the store until some consumer fetches the block_ref."""
+    block_ref, meta = ray_tpu.get(task_ref)
+    return block_ref, meta
+
+
+def _source_stream(op):
+    if isinstance(op, plan_mod.InputData):
+        yield from op.blocks
+        return
+    # Read: run read tasks through the (possibly fused) map path; callers
+    # fuse stages onto it, so a bare Read is _task_map_stream with no stages.
+    raise AssertionError("Read handled in execute_plan segmentation")
+
+
+def _limit_stream(inputs, n: int):
+    seen = 0
+    for ref, meta in inputs:
+        if seen >= n:
+            break
+        if seen + meta.num_rows <= n:
+            seen += meta.num_rows
+            yield ref, meta
+            continue
+        block = ray_tpu.get(ref)
+        cut = BlockAccessor.for_block(block).slice(0, n - seen)
+        cut_meta = BlockAccessor.for_block(cut).metadata()
+        yield ray_tpu.put(cut), cut_meta
+        seen = n
+
+
+# ---------------------------------------------------------------------------
+# plan segmentation + dispatch
+# ---------------------------------------------------------------------------
+
+def execute_plan(plan: plan_mod.ExecutionPlan):
+    """Generator of (ref, meta) driving the fused stage pipeline."""
+    from ray_tpu.data._internal import allops
+
+    ops = plan.ops
+    stream = None
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if isinstance(op, (plan_mod.Read, plan_mod.InputData)):
+            # Fuse any directly following map ops into the source stage.
+            stages, j = _collect_stages(ops, i + 1)
+            if isinstance(op, plan_mod.InputData):
+                if stages:
+                    map_op = ops[i + 1]
+                    stream = _dispatch_map(iter(op.blocks), stages, map_op)
+                else:
+                    stream = iter(op.blocks)
+            else:
+                map_op = ops[i + 1] if stages else None
+                stream = _dispatch_map(iter(op.read_tasks), stages, map_op)
+            i = j
+        elif isinstance(op, plan_mod.MapOp):
+            stages, j = _collect_stages(ops, i)
+            stream = _dispatch_map(stream, stages, op)
+            i = j
+        elif isinstance(op, plan_mod.AllToAll):
+            stream = iter(allops.run(op, list(stream)))
+            i += 1
+        elif isinstance(op, plan_mod.Limit):
+            stream = _limit_stream(stream, op.n)
+            i += 1
+        elif isinstance(op, plan_mod.Union):
+            streams = [stream] + [p.stream() for p in op.others]
+            stream = itertools.chain(*streams)
+            i += 1
+        elif isinstance(op, plan_mod.Zip):
+            stream = iter(allops.zip_streams(list(stream),
+                                             list(op.other.stream())))
+            i += 1
+        else:
+            raise ValueError(f"unknown op {op}")
+    yield from stream
+
+
+def _collect_stages(ops, start):
+    """Greedy fusion of consecutive task-compute map ops. Actor-compute ops
+    never fuse with neighbors (they need their own pool)."""
+    from ray_tpu.data.context import DataContext
+    stages = []
+    j = start
+    while j < len(ops) and isinstance(ops[j], plan_mod.MapOp):
+        op = ops[j]
+        if op.compute is not None and (stages or j > start):
+            break
+        stages.append(_make_stage(op))
+        j += 1
+        if op.compute is not None:
+            break
+        if not DataContext.get_current().enable_operator_fusion:
+            break
+    return stages, j
+
+
+def _dispatch_map(inputs, stages, op: plan_mod.MapOp | None):
+    if op is not None and op.compute is not None:
+        return _actor_map_stream(inputs, stages, op)
+    return _task_map_stream(inputs, stages, op)

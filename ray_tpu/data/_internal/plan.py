@@ -1,0 +1,136 @@
+"""Logical plan: lazy op list + optimizer (fusion).
+
+Counterpart of the reference's `data/_internal/logical/` (operator defs,
+`optimizers.py` fusion rules) + `planner/planner.py`. Deliberately compact:
+ops are dataclasses, the only optimization that matters for the hot path —
+fusing consecutive map-type ops into one task launch — is applied at plan
+build time (reference: `logical/rules/operator_fusion.py`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable
+
+
+@dataclass
+class LogicalOp:
+    pass
+
+
+@dataclass
+class Read(LogicalOp):
+    """Source: a list of ReadTask thunks, each producing one block."""
+    read_tasks: list = field(default_factory=list)   # callables -> block
+    input_files: list | None = None
+
+    @property
+    def name(self):
+        return "Read"
+
+
+@dataclass
+class InputData(LogicalOp):
+    """Source: already-materialized (block_ref, metadata) pairs."""
+    blocks: list = field(default_factory=list)
+
+    @property
+    def name(self):
+        return "InputData"
+
+
+@dataclass
+class MapOp(LogicalOp):
+    """Any per-block transform. kind: map_batches|map|filter|flat_map|
+    write. `fn` operates on a batch/row per kind; fusion chains these."""
+    kind: str
+    fn: Callable
+    fn_constructor_args: tuple = ()
+    fn_args: tuple = ()
+    fn_kwargs: dict = field(default_factory=dict)
+    batch_size: int | None = None         # map_batches only
+    batch_format: str | None = "numpy"
+    zero_copy_batch: bool = False
+    compute: Any = None                   # None=tasks, ActorPoolStrategy
+    num_cpus: float | None = None
+    num_tpus: float | None = None
+    is_callable_class: bool = False
+
+    @property
+    def name(self):
+        return self.kind
+
+
+@dataclass
+class AllToAll(LogicalOp):
+    """Barrier op: repartition | random_shuffle | sort | groupby_agg."""
+    kind: str
+    options: dict = field(default_factory=dict)
+
+    @property
+    def name(self):
+        return self.kind
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int = 0
+
+    @property
+    def name(self):
+        return f"limit={self.n}"
+
+
+@dataclass
+class Union(LogicalOp):
+    others: list = field(default_factory=list)      # list[ExecutionPlan]
+
+    @property
+    def name(self):
+        return "Union"
+
+
+@dataclass
+class Zip(LogicalOp):
+    other: Any = None                               # ExecutionPlan
+
+    @property
+    def name(self):
+        return "Zip"
+
+
+class ExecutionPlan:
+    """Immutable chain of logical ops; Datasets share structure on append
+    (reference: `_internal/plan.py` ExecutionPlan)."""
+
+    def __init__(self, ops: list[LogicalOp]):
+        self.ops = list(ops)
+        self._cached_blocks = None   # list[(ref, BlockMetadata)] once run
+
+    def with_op(self, op: LogicalOp) -> "ExecutionPlan":
+        return ExecutionPlan(self.ops + [op])
+
+    def copy(self) -> "ExecutionPlan":
+        p = ExecutionPlan(self.ops)
+        p._cached_blocks = self._cached_blocks
+        return p
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops)
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self):
+        """Materialize fully: list[(block_ref, BlockMetadata)]."""
+        if self._cached_blocks is None:
+            from ray_tpu.data._internal.execution import execute_plan
+            self._cached_blocks = list(execute_plan(self))
+        return self._cached_blocks
+
+    def stream(self):
+        """Yield (block_ref, BlockMetadata) as they become available."""
+        if self._cached_blocks is not None:
+            yield from self._cached_blocks
+            return
+        from ray_tpu.data._internal.execution import execute_plan
+        yield from execute_plan(self)

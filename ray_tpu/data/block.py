@@ -1,0 +1,253 @@
+"""Block model: the unit of distributed data.
+
+Counterpart of the reference's `data/block.py` + `_internal/arrow_block.py` /
+`pandas_block.py` / numpy support: a Block is a pyarrow Table, a pandas
+DataFrame, or a dict of numpy arrays (column-major). `BlockAccessor` gives a
+uniform view over all three, chosen so the hot path for TPU feeding —
+`iter_batches(batch_format="numpy")` → `jax.device_put` — is zero-copy from
+Arrow where dtypes allow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+import numpy as np
+
+# A Block is pa.Table | pd.DataFrame | dict[str, np.ndarray].
+Block = Any
+
+
+@dataclass
+class BlockMetadata:
+    """Counterpart of reference `data/block.py` BlockMetadata: size info
+    kept driver-side so planning never fetches data."""
+    num_rows: int
+    size_bytes: int
+    schema: Any = None
+    input_files: list | None = None
+
+
+def _is_tabular_dict(d) -> bool:
+    return isinstance(d, dict) and all(
+        isinstance(v, np.ndarray) for v in d.values())
+
+
+class BlockAccessor:
+    """Uniform view over arrow Table / pandas DataFrame / numpy dict."""
+
+    def __init__(self, block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- builders -----------------------------------------------------------
+
+    @staticmethod
+    def batch_to_block(batch):
+        """Normalize a UDF-returned batch into a canonical block."""
+        import pandas as pd
+        import pyarrow as pa
+        if isinstance(batch, (pa.Table, pd.DataFrame)):
+            return batch
+        if _is_tabular_dict(batch):
+            return batch
+        if isinstance(batch, dict):
+            return {k: np.asarray(v) for k, v in batch.items()}
+        if isinstance(batch, np.ndarray):
+            return {"data": batch}
+        if isinstance(batch, list):
+            return _rows_to_block(batch)
+        raise TypeError(
+            f"UDF returned unsupported batch type {type(batch).__name__}; "
+            "expected dict-of-ndarray, ndarray, pyarrow.Table, DataFrame, "
+            "or list of rows")
+
+    # -- core ---------------------------------------------------------------
+
+    @property
+    def block(self):
+        return self._block
+
+    def num_rows(self) -> int:
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.num_rows
+        if isinstance(b, pd.DataFrame):
+            return len(b)
+        if not b:
+            return 0
+        return len(next(iter(b.values())))
+
+    def size_bytes(self) -> int:
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.nbytes
+        if isinstance(b, pd.DataFrame):
+            return int(b.memory_usage(index=False, deep=True).sum())
+        return sum(v.nbytes for v in b.values())
+
+    def schema(self):
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.schema
+        if isinstance(b, pd.DataFrame):
+            return pa.Schema.from_pandas(b, preserve_index=False)
+        return {k: v.dtype for k, v in b.items()}
+
+    def metadata(self, input_files=None) -> BlockMetadata:
+        return BlockMetadata(self.num_rows(), self.size_bytes(),
+                             self.schema(), input_files)
+
+    def column_names(self) -> list:
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return list(b.column_names)
+        if isinstance(b, pd.DataFrame):
+            return list(b.columns)
+        return list(b.keys())
+
+    # -- conversions --------------------------------------------------------
+
+    def to_arrow(self):
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b
+        if isinstance(b, pd.DataFrame):
+            return pa.Table.from_pandas(b, preserve_index=False)
+        cols, names = [], []
+        for k, v in b.items():
+            names.append(k)
+            if v.ndim == 1:
+                cols.append(pa.array(v))
+            else:  # tensor column: list-of-lists representation
+                cols.append(pa.array(list(v)))
+        return pa.Table.from_arrays(cols, names=names)
+
+    def to_pandas(self):
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pd.DataFrame):
+            return b
+        if isinstance(b, pa.Table):
+            return b.to_pandas()
+        return pd.DataFrame(
+            {k: (v if v.ndim == 1 else list(v)) for k, v in b.items()})
+
+    def to_numpy(self) -> dict:
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if _is_tabular_dict(b):
+            return b
+        if isinstance(b, pa.Table):
+            out = {}
+            for name in b.column_names:
+                col = b.column(name)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    out[name] = np.asarray(col.to_pylist(), dtype=object)
+                if out[name].dtype == object and len(out[name]) and \
+                        isinstance(out[name][0], (list, np.ndarray)):
+                    try:
+                        out[name] = np.stack(
+                            [np.asarray(x) for x in out[name]])
+                    except ValueError:
+                        pass   # ragged; keep object array
+            return out
+        if isinstance(b, pd.DataFrame):
+            return {c: b[c].to_numpy() for c in b.columns}
+        raise TypeError(type(b))
+
+    def to_batch(self, batch_format: str | None):
+        if batch_format in (None, "default", "numpy"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.to_arrow()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- row/slice ops ------------------------------------------------------
+
+    def slice(self, start: int, end: int):
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        if isinstance(b, pa.Table):
+            return b.slice(start, end - start)
+        if isinstance(b, pd.DataFrame):
+            return b.iloc[start:end]
+        return {k: v[start:end] for k, v in b.items()}
+
+    def take(self, indices):
+        import pandas as pd
+        import pyarrow as pa
+        b = self._block
+        idx = np.asarray(indices)
+        if idx.dtype != bool:
+            idx = idx.astype(np.int64, copy=False)   # [] defaults to f64
+        if isinstance(b, pa.Table):
+            return b.take(idx)
+        if isinstance(b, pd.DataFrame):
+            return b.iloc[idx]
+        return {k: v[idx] for k, v in b.items()}
+
+    def iter_rows(self) -> Iterable[dict]:
+        cols = self.to_numpy()
+        names = list(cols)
+        n = self.num_rows()
+        for i in range(n):
+            yield {k: cols[k][i] for k in names}
+
+
+def _rows_to_block(rows: list):
+    """List of dict rows (or scalars) -> numpy-dict block."""
+    if not rows:
+        return {}
+    if isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        out = {}
+        for k in keys:
+            vals = [r[k] for r in rows]
+            try:
+                out[k] = np.asarray(vals)
+            except ValueError:
+                out[k] = np.asarray(vals, dtype=object)
+        return out
+    return {"item": np.asarray(rows)}
+
+
+def concat_blocks(blocks: list):
+    """Concatenate same-kind blocks (normalizing mixed kinds via arrow)."""
+    import pandas as pd
+    import pyarrow as pa
+    blocks = [b for b in blocks
+              if BlockAccessor.for_block(b).num_rows() > 0]
+    if not blocks:
+        return {}
+    kinds = {type(b) for b in blocks}
+    if len(kinds) > 1:
+        blocks = [BlockAccessor.for_block(b).to_arrow() for b in blocks]
+    b0 = blocks[0]
+    if isinstance(b0, pa.Table):
+        return pa.concat_tables(blocks, promote_options="default")
+    if isinstance(b0, pd.DataFrame):
+        return pd.concat(blocks, ignore_index=True)
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}

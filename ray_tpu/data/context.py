@@ -1,0 +1,31 @@
+"""Execution context / knobs (reference: `data/context.py` DataContext).
+
+Every knob here is read by the executor — config options that exist but do
+nothing are worse than missing ones.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    # Max concurrent tasks per operator: the backpressure bound (the
+    # reference budgets bytes in streaming_executor_state; ours is task
+    # slots — the object store is node-local tmpfs, so slots ~ blocks).
+    max_tasks_per_operator: int | None = None    # None = default (8)
+    # Default parallelism for read_*/from_* when the call passes -1.
+    read_parallelism: int = -1                   # -1 = #CPUs
+    enable_operator_fusion: bool = True
+
+    _local = threading.local()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(DataContext._local, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            DataContext._local.ctx = ctx
+        return ctx

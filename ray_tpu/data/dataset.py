@@ -1,0 +1,467 @@
+"""Dataset: lazy distributed data API.
+
+Counterpart of the reference's `data/dataset.py:170` (`map_batches` :379,
+`repartition` :909, `random_shuffle` :960, `split` :1170, `groupby` :1703,
+`sort` :2017, `iter_batches` :3031) over the ray_tpu core. Execution is
+lazy: transforms append logical ops; iteration/materialization drives the
+streaming executor (`_internal/execution.py`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+
+
+@dataclass
+class TaskPoolStrategy:
+    """Stateless tasks (default compute; reference `compute.py:58`)."""
+    size: int | None = None
+
+
+@dataclass
+class ActorPoolStrategy:
+    """Autoscaling-pool-of-actors compute for stateful UDFs — the TPU batch
+    inference path (reference `compute.py:180`, `actor_pool_map_operator`).
+    """
+    size: int | None = None
+    min_size: int | None = None
+    max_size: int | None = None
+    max_tasks_in_flight_per_actor: int = 2
+
+
+class Dataset:
+    def __init__(self, plan: plan_mod.ExecutionPlan):
+        self._plan = plan
+
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+    # ------------------------------------------------------------------
+
+    def _append(self, op) -> "Dataset":
+        return Dataset(self._plan.with_op(op))
+
+    def map_batches(self, fn, *, batch_size: int | None = 1024,
+                    batch_format: str | None = "numpy",
+                    compute=None, fn_args=(), fn_kwargs=None,
+                    fn_constructor_args=(), num_cpus=None, num_tpus=None,
+                    zero_copy_batch=False, **_ignored) -> "Dataset":
+        is_cls = isinstance(fn, type)
+        if is_cls and compute is None:
+            compute = ActorPoolStrategy(size=2)
+        return self._append(plan_mod.MapOp(
+            "map_batches", fn, tuple(fn_constructor_args), tuple(fn_args),
+            dict(fn_kwargs or {}), batch_size, batch_format,
+            zero_copy_batch, compute, num_cpus, num_tpus, is_cls))
+
+    def map(self, fn, *, compute=None, num_cpus=None, **_ignored):
+        return self._append(plan_mod.MapOp(
+            "map", fn, (), (), {}, None, None, False, compute, num_cpus,
+            None, isinstance(fn, type)))
+
+    def filter(self, fn, **_ignored):
+        return self._append(plan_mod.MapOp(
+            "filter", fn, (), (), {}, None, None, False, None, None, None,
+            isinstance(fn, type)))
+
+    def flat_map(self, fn, **_ignored):
+        return self._append(plan_mod.MapOp(
+            "flat_map", fn, (), (), {}, None, None, False, None, None,
+            None, isinstance(fn, type)))
+
+    def add_column(self, col: str, fn) -> "Dataset":
+        def add(batch):
+            batch = dict(batch)
+            batch[col] = np.asarray(fn(batch))
+            return batch
+        return self.map_batches(add, batch_size=None)
+
+    def drop_columns(self, cols: list) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop},
+            batch_size=None)
+
+    def select_columns(self, cols: list) -> "Dataset":
+        keep = list(cols)
+        return self.map_batches(
+            lambda b: {k: b[k] for k in keep}, batch_size=None)
+
+    def rename_columns(self, mapping: dict) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()},
+            batch_size=None)
+
+    def random_sample(self, fraction: float, *, seed=None) -> "Dataset":
+        def sample(batch, _ctr=[0]):
+            n = len(next(iter(batch.values()))) if batch else 0
+            # Per-batch sub-seed: a fixed seed must not reuse the identical
+            # mask on every block (perfectly correlated "sample").
+            _ctr[0] += 1
+            rng = (np.random.default_rng() if seed is None else
+                   np.random.default_rng(
+                       np.random.SeedSequence([seed, _ctr[0]])))
+            keep = rng.random(n) < fraction
+            return {k: v[keep] for k, v in batch.items()}
+        return self.map_batches(sample, batch_size=None)
+
+    # -- all-to-all -----------------------------------------------------
+
+    def repartition(self, num_blocks: int, **_ignored) -> "Dataset":
+        return self._append(plan_mod.AllToAll(
+            "repartition", {"num_blocks": num_blocks}))
+
+    def random_shuffle(self, *, seed=None, num_blocks=None,
+                       **_ignored) -> "Dataset":
+        return self._append(plan_mod.AllToAll(
+            "random_shuffle", {"seed": seed, "num_blocks": num_blocks}))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._append(plan_mod.AllToAll(
+            "sort", {"key": key, "descending": descending}))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(plan_mod.Limit(n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(plan_mod.Union(
+            [o._plan.copy() for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(plan_mod.Zip(other._plan.copy()))
+
+    # ------------------------------------------------------------------
+    # consumption
+    # ------------------------------------------------------------------
+
+    def materialize(self) -> "Dataset":
+        self._plan.execute()
+        return self
+
+    def _blocks(self):
+        return self._plan.execute()
+
+    def num_blocks(self) -> int:
+        return len(self._blocks())
+
+    def count(self) -> int:
+        return sum(m.num_rows for _, m in self._blocks())
+
+    def size_bytes(self) -> int:
+        return sum(m.size_bytes for _, m in self._blocks())
+
+    def schema(self):
+        for ref, meta in self._plan.stream():
+            if meta.num_rows > 0:
+                return meta.schema
+        return None
+
+    def columns(self) -> list | None:
+        for ref, _ in self._plan.stream():
+            block = ray_tpu.get(ref)
+            return BlockAccessor.for_block(block).column_names()
+        return None
+
+    def input_files(self) -> list:
+        out = []
+        for _, meta in self._blocks():
+            out.extend(meta.input_files or [])
+        return out
+
+    def take(self, n: int = 20) -> list:
+        rows = []
+        for ref, _meta in self._plan.stream():
+            block = ray_tpu.get(ref)
+            for row in BlockAccessor.for_block(block).iter_rows():
+                rows.append(row)
+                if len(rows) >= n:
+                    return rows
+        return rows
+
+    def take_all(self) -> list:
+        return self.take(int(1e18))
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref, _meta in self._plan.stream():
+            block = ray_tpu.get(ref)
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str | None = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: int | None = None,
+                     local_shuffle_seed: int | None = None,
+                     prefetch_batches: int = 1) -> Iterator:
+        it = DataIterator(self)
+        return it.iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed,
+            prefetch_batches=prefetch_batches)
+
+    def iterator(self) -> "DataIterator":
+        return DataIterator(self)
+
+    # -- conversions ----------------------------------------------------
+
+    def to_pandas(self):
+        blocks = [ray_tpu.get(r) for r, _ in self._blocks()]
+        out = concat_blocks(blocks)
+        return BlockAccessor.for_block(out).to_pandas()
+
+    def to_numpy(self) -> dict:
+        blocks = [ray_tpu.get(r) for r, _ in self._blocks()]
+        return BlockAccessor.for_block(concat_blocks(blocks)).to_numpy()
+
+    def to_arrow_refs(self) -> list:
+        return [r for r, _ in self._blocks()]
+
+    # -- splits ---------------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> list["Dataset"]:
+        blocks = self._blocks()
+        if equal:
+            total = sum(m.num_rows for _, m in blocks)
+            per = total // n
+            return [
+                self._slice_rows(i * per, (i + 1) * per) for i in range(n)
+            ]
+        shards: list[list] = [[] for _ in range(n)]
+        for i, bm in enumerate(blocks):
+            shards[i % n].append(bm)
+        return [Dataset(plan_mod.ExecutionPlan(
+            [plan_mod.InputData(blocks=s)])) for s in shards]
+
+    def _slice_rows(self, start: int, end: int) -> "Dataset":
+        out = []
+        off = 0
+        for ref, meta in self._blocks():
+            lo, hi = max(start - off, 0), min(end - off, meta.num_rows)
+            if lo < hi:
+                block = ray_tpu.get(ref)
+                cut = BlockAccessor.for_block(block).slice(lo, hi)
+                m = BlockAccessor.for_block(cut).metadata()
+                out.append((ray_tpu.put(cut), m))
+            off += meta.num_rows
+        return Dataset(plan_mod.ExecutionPlan(
+            [plan_mod.InputData(blocks=out)]))
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> list["DataIterator"]:
+        return [DataIterator(ds) for ds in self.split(n, equal=equal)]
+
+    def streaming_split_shard(self, rank: int, world: int) -> "Dataset":
+        """Per-worker shard hook used by JaxTrainer._make_shards."""
+        return self.split(world, equal=True)[rank]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed=None) -> tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = int(total * test_size) if isinstance(test_size, float) \
+            else int(test_size)
+        return (ds._slice_rows(0, total - n_test),
+                ds._slice_rows(total - n_test, total))
+
+    # -- writes ---------------------------------------------------------
+
+    def _write(self, writer, path: str, **kwargs):
+        refs = []
+        write = ray_tpu.remote(_write_task)
+        for i, (ref, _meta) in enumerate(self._plan.stream()):
+            refs.append(write.remote(ref, writer, path, i, kwargs))
+        ray_tpu.get(refs, timeout=600)
+
+    def write_parquet(self, path: str, **kwargs):
+        from ray_tpu.data.datasource import write_parquet_block
+        self._write(write_parquet_block, path, **kwargs)
+
+    def write_csv(self, path: str, **kwargs):
+        from ray_tpu.data.datasource import write_csv_block
+        self._write(write_csv_block, path, **kwargs)
+
+    def write_json(self, path: str, **kwargs):
+        from ray_tpu.data.datasource import write_json_block
+        self._write(write_json_block, path, **kwargs)
+
+    def write_numpy(self, path: str, *, column: str = "data", **kwargs):
+        from ray_tpu.data.datasource import write_numpy_block
+        self._write(write_numpy_block, path, column=column, **kwargs)
+
+    # -- misc -----------------------------------------------------------
+
+    def stats(self) -> str:
+        return self._plan.describe()
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan.describe()})"
+
+
+def _write_task(block, writer, path, idx, kwargs):
+    writer(block, path, idx, **kwargs)
+    return True
+
+
+class GroupedData:
+    """Counterpart of reference `data/grouped_data.py`."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: list) -> Dataset:
+        return self._ds._append(plan_mod.AllToAll(
+            "groupby_agg", {"key": self._key, "aggs": aggs}))
+
+    def count(self) -> Dataset:
+        return self._agg([(None, "count", "count()")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([(col, "sum", f"sum({col})")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([(col, "mean", f"mean({col})")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([(col, "min", f"min({col})")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([(col, "max", f"max({col})")])
+
+    def std(self, col: str) -> Dataset:
+        return self._agg([(col, "std", f"std({col})")])
+
+    def aggregate(self, *aggs) -> Dataset:
+        """aggs: tuples (col, how) or (col, how, out_name)."""
+        norm = []
+        for a in aggs:
+            col, how = a[0], a[1]
+            out = a[2] if len(a) > 2 else f"{how}({col})"
+            norm.append((col, how, out))
+        return self._agg(norm)
+
+
+class DataIterator:
+    """Counterpart of reference `data/iterator.py` + block_batching:
+    pull blocks as the executor produces them, re-batch, format, prefetch.
+    """
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str | None = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: int | None = None,
+                     local_shuffle_seed: int | None = None,
+                     prefetch_batches: int = 1) -> Iterator:
+        def block_iter():
+            for ref, _meta in self._ds._plan.stream():
+                yield ray_tpu.get(ref)
+
+        blocks = block_iter()
+        if prefetch_batches and prefetch_batches > 0:
+            blocks = _prefetched(blocks, prefetch_batches)
+        if local_shuffle_buffer_size:
+            blocks = _shuffled_blocks(
+                blocks, local_shuffle_buffer_size, local_shuffle_seed)
+        yield from _rebatch(blocks, batch_size, batch_format, drop_last)
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def materialize(self):
+        return self._ds.materialize()
+
+    # Train integration: JaxTrainer dataset shards arrive as DataIterator
+    # or Dataset; both expose iter_batches.
+    def streaming_split_shard(self, rank, world):
+        return self._ds.streaming_split_shard(rank, world)
+
+
+def _prefetched(it, depth: int):
+    """Pull ahead on a daemon thread so block fetch/format overlaps the
+    consumer's compute (reference: `block_batching` prefetcher)."""
+    import queue
+    import threading
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    DONE, ERR = object(), object()
+
+    def fill():
+        try:
+            for x in it:
+                q.put(x)
+            q.put(DONE)
+        except BaseException as e:   # surface in consumer
+            q.put((ERR, e))
+
+    threading.Thread(target=fill, daemon=True,
+                     name="data-prefetch").start()
+    while True:
+        x = q.get()
+        if x is DONE:
+            return
+        if isinstance(x, tuple) and len(x) == 2 and x[0] is ERR:
+            raise x[1]
+        yield x
+
+
+def _shuffled_blocks(blocks, buffer_rows: int, seed):
+    rng = np.random.default_rng(seed)
+    buf: list = []
+    rows = 0
+    for b in blocks:
+        buf.append(b)
+        rows += BlockAccessor.for_block(b).num_rows()
+        if rows >= buffer_rows:
+            merged = concat_blocks(buf)
+            acc = BlockAccessor.for_block(merged)
+            yield acc.take(rng.permutation(acc.num_rows()))
+            buf, rows = [], 0
+    if buf:
+        merged = concat_blocks(buf)
+        acc = BlockAccessor.for_block(merged)
+        yield acc.take(rng.permutation(acc.num_rows()))
+
+
+def _rebatch(blocks, batch_size, batch_format, drop_last):
+    """Slice a stream of blocks into fixed-size batches across block
+    boundaries (reference: `_internal/block_batching/iter_batches.py`)."""
+    if batch_size is None:
+        for b in blocks:
+            acc = BlockAccessor.for_block(b)
+            if acc.num_rows():
+                yield acc.to_batch(batch_format)
+        return
+    pending: list = []
+    pending_rows = 0
+    for b in blocks:
+        pending.append(b)
+        pending_rows += BlockAccessor.for_block(b).num_rows()
+        while pending_rows >= batch_size:
+            merged = concat_blocks(pending)
+            acc = BlockAccessor.for_block(merged)
+            yield BlockAccessor.for_block(
+                acc.slice(0, batch_size)).to_batch(batch_format)
+            rest = acc.slice(batch_size, acc.num_rows())
+            pending = [rest]
+            pending_rows = BlockAccessor.for_block(rest).num_rows()
+    if pending_rows and not drop_last:
+        merged = concat_blocks(pending)
+        acc = BlockAccessor.for_block(merged)
+        if acc.num_rows():
+            yield acc.to_batch(batch_format)

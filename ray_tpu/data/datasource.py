@@ -1,0 +1,193 @@
+"""Datasources: pluggable readers/writers producing ReadTasks.
+
+Counterpart of the reference's `data/datasource/` (parquet, csv, json,
+text, numpy, binary, range). A ReadTask is a zero-arg callable returning
+one block; it runs inside a worker task so IO parallelizes and the driver
+never touches file bytes.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable
+
+import numpy as np
+
+
+class ReadTask:
+    """Callable producing one block, with file provenance for metadata."""
+
+    def __init__(self, fn: Callable, input_files: list | None = None):
+        self._fn = fn
+        self.input_files = input_files
+
+    def __call__(self):
+        return self._fn()
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def _chunk(files: list, parallelism: int) -> list[list]:
+    parallelism = max(1, min(parallelism, len(files)))
+    bounds = np.linspace(0, len(files), parallelism + 1).astype(int)
+    return [files[bounds[i]:bounds[i + 1]] for i in range(parallelism)
+            if bounds[i] < bounds[i + 1]]
+
+
+class Datasource:
+    """Subclass hook-point (reference: `datasource.py` Datasource)."""
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        raise NotImplementedError
+
+    def write(self, block, path: str, **kwargs):
+        raise NotImplementedError
+
+
+class FileBasedDatasource(Datasource):
+    def __init__(self, paths, **read_kwargs):
+        self._files = _expand_paths(paths)
+        self._kwargs = read_kwargs
+
+    def _read_files(self, files: list) -> object:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        return [
+            ReadTask((lambda fs=fs: self._read_files(fs)), input_files=fs)
+            for fs in _chunk(self._files, parallelism)
+        ]
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def _read_files(self, files):
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+        tables = [pq.read_table(f, **self._kwargs) for f in files]
+        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_files(self, files):
+        import pyarrow as pa
+        from pyarrow import csv as pacsv
+        tables = [pacsv.read_csv(f, **self._kwargs) for f in files]
+        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSONL (newline-delimited) via pyarrow.json."""
+
+    def _read_files(self, files):
+        import pyarrow as pa
+        from pyarrow import json as pajson
+        tables = [pajson.read_json(f, **self._kwargs) for f in files]
+        return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_files(self, files):
+        lines = []
+        for f in files:
+            with open(f, "r", encoding=self._kwargs.get("encoding", "utf-8"),
+                      errors="replace") as fh:
+                lines.extend(l.rstrip("\n") for l in fh)
+        return {"text": np.asarray(lines, dtype=object)}
+
+
+class NumpyDatasource(FileBasedDatasource):
+    def _read_files(self, files):
+        arrs = [np.load(f, allow_pickle=False) for f in files]
+        return {"data": np.concatenate(arrs) if len(arrs) > 1 else arrs[0]}
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_files(self, files):
+        blobs, names = [], []
+        for f in files:
+            with open(f, "rb") as fh:
+                blobs.append(fh.read())
+            names.append(f)
+        return {"bytes": np.asarray(blobs, dtype=object),
+                "path": np.asarray(names, dtype=object)}
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, tensor_shape=None):
+        self._n = n
+        self._shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        parallelism = max(1, min(parallelism, max(self._n, 1)))
+        bounds = np.linspace(0, self._n, parallelism + 1).astype(int)
+        tasks = []
+        shape = self._shape
+        for i in range(parallelism):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            if lo >= hi and self._n > 0:
+                continue
+
+            def make(lo=lo, hi=hi):
+                ids = np.arange(lo, hi)
+                if shape is None:
+                    return {"id": ids}
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)),
+                    (hi - lo,) + tuple(shape)).copy()
+                return {"data": data}
+            tasks.append(ReadTask(make))
+        return tasks or [ReadTask(lambda: {"id": np.arange(0)})]
+
+
+# -- writers (one file per block, run inside write tasks) -------------------
+
+def write_parquet_block(block, path_dir, block_idx, **kwargs):
+    import pyarrow.parquet as pq
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path_dir, exist_ok=True)
+    table = BlockAccessor.for_block(block).to_arrow()
+    pq.write_table(table,
+                   os.path.join(path_dir, f"part-{block_idx:05d}.parquet"),
+                   **kwargs)
+
+
+def write_csv_block(block, path_dir, block_idx, **kwargs):
+    from pyarrow import csv as pacsv
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path_dir, exist_ok=True)
+    table = BlockAccessor.for_block(block).to_arrow()
+    pacsv.write_csv(table,
+                    os.path.join(path_dir, f"part-{block_idx:05d}.csv"))
+
+
+def write_json_block(block, path_dir, block_idx, **kwargs):
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path_dir, exist_ok=True)
+    df = BlockAccessor.for_block(block).to_pandas()
+    df.to_json(os.path.join(path_dir, f"part-{block_idx:05d}.json"),
+               orient="records", lines=True)
+
+
+def write_numpy_block(block, path_dir, block_idx, column="data", **kwargs):
+    from ray_tpu.data.block import BlockAccessor
+    os.makedirs(path_dir, exist_ok=True)
+    cols = BlockAccessor.for_block(block).to_numpy()
+    np.save(os.path.join(path_dir, f"part-{block_idx:05d}.npy"),
+            cols[column])

@@ -1,0 +1,134 @@
+"""Read/creation API (reference: `data/read_api.py`: read_parquet :505,
+read_csv :898, range :120, from_items :1611, from_pandas :1656,
+from_numpy :1705, from_arrow :1724, from_huggingface :1748)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import datasource as dsrc
+from ray_tpu.data._internal import plan as plan_mod
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+
+
+_builtin_range = range
+
+
+def _auto_parallelism(parallelism: int) -> int:
+    if parallelism and parallelism > 0:
+        return parallelism
+    ctx = DataContext.get_current()
+    if ctx.read_parallelism and ctx.read_parallelism > 0:
+        return ctx.read_parallelism
+    try:
+        cpus = ray_tpu.cluster_resources().get("CPU", 2)
+    except Exception:
+        cpus = 2
+    return max(2, int(cpus))
+
+
+def _from_datasource(ds: dsrc.Datasource, parallelism: int) -> Dataset:
+    tasks = ds.get_read_tasks(_auto_parallelism(parallelism))
+    return Dataset(plan_mod.ExecutionPlan(
+        [plan_mod.Read(read_tasks=tasks,
+                       input_files=getattr(ds, "_files", None))]))
+
+
+def read_datasource(ds: dsrc.Datasource, *, parallelism: int = -1,
+                    **_ignored) -> Dataset:
+    return _from_datasource(ds, parallelism)
+
+
+def read_parquet(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.ParquetDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.CSVDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.JSONDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.TextDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.NumpyDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _from_datasource(dsrc.BinaryDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return _from_datasource(dsrc.RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    return _from_datasource(dsrc.RangeDatasource(n, tensor_shape=shape),
+                            parallelism)
+
+
+def _input_data(blocks) -> Dataset:
+    pairs = []
+    for b in blocks:
+        meta = BlockAccessor.for_block(b).metadata()
+        pairs.append((ray_tpu.put(b), meta))
+    return Dataset(plan_mod.ExecutionPlan(
+        [plan_mod.InputData(blocks=pairs)]))
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    if items and not isinstance(items[0], dict):
+        items = [{"item": x} for x in items]
+    p = max(1, min(_auto_parallelism(parallelism), max(len(items), 1)))
+    bounds = np.linspace(0, len(items), p + 1).astype(int)
+    blocks = []
+    from ray_tpu.data.block import _rows_to_block
+    for i in _builtin_range(p):
+        chunk = items[bounds[i]:bounds[i + 1]]
+        if chunk:
+            blocks.append(_rows_to_block(chunk))
+    return _input_data(blocks or [{}])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _input_data(dfs)
+
+
+def from_numpy(arrs) -> Dataset:
+    if not isinstance(arrs, list):
+        arrs = [arrs]
+    return _input_data([{"data": np.asarray(a)} for a in arrs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _input_data(tables)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """datasets.Dataset -> Dataset via its arrow table."""
+    table = hf_dataset.data.table
+    return _input_data([table])
+
+
+def from_torch(torch_dataset) -> Dataset:
+    rows = [{"item": torch_dataset[i]}
+            for i in _builtin_range(len(torch_dataset))]
+    return from_items(rows)
