@@ -21,7 +21,6 @@ import shutil
 import tempfile
 import threading
 
-import jax
 import numpy as np
 
 _ORBAX_SUBDIR = "pytree"
@@ -50,6 +49,10 @@ def _cleanup_tmpdirs():
 
 
 def _is_array_tree(value) -> bool:
+    # jax imports lazily: Checkpoint is used by tune/experiment metadata
+    # paths that must stay JAX-free at import time (package docstring
+    # promise in ray_tpu/__init__.py).
+    import jax
     leaves = jax.tree.leaves(value)
     return bool(leaves) and all(
         isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
@@ -70,10 +73,12 @@ class Checkpoint:
     def from_dict(cls, data: dict) -> "Checkpoint":
         # Snapshot arrays to host numpy now: detaches from device buffers
         # (donation-safe) and makes the object picklable across processes.
-        snap = {
-            k: (jax.tree.map(np.asarray, v) if _is_array_tree(v) else v)
-            for k, v in data.items()
-        }
+        snap = {}
+        for k, v in data.items():
+            if _is_array_tree(v):
+                import jax
+                v = jax.tree.map(np.asarray, v)
+            snap[k] = v
         return cls(_data=snap)
 
     @classmethod

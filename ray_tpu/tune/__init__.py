@@ -1,0 +1,62 @@
+"""ray_tpu.tune — experiment runner / hyperparameter search.
+
+Counterpart of the reference's `python/ray/tune/` (SURVEY.md §2.6): the
+Tuner/tune.run APIs, Trainable class + function APIs, grid/random search
+with a pluggable Searcher seam, ASHA/HyperBand/median-stopping/PBT
+schedulers, per-trial checkpointing and experiment-level resume.
+"""
+
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Categorical,
+    ConcurrencyLimiter,
+    Domain,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    report,
+    with_parameters,
+    with_resources,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+from ray_tpu.tune.experiment import Trial
+
+__all__ = [
+    # search space
+    "uniform", "quniform", "loguniform", "qloguniform", "randint",
+    "qrandint", "lograndint", "choice", "sample_from", "randn",
+    "grid_search", "Domain", "Categorical",
+    # searchers
+    "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter",
+    # schedulers
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+    # trainable + session
+    "Trainable", "report", "get_checkpoint", "with_parameters",
+    "with_resources",
+    # runner
+    "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
+]

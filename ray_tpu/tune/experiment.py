@@ -1,0 +1,137 @@
+"""Trial state and experiment-level checkpointing.
+
+Counterpart of the reference's `tune/experiment/trial.py` (Trial state
+machine PENDING/RUNNING/PAUSED/TERMINATED/ERROR) and
+`tune/execution/experiment_state.py:98` (`_ExperimentCheckpointManager` —
+periodic experiment snapshots enabling `Tuner.restore`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+EXPERIMENT_STATE_FILE = "experiment_state.json"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict, experiment_dir: str,
+                 resources: Optional[dict] = None):
+        self.trial_id = trial_id
+        self.config = dict(config)
+        self.resources = dict(resources or {"CPU": 1.0})
+        self.status = PENDING
+        self.last_result: dict = {}
+        self.metrics_history: list = []
+        self.error: Optional[str] = None
+        self.num_failures = 0
+        self.local_dir = os.path.join(experiment_dir, f"trial_{trial_id}")
+        os.makedirs(self.local_dir, exist_ok=True)
+        # Latest persisted checkpoint (dict-backed checkpoints are written
+        # to disk on save so experiment resume survives a driver restart).
+        self.checkpoint_path: Optional[str] = None
+        # runtime-only fields (not persisted)
+        self.actor = None
+        self._pbt_exploit = None
+
+    # -- persistence ------------------------------------------------------
+
+    def persist_checkpoint(self, ckpt: Checkpoint, iteration: int) -> str:
+        path = os.path.join(self.local_dir, f"checkpoint_{iteration:06d}")
+        ckpt.to_directory(path)
+        self.checkpoint_path = path
+        return path
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if self.checkpoint_path and os.path.isdir(self.checkpoint_path):
+            return Checkpoint.from_directory(self.checkpoint_path)
+        return None
+
+    def to_state(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": _jsonable(self.config),
+            "resources": self.resources,
+            "status": self.status,
+            "last_result": _jsonable(self.last_result),
+            "error": self.error,
+            "num_failures": self.num_failures,
+            "checkpoint_path": self.checkpoint_path,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict, experiment_dir: str) -> "Trial":
+        t = cls(state["trial_id"], state["config"], experiment_dir,
+                state.get("resources"))
+        t.status = state["status"]
+        t.last_result = state.get("last_result", {})
+        t.error = state.get("error")
+        t.num_failures = state.get("num_failures", 0)
+        t.checkpoint_path = state.get("checkpoint_path")
+        if t.status in (RUNNING, PAUSED):
+            t.status = PENDING      # was in flight when the driver died
+        return t
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _jsonable(v) for k, v in obj.items()}
+        return repr(obj)
+
+
+def new_trial_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+class ExperimentState:
+    """Periodic snapshot of all trial states → experiment_state.json."""
+
+    def __init__(self, experiment_dir: str, save_period_s: float = 5.0):
+        self.experiment_dir = experiment_dir
+        os.makedirs(experiment_dir, exist_ok=True)
+        self.save_period_s = save_period_s
+        self._last_save = 0.0
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.experiment_dir, EXPERIMENT_STATE_FILE)
+
+    def save(self, trials: list, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_save < self.save_period_s:
+            return
+        self._last_save = now
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"timestamp": now,
+                       "trials": [t.to_state() for t in trials]}, f)
+        os.replace(tmp, self.path)
+
+    @classmethod
+    def load_trials(cls, experiment_dir: str) -> list:
+        path = os.path.join(experiment_dir, EXPERIMENT_STATE_FILE)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no experiment state at {path}; cannot restore")
+        with open(path) as f:
+            state = json.load(f)
+        return [Trial.from_state(s, experiment_dir)
+                for s in state["trials"]]

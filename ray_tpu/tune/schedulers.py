@@ -1,0 +1,263 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Counterparts of the reference's `tune/schedulers/`: FIFO (trial_scheduler.py),
+ASHA (`async_hyperband.py` — the recommended default), HyperBand
+(`hyperband.py`), median stopping (`median_stopping_rule.py`), and PBT
+(`pbt.py`). Decisions use the same CONTINUE/PAUSE/STOP contract.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+from ray_tpu.tune.trainable import TRAINING_ITERATION
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    metric: Optional[str] = None
+    mode: str = "max"
+
+    def set_metric(self, metric: Optional[str], mode: Optional[str]) -> None:
+        if self.metric is None:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]) -> None:
+        pass
+
+    def on_trial_remove(self, trial) -> None:
+        pass
+
+    def choose_trial_to_run(self, pending: List) -> Optional[object]:
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (the default)."""
+
+
+def _score(result: dict, metric: str, mode: str) -> float:
+    val = result.get(metric)
+    if val is None:
+        return -math.inf
+    return float(val) if mode == "max" else -float(val)
+
+
+class _Rung:
+    """One milestone of a successive-halving bracket."""
+
+    def __init__(self, milestone: float, rf: float):
+        self.milestone = milestone
+        self.rf = rf
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self) -> Optional[float]:
+        if not self.recorded:
+            return None
+        vals = sorted(self.recorded.values())
+        idx = int(len(vals) * (1 - 1 / self.rf))
+        return vals[min(idx, len(vals) - 1)]
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: `async_hyperband.py:27` AsyncHyperBandScheduler).
+
+    Each trial is assigned to a bracket; at every rung milestone the trial
+    must be in the top 1/reduction_factor of results recorded at that rung
+    or it is stopped. Asynchronous: no waiting for a full rung cohort.
+    """
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION,
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        self._brackets: List[List[_Rung]] = []
+        for s in range(brackets):
+            rungs = []
+            t = grace_period * (reduction_factor ** s)
+            while t < max_t:
+                rungs.append(_Rung(t, reduction_factor))
+                t *= reduction_factor
+            self._brackets.append(sorted(rungs, key=lambda r: -r.milestone))
+        self._trial_bracket: Dict[str, int] = {}
+        self._rng = random.Random(0)
+
+    def on_trial_add(self, trial) -> None:
+        self._trial_bracket[trial.trial_id] = (
+            self._rng.randrange(len(self._brackets)))
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return self.STOP
+        score = _score(result, self.metric, self.mode)
+        bracket = self._brackets[self._trial_bracket.get(trial.trial_id, 0)]
+        action = self.CONTINUE
+        for rung in bracket:
+            if t < rung.milestone or trial.trial_id in rung.recorded:
+                continue
+            cutoff = rung.cutoff()
+            rung.recorded[trial.trial_id] = score
+            if cutoff is not None and score < cutoff:
+                action = self.STOP
+            break
+        return action
+
+
+# The reference aliases this too (schedulers/__init__.py).
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Simplified synchronous-flavored HyperBand: ASHA brackets with
+    staggered aggressiveness (reference: `hyperband.py`; the async variant
+    is what the reference itself recommends, so this shares machinery)."""
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION,
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self._asha = AsyncHyperBandScheduler(
+            time_attr=time_attr, metric=metric, mode=mode, max_t=max_t,
+            grace_period=1, reduction_factor=reduction_factor,
+            brackets=max(1, int(math.log(max_t, reduction_factor))))
+
+    def set_metric(self, metric, mode) -> None:
+        self._asha.set_metric(metric, mode)
+        self.metric, self.mode = self._asha.metric, self._asha.mode
+
+    def on_trial_add(self, trial) -> None:
+        self._asha.on_trial_add(trial)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return self._asha.on_trial_result(trial, result)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running means at the same time step (reference:
+    `median_stopping_rule.py:18`)."""
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION,
+                 metric: Optional[str] = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        score = _score(result, self.metric, self.mode)
+        hist = self._history.setdefault(trial.trial_id, [])
+        hist.append(score)
+        if result.get(self.time_attr, 0) < self.grace:
+            return self.CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._history.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return self.CONTINUE
+        median = sorted(others)[len(others) // 2]
+        best = max(hist)
+        return self.STOP if best < median else self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: `pbt.py:135`): at each perturbation interval, trials
+    in the bottom quantile clone the checkpoint + config of a top-quantile
+    trial and perturb the hyperparameters (explore).
+
+    The controller performs the actual exploit (restore from the donor's
+    checkpoint + reset config); this class only decides and records it via
+    `trial._pbt_exploit = (donor_trial, new_config)`.
+    """
+
+    def __init__(self, time_attr: str = TRAINING_ITERATION,
+                 metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._scores: Dict[str, float] = {}
+        self._trials: Dict[str, object] = {}
+
+    def on_trial_add(self, trial) -> None:
+        self._trials[trial.trial_id] = trial
+        self._last_perturb[trial.trial_id] = 0
+
+    def on_trial_remove(self, trial) -> None:
+        self._trials.pop(trial.trial_id, None)
+        self._scores.pop(trial.trial_id, None)
+
+    on_trial_complete = lambda self, trial, result: self.on_trial_remove(trial)  # noqa: E731
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or key not in new:
+                if callable(spec):
+                    new[key] = spec()
+                elif isinstance(spec, list):
+                    new[key] = self._rng.choice(spec)
+                elif hasattr(spec, "sample"):
+                    new[key] = spec.sample(self._rng)
+            else:
+                cur = new[key]
+                if isinstance(spec, list):
+                    # move to a neighboring listed value
+                    try:
+                        i = spec.index(cur)
+                        j = max(0, min(len(spec) - 1,
+                                       i + self._rng.choice([-1, 1])))
+                        new[key] = spec[j]
+                    except ValueError:
+                        new[key] = self._rng.choice(spec)
+                elif isinstance(cur, (int, float)):
+                    factor = self._rng.choice([0.8, 1.2])
+                    new[key] = type(cur)(cur * factor) or cur
+        return new
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        self._scores[trial.trial_id] = _score(result, self.metric, self.mode)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return self.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scored = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(scored)
+        k = max(1, int(n * self.quantile))
+        if n < 2 or k * 2 > n:
+            return self.CONTINUE
+        bottom = {tid for tid, _ in scored[:k]}
+        top = [tid for tid, _ in scored[-k:]]
+        if trial.trial_id in bottom:
+            donor_id = self._rng.choice(top)
+            donor = self._trials.get(donor_id)
+            if donor is not None and donor is not trial:
+                trial._pbt_exploit = (donor, self._explore(donor.config))
+        return self.CONTINUE

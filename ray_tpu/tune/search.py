@@ -1,0 +1,260 @@
+"""Search spaces and search algorithms.
+
+Counterpart of the reference's `tune/search/` package: sample domains
+(`tune/search/sample.py` — Float/Integer/Categorical/Function), the
+grid/random `BasicVariantGenerator` (`tune/search/basic_variant.py`), the
+`Searcher` interface (`tune/search/searcher.py`) and `ConcurrencyLimiter`
+(`tune/search/concurrency_limiter.py`).
+
+The external-library wrappers the reference ships (optuna/hyperopt/...) are
+deliberately not vendored; `Searcher` is the plug-in seam for them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+# ---------------------------------------------------------------------------
+# Sample domains (reference: tune/search/sample.py)
+# ---------------------------------------------------------------------------
+
+
+class Domain:
+    """A distribution to sample a hyperparameter from."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, *, log: bool = False,
+                 q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform needs a positive lower bound")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> float:
+        import math
+        if self.log:
+            val = math.exp(rng.uniform(math.log(self.lower),
+                                       math.log(self.upper)))
+        else:
+            val = rng.uniform(self.lower, self.upper)
+        if self.q:
+            val = round(round(val / self.q) * self.q, 10)
+        return val
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, *, log: bool = False,
+                 q: int = 1):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> int:
+        import math
+        if self.log:
+            val = int(math.exp(rng.uniform(math.log(max(self.lower, 1)),
+                                           math.log(self.upper))))
+        else:
+            # upper is exclusive, matching the reference's randint.
+            val = rng.randrange(self.lower, self.upper)
+        if self.q > 1:
+            val = int(round(val / self.q) * self.q)
+        return max(self.lower, min(val, self.upper - 1))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    """`sample_from`: arbitrary callable, optionally of the partial spec."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng: random.Random, spec: Optional[dict] = None) -> Any:
+        try:
+            return self.fn(spec)
+        except TypeError:
+            return self.fn()
+
+
+# Public constructors (reference exposes these on `ray.tune`).
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, q=q)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Function:
+    return Function(lambda: random.gauss(mean, sd))
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Marker dict, identical shape to the reference's
+    (`tune/search/variant_generator.py` looks for {"grid_search": [...]})."""
+    return {"grid_search": list(values)}
+
+
+# ---------------------------------------------------------------------------
+# Variant generation (reference: tune/search/variant_generator.py)
+# ---------------------------------------------------------------------------
+
+
+def _is_grid(value: Any) -> bool:
+    return isinstance(value, dict) and set(value.keys()) == {"grid_search"}
+
+
+def _walk(spec: Any, path=()):
+    """Yield (path, leaf) for every leaf of a nested dict."""
+    if isinstance(spec, dict) and not _is_grid(spec):
+        for k, v in spec.items():
+            yield from _walk(v, path + (k,))
+    else:
+        yield path, spec
+
+
+def _set_path(spec: dict, path, value) -> None:
+    node = spec
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def generate_variants(param_space: dict, num_samples: int,
+                      seed: Optional[int] = None) -> Iterator[dict]:
+    """Expand grid axes × num_samples random draws of the sample domains.
+
+    Matches the reference's semantics: `num_samples` multiplies the grid
+    (`basic_variant.py`: each sample iterates the full grid).
+    """
+    rng = random.Random(seed)
+    leaves = list(_walk(param_space))
+    grid_axes = [(p, v["grid_search"]) for p, v in leaves if _is_grid(v)]
+    sample_leaves = [(p, v) for p, v in leaves if isinstance(v, Domain)]
+    const_leaves = [(p, v) for p, v in leaves
+                    if not _is_grid(v) and not isinstance(v, Domain)]
+
+    grid_paths = [p for p, _ in grid_axes]
+    grid_values = [vals for _, vals in grid_axes]
+    for _ in range(num_samples):
+        for combo in itertools.product(*grid_values) if grid_values else [()]:
+            cfg: dict = {}
+            for p, v in const_leaves:
+                _set_path(cfg, p, v)
+            for p, v in zip(grid_paths, combo):
+                _set_path(cfg, p, v)
+            for p, dom in sample_leaves:
+                if isinstance(dom, Function):
+                    _set_path(cfg, p, dom.sample(rng, cfg))
+                else:
+                    _set_path(cfg, p, dom.sample(rng))
+            yield cfg
+
+
+def count_variants(param_space: dict, num_samples: int) -> int:
+    n = num_samples
+    for _, v in _walk(param_space):
+        if _is_grid(v):
+            n *= len(v["grid_search"])
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Searcher interface (reference: tune/search/searcher.py)
+# ---------------------------------------------------------------------------
+
+
+class Searcher:
+    """Suggest-based search algorithm. Subclass to plug in BO/TPE/etc."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        """Next config, or None when the search space is exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid + random search (the reference's default searcher)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        super().__init__()
+        self._it = generate_variants(param_space, num_samples, seed)
+        self.total = count_variants(param_space, num_samples)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        return next(self._it, None)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions from a wrapped searcher
+    (reference: tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None     # controller retries later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,263 @@
+"""Trainable API: the unit of work a Tune trial executes.
+
+Counterpart of the reference's `tune/trainable/trainable.py:68` (class
+Trainable: setup/step/save_checkpoint/load_checkpoint, driven by
+train()/save()/restore()) and `tune/trainable/function_trainable.py:292`
+(user function running in a thread, reports bridged through a queue — the
+same concurrency shape as the Train session, which we reuse directly).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+import queue as _queue
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+# Result bookkeeping keys (reference: tune/result.py)
+TRAINING_ITERATION = "training_iteration"
+DONE = "done"
+TRIAL_ID = "trial_id"
+TIME_TOTAL_S = "time_total_s"
+
+
+class Trainable:
+    """Class API: subclass and implement setup/step/save/load_checkpoint.
+
+    train() is called repeatedly by the controller; each call returns one
+    result dict (one "iteration").
+    """
+
+    def __init__(self, config: dict | None = None, trial_dir: str | None = None):
+        self.config = dict(config or {})
+        self._iteration = 0
+        self._time_total = 0.0
+        self._trial_dir = trial_dir or os.getcwd()
+        self.setup(self.config)
+
+    # -- subclass surface -------------------------------------------------
+
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> dict | str | None:
+        """Return a dict (stored for you) or write files into
+        checkpoint_dir and return it."""
+        return None
+
+    def load_checkpoint(self, checkpoint: dict | str) -> None:
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """Return True if the trainable reconfigured in place (lets PBT
+        reuse the actor; reference: trainable.py reset_config)."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- controller surface ----------------------------------------------
+
+    @property
+    def iteration(self) -> int:
+        return self._iteration
+
+    @property
+    def trial_dir(self) -> str:
+        return self._trial_dir
+
+    def train(self) -> dict:
+        start = time.time()
+        result = self.step() or {}
+        self._iteration += 1
+        self._time_total += time.time() - start
+        result.setdefault(TRAINING_ITERATION, self._iteration)
+        result.setdefault(TIME_TOTAL_S, self._time_total)
+        result.setdefault(DONE, False)
+        return result
+
+    def save(self) -> Checkpoint:
+        ckpt_dir = os.path.join(
+            self._trial_dir, f"checkpoint_{self._iteration:06d}")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        data = self.save_checkpoint(ckpt_dir)
+        if isinstance(data, dict):
+            ckpt = Checkpoint.from_dict(
+                {**data, "_tune_iteration": self._iteration})
+        else:
+            ckpt = Checkpoint.from_directory(data or ckpt_dir)
+        return ckpt
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        try:
+            data = checkpoint.to_dict()
+            self._iteration = int(data.pop("_tune_iteration", 0))
+            self.load_checkpoint(data)
+        except (ValueError, NotImplementedError, FileNotFoundError):
+            self.load_checkpoint(checkpoint.as_directory())
+
+    def reset(self, new_config: dict) -> bool:
+        ok = self.reset_config(dict(new_config))
+        if ok:
+            self.config = dict(new_config)
+        return ok
+
+    def stop(self) -> None:
+        self.cleanup()
+
+
+class FunctionTrainable(Trainable):
+    """Wraps `fn(config)` that calls `ray_tpu.tune.report(...)`.
+
+    The function runs in a daemon thread; train() blocks until the next
+    report (or function return, which yields a final done=True result) —
+    the reference's `function_trainable.py` shape, minus its Tune/Train
+    session duplication.
+    """
+
+    _fn = None          # set by subclassing in wrap_function
+
+    def setup(self, config: dict) -> None:
+        self._queue: _queue.Queue = _queue.Queue(1)
+        self._consumed = threading.Semaphore(0)
+        self._stop_event = threading.Event()
+        self._error: list = []
+        self._restore_checkpoint: Checkpoint | None = None
+        self._last_report_checkpoint: Checkpoint | None = None
+        self._last_metrics: dict = {}
+        self._thread: threading.Thread | None = None
+
+    def _runner(self) -> None:
+        _session._install(self)
+        try:
+            self._fn(self.config)
+            self._queue.put(("return", None))
+        except SystemExit:
+            self._queue.put(("return", None))
+        except BaseException:       # surfaces in train() as an error result
+            self._error.append(traceback.format_exc())
+            self._queue.put(("error", None))
+
+    # called from the user thread via tune.report
+    def _report(self, metrics: dict, checkpoint=None) -> None:
+        if self._stop_event.is_set():
+            raise SystemExit(0)
+        if checkpoint is not None and not isinstance(checkpoint, Checkpoint):
+            checkpoint = Checkpoint.from_dict(dict(checkpoint))
+        self._last_report_checkpoint = checkpoint
+        self._queue.put(("report", {"metrics": dict(metrics),
+                                    "checkpoint": checkpoint}))
+        self._consumed.acquire()
+
+    def _get_checkpoint(self) -> Checkpoint | None:
+        return self._restore_checkpoint
+
+    def step(self) -> dict:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._runner, daemon=True)
+            self._thread.start()
+        kind, payload = self._queue.get()
+        if kind == "return":
+            # Function finished: final result = last reported metrics,
+            # flagged done (reference: function_trainable.py final report).
+            return {**self._last_metrics, DONE: True}
+        if kind == "error":
+            raise RuntimeError(self._error[0])
+        metrics = payload["metrics"]
+        self._last_metrics = dict(metrics)
+        self._consumed.release()
+        return metrics
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        if self._last_report_checkpoint is not None:
+            return dict(self._last_report_checkpoint.to_dict())
+        return {"_no_user_checkpoint": True}
+
+    def load_checkpoint(self, checkpoint) -> None:
+        if isinstance(checkpoint, dict):
+            checkpoint = {k: v for k, v in checkpoint.items()
+                          if k != "_no_user_checkpoint"}
+            self._restore_checkpoint = (
+                Checkpoint.from_dict(checkpoint) if checkpoint else None)
+        else:
+            self._restore_checkpoint = Checkpoint.from_directory(checkpoint)
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self._consumed.release()        # unblock a pending report
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.cleanup()
+
+
+class _Session:
+    """Worker-side singleton bridging tune.report to the live trainable."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def _install(self, trainable: FunctionTrainable) -> None:
+        self._local.trainable = trainable
+
+    def _get(self) -> FunctionTrainable:
+        t = getattr(self._local, "trainable", None)
+        if t is None:
+            raise RuntimeError(
+                "tune.report() may only be called inside a Tune trial")
+        return t
+
+    def report(self, metrics: dict, checkpoint=None) -> None:
+        self._get()._report(metrics, checkpoint)
+
+    def get_checkpoint(self) -> Checkpoint | None:
+        return self._get()._get_checkpoint()
+
+
+_session = _Session()
+
+
+def report(metrics: dict | None = None, *, checkpoint=None, **kwargs) -> None:
+    """`tune.report` (reference exposes both kwargs and dict forms)."""
+    merged = dict(metrics or {})
+    merged.update(kwargs)
+    _session.report(merged, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    return _session.get_checkpoint()
+
+
+def wrap_function(fn) -> type:
+    """Build a FunctionTrainable subclass for `fn` (reference:
+    function_trainable.py wrap_function)."""
+    name = getattr(fn, "__name__", "func")
+    return type(f"FunctionTrainable_{name}", (FunctionTrainable,),
+                {"_fn": staticmethod(fn)})
+
+
+def with_parameters(fn, **heavy_kwargs):
+    """Bind large objects by reference so they're put in the object store
+    once (reference: tune/trainable/util.py with_parameters)."""
+    import functools
+    import ray_tpu
+    refs = {k: ray_tpu.put(v) for k, v in heavy_kwargs.items()}
+
+    @functools.wraps(fn)
+    def inner(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return fn(config, **resolved)
+
+    return inner
+
+
+def with_resources(trainable, resources: dict):
+    """Attach per-trial resource requests (reference: tune.with_resources)."""
+    target = trainable
+    setattr(target, "_tune_resources", dict(resources))
+    return target

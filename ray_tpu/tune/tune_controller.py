@@ -1,0 +1,325 @@
+"""Trial execution loop.
+
+Counterpart of the reference's `tune/execution/tune_controller.py:49`
+(actor-manager-based TuneController) and `ray_trial_executor.py:188`:
+every trial runs inside a dedicated actor; the controller is an event loop
+over in-flight `train()` futures — process a result, consult the
+scheduler, launch/stop/restore trials, snapshot experiment state.
+
+Simplifications vs the reference, on purpose: one in-flight future per
+trial (the reference multiplexes arbitrary actor calls), and checkpoints
+save synchronously (cheap at trial granularity).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as _exc
+from ray_tpu.tune import experiment as _exp
+from ray_tpu.tune.experiment import (
+    ERROR, PENDING, RUNNING, TERMINATED, ExperimentState, Trial)
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import Searcher
+from ray_tpu.tune.trainable import DONE, TRAINING_ITERATION
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+class _TrialExecutor:
+    """The per-trial actor (reference: each Trainable IS an actor)."""
+
+    def __init__(self, trainable_cls, config, trial_id, trial_dir):
+        self.trainable = trainable_cls(config, trial_dir)
+        self.trial_id = trial_id
+
+    def ready(self):
+        return True
+
+    def train(self) -> dict:
+        result = self.trainable.train()
+        result.setdefault("trial_id", self.trial_id)
+        return result
+
+    def save(self):
+        return self.trainable.save()
+
+    def restore(self, checkpoint) -> None:
+        self.trainable.restore(checkpoint)
+
+    def reset(self, new_config: dict) -> bool:
+        return self.trainable.reset(new_config)
+
+    def stop(self) -> None:
+        self.trainable.stop()
+
+
+class TuneController:
+    def __init__(self,
+                 trainable_cls,
+                 trials: List[Trial],
+                 experiment_dir: str,
+                 scheduler: Optional[TrialScheduler] = None,
+                 searcher: Optional[Searcher] = None,
+                 metric: Optional[str] = None,
+                 mode: str = "max",
+                 stop: Optional[dict] = None,
+                 max_concurrent: Optional[int] = None,
+                 max_failures: int = 0,
+                 checkpoint_frequency: int = 0,
+                 checkpoint_at_end: bool = False,
+                 callbacks: Optional[list] = None):
+        self.trainable_cls = trainable_cls
+        self.trials = list(trials)
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_metric(metric, mode)
+        self.searcher = searcher
+        self.metric, self.mode = metric, mode
+        self.stop_criteria = dict(stop or {})
+        self.max_failures = max_failures
+        self.checkpoint_frequency = checkpoint_frequency
+        self.checkpoint_at_end = checkpoint_at_end
+        self.callbacks = list(callbacks or [])
+        self.state = ExperimentState(experiment_dir)
+        self.experiment_dir = experiment_dir
+        if max_concurrent is None:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            per_trial = max(t.resources.get("CPU", 1.0)
+                            for t in self.trials) if self.trials else 1.0
+            max_concurrent = max(1, int(cpus // max(per_trial, 0.001)))
+        self.max_concurrent = max_concurrent
+        self._futures: Dict[object, Trial] = {}   # train() future -> trial
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> List[Trial]:
+        for t in self.trials:
+            if t.status == PENDING:
+                self.scheduler.on_trial_add(t)
+        for cb in self.callbacks:
+            _safe(cb, "on_experiment_start", trials=self.trials)
+        try:
+            while not self._finished():
+                self._launch_pending()
+                if not self._futures:
+                    if self._has_pending():
+                        time.sleep(0.05)
+                        continue
+                    break
+                self._process_one_event()
+                self.state.save(self.trials)
+        finally:
+            self._cleanup()
+            self.state.save(self.trials, force=True)
+            for cb in self.callbacks:
+                _safe(cb, "on_experiment_end", trials=self.trials)
+        return self.trials
+
+    # ------------------------------------------------------------------
+
+    def _finished(self) -> bool:
+        return all(t.status in (TERMINATED, ERROR) for t in self.trials) \
+            and not self._futures
+
+    def _has_pending(self) -> bool:
+        return any(t.status == PENDING for t in self.trials)
+
+    def _running_count(self) -> int:
+        return sum(1 for t in self.trials if t.status == RUNNING)
+
+    def _launch_pending(self) -> None:
+        while self._running_count() < self.max_concurrent:
+            pending = [t for t in self.trials if t.status == PENDING]
+            trial = self.scheduler.choose_trial_to_run(pending)
+            if trial is None:
+                break
+            self._start_trial(trial)
+
+    def _start_trial(self, trial: Trial) -> None:
+        actor_cls = ray_tpu.remote(**_actor_opts(trial.resources))(
+            _TrialExecutor)
+        trial.actor = actor_cls.remote(
+            self.trainable_cls, trial.config, trial.trial_id,
+            trial.local_dir)
+        ckpt = trial.latest_checkpoint()
+        if ckpt is not None:
+            trial.actor.restore.remote(ckpt)
+        trial.status = RUNNING
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_start", trial=trial)
+        self._submit_train(trial)
+
+    def _submit_train(self, trial: Trial) -> None:
+        fut = trial.actor.train.remote()
+        self._futures[fut] = trial
+
+    def _process_one_event(self) -> None:
+        ready, _ = ray_tpu.wait(list(self._futures), num_returns=1,
+                                timeout=60.0)
+        if not ready:
+            return
+        fut = ready[0]
+        trial = self._futures.pop(fut)
+        try:
+            result = ray_tpu.get(fut)
+        except (_exc.TaskError, _exc.ActorDiedError,
+                _exc.WorkerCrashedError, _exc.RayTpuError) as e:
+            self._handle_failure(trial, e)
+            return
+        self._handle_result(trial, result)
+
+    # ------------------------------------------------------------------
+
+    def _handle_result(self, trial: Trial, result: dict) -> None:
+        trial.last_result = result
+        trial.metrics_history.append(result)
+        if self.searcher is not None:
+            self.searcher.on_trial_result(trial.trial_id, result)
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_result", trial=trial, result=result)
+
+        it = result.get(TRAINING_ITERATION, 0)
+        if (self.checkpoint_frequency
+                and it % self.checkpoint_frequency == 0
+                and not result.get(DONE)):
+            self._save_now(trial)
+
+        if result.get(DONE) or self._hit_stop_criteria(result):
+            self._stop_trial(trial, TERMINATED, result)
+            return
+
+        decision = self.scheduler.on_trial_result(trial, result)
+        if decision == TrialScheduler.STOP:
+            self._stop_trial(trial, TERMINATED, result)
+            return
+
+        exploit = getattr(trial, "_pbt_exploit", None)
+        if exploit is not None:
+            trial._pbt_exploit = None
+            self._exploit(trial, *exploit)
+        self._submit_train(trial)
+
+    def _hit_stop_criteria(self, result: dict) -> bool:
+        for key, threshold in self.stop_criteria.items():
+            val = result.get(key)
+            if val is None:
+                continue
+            if key == TRAINING_ITERATION or key.startswith("time_"):
+                if val >= threshold:
+                    return True
+            elif (self.mode == "max" and val >= threshold) or \
+                 (self.mode == "min" and val <= threshold):
+                return True
+        return False
+
+    def _save_now(self, trial: Trial) -> None:
+        try:
+            ckpt = ray_tpu.get(trial.actor.save.remote(), timeout=120)
+            it = trial.last_result.get(TRAINING_ITERATION, 0)
+            trial.persist_checkpoint(ckpt, it)
+        except _exc.RayTpuError as e:
+            logger.warning("checkpoint save failed for %s: %s",
+                           trial.trial_id, e)
+
+    def _exploit(self, trial: Trial, donor: Trial, new_config: dict) -> None:
+        """PBT exploit+explore: clone donor weights, adopt mutated config."""
+        if donor.actor is None:
+            return
+        try:
+            ckpt = ray_tpu.get(donor.actor.save.remote(), timeout=120)
+            ok = ray_tpu.get(trial.actor.reset.remote(new_config),
+                             timeout=60)
+            if not ok:
+                # Recreate the actor with the new config (the reference
+                # falls back to a fresh actor when reset_config declines).
+                ray_tpu.get(trial.actor.stop.remote(), timeout=30)
+                ray_tpu.kill(trial.actor)
+                actor_cls = ray_tpu.remote(
+                    **_actor_opts(trial.resources))(_TrialExecutor)
+                trial.actor = actor_cls.remote(
+                    self.trainable_cls, new_config, trial.trial_id,
+                    trial.local_dir)
+            ray_tpu.get(trial.actor.restore.remote(ckpt), timeout=120)
+            trial.config = dict(new_config)
+            logger.info("PBT: trial %s exploited %s", trial.trial_id,
+                        donor.trial_id)
+        except _exc.RayTpuError as e:
+            logger.warning("PBT exploit failed for %s: %s",
+                           trial.trial_id, e)
+
+    def _handle_failure(self, trial: Trial, err: Exception) -> None:
+        trial.num_failures += 1
+        trial.error = str(err)
+        logger.warning("trial %s failed (%d): %s", trial.trial_id,
+                       trial.num_failures, err)
+        self._kill_actor(trial)
+        unlimited = self.max_failures < 0
+        if unlimited or trial.num_failures <= self.max_failures:
+            trial.status = PENDING      # relaunched; restores from ckpt
+        else:
+            trial.status = ERROR
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, error=True)
+            self.scheduler.on_trial_complete(trial, None)
+            for cb in self.callbacks:
+                _safe(cb, "on_trial_error", trial=trial)
+
+    def _stop_trial(self, trial: Trial, status: str, result: dict) -> None:
+        if self.checkpoint_at_end:
+            self._save_now(trial)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id, result)
+        self.scheduler.on_trial_complete(trial, result)
+        self._kill_actor(trial)
+        trial.status = status
+        for cb in self.callbacks:
+            _safe(cb, "on_trial_complete", trial=trial, result=result)
+
+    def _kill_actor(self, trial: Trial) -> None:
+        if trial.actor is None:
+            return
+        # Drop any orphaned future for this trial.
+        for fut, t in list(self._futures.items()):
+            if t is trial:
+                del self._futures[fut]
+        try:
+            ray_tpu.get(trial.actor.stop.remote(), timeout=10)
+        except _exc.RayTpuError:
+            pass
+        try:
+            ray_tpu.kill(trial.actor)
+        except _exc.RayTpuError:
+            pass
+        trial.actor = None
+
+    def _cleanup(self) -> None:
+        for trial in self.trials:
+            if trial.actor is not None:
+                self._kill_actor(trial)
+            if trial.status == RUNNING:
+                trial.status = TERMINATED
+
+
+def _actor_opts(resources: dict) -> dict:
+    opts = {}
+    res = dict(resources)
+    if "CPU" in res:
+        opts["num_cpus"] = res.pop("CPU")
+    if "TPU" in res:
+        opts["num_tpus"] = res.pop("TPU")
+    if res:
+        opts["resources"] = res
+    return opts
+
+
+def _safe(cb, method: str, **kwargs) -> None:
+    fn = getattr(cb, method, None)
+    if fn is None:
+        return
+    try:
+        fn(**kwargs)
+    except Exception:       # callbacks must never kill the experiment
+        logger.exception("callback %s.%s failed", cb, method)

@@ -1,0 +1,239 @@
+"""Tuner — the user-facing experiment API.
+
+Counterpart of the reference's `tune/tuner.py:53` (Tuner.fit :320), the
+functional `tune.run` (`tune/tune.py:293`), `TuneConfig`
+(`tune/tune_config.py`), and `ResultGrid` (`tune/result_grid.py`).
+
+Also the integration seam with the Train-equivalent: passing a
+`JaxTrainer` to Tuner sweeps its `train_loop_config` — but unlike the
+reference (where Train.fit secretly routes THROUGH Tune,
+`base_trainer.py:570`), the coupling here points one way: Tune wraps
+Train (SURVEY.md §7.2 M6).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Type, Union
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.trainer import Result
+from ray_tpu.tune.experiment import (
+    ERROR, ExperimentState, Trial, new_trial_id)
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import (
+    BasicVariantGenerator, Searcher, count_variants, generate_variants)
+from ray_tpu.tune.trainable import (
+    Trainable, wrap_function)
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    # stop criteria dict (e.g. {"training_iteration": 10}); the reference
+    # puts this on tune.run / RunConfig.stop.
+    stop: Optional[dict] = None
+
+
+class ResultGrid:
+    """Reference: tune/result_grid.py."""
+
+    def __init__(self, results: List[Result], trials: List[Trial],
+                 experiment_path: str):
+        self._results = results
+        self._trials = trials
+        self.experiment_path = experiment_path
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.status == ERROR]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: str = "max") -> Result:
+        scored = [r for r in self._results
+                  if metric is None or metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = (lambda r: r.metrics.get(metric, float("-inf"))) \
+            if metric else (lambda r: 0)
+        return (max if mode == "max" else min)(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for t, r in zip(self._trials, self._results):
+            row = {f"config/{k}": v for k, v in t.config.items()
+                   if not isinstance(v, dict)}
+            row.update(r.metrics)
+            row["trial_id"] = t.trial_id
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self,
+                 trainable: Union[Callable, Type[Trainable], object] = None,
+                 *,
+                 param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _restore_path: Optional[str] = None):
+        self.trainable = trainable
+        self.param_space = dict(param_space or {})
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restore_path = _restore_path
+
+    @classmethod
+    def restore(cls, path: str, trainable=None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore, experiment_state.py:441)."""
+        return cls(trainable, _restore_path=path)
+
+    # ------------------------------------------------------------------
+
+    def _resolve_trainable(self):
+        """(trainable_cls, default_resources)."""
+        t = self.trainable
+        resources = dict(getattr(t, "_tune_resources", {"CPU": 1.0}))
+        # JaxTrainer instance → function trainable that runs trainer.fit()
+        # inside the trial with the sampled config merged in.
+        from ray_tpu.train.trainer import JaxTrainer
+        if isinstance(t, JaxTrainer):
+            return _trainer_as_trainable(t), resources
+        if inspect.isclass(t) and issubclass(t, Trainable):
+            return t, resources
+        if callable(t):
+            return wrap_function(t), resources
+        raise TypeError(f"cannot tune {t!r}")
+
+    def _make_trials(self, experiment_dir: str,
+                     resources: dict) -> List[Trial]:
+        tc = self.tune_config
+        if tc.search_alg is not None:
+            trials = []
+            tid = new_trial_id()
+            total = tc.num_samples
+            while len(trials) < total:
+                cfg = tc.search_alg.suggest(tid)
+                if cfg is None:
+                    break
+                trials.append(Trial(tid, cfg, experiment_dir, resources))
+                tid = new_trial_id()
+            return trials
+        return [
+            Trial(new_trial_id(), cfg, experiment_dir, resources)
+            for cfg in generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+        ]
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        trainable_cls, resources = self._resolve_trainable()
+        if self._restore_path:
+            experiment_dir = self._restore_path
+            trials = ExperimentState.load_trials(experiment_dir)
+        else:
+            experiment_dir = self.run_config.resolved_storage_path()
+            os.makedirs(experiment_dir, exist_ok=True)
+            trials = self._make_trials(experiment_dir, resources)
+        if not trials:
+            raise ValueError("search space produced no trials")
+
+        ckpt_cfg = self.run_config.checkpoint_config
+        controller = TuneController(
+            trainable_cls, trials, experiment_dir,
+            scheduler=tc.scheduler,
+            searcher=tc.search_alg,
+            metric=tc.metric, mode=tc.mode,
+            stop=tc.stop,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+            checkpoint_frequency=ckpt_cfg.checkpoint_frequency,
+            checkpoint_at_end=bool(ckpt_cfg.num_to_keep
+                                   or ckpt_cfg.checkpoint_frequency),
+        )
+        trials = controller.run()
+        results = [
+            Result(metrics=t.last_result,
+                   checkpoint=t.latest_checkpoint(),
+                   error=t.error,
+                   metrics_history=t.metrics_history,
+                   path=t.local_dir)
+            for t in trials
+        ]
+        return ResultGrid(results, trials, experiment_dir)
+
+
+def _trainer_as_trainable(trainer) -> type:
+    """Each trial runs a full JaxTrainer.fit with the trial config merged
+    into train_loop_config; worker actors are created from inside the
+    trial actor (nested actors, like the reference's trial→WorkerGroup)."""
+    import copy
+
+    def run_trainer(config: dict):
+        from ray_tpu.tune.trainable import report
+        t = copy.copy(trainer)
+        t.config = {**trainer.config, **config}
+        result = t.fit()
+        final = dict(result.metrics)
+        report(final, checkpoint=result.checkpoint)
+
+    return wrap_function(run_trainer)
+
+
+def run(trainable, *, config: Optional[dict] = None, num_samples: int = 1,
+        metric: Optional[str] = None, mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
+        stop: Optional[dict] = None,
+        resources_per_trial: Optional[dict] = None,
+        max_concurrent_trials: Optional[int] = None,
+        name: Optional[str] = None,
+        storage_path: Optional[str] = None,
+        checkpoint_freq: int = 0,
+        max_failures: int = 0,
+        verbose: int = 1) -> ResultGrid:
+    """Functional API (reference: tune.run, tune/tune.py:293)."""
+    from ray_tpu.train.config import CheckpointConfig, FailureConfig
+    if resources_per_trial:
+        trainable = _with_res(trainable, resources_per_trial)
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples,
+                               scheduler=scheduler, search_alg=search_alg,
+                               max_concurrent_trials=max_concurrent_trials,
+                               stop=stop),
+        run_config=RunConfig(
+            name=name or "tune_run", storage_path=storage_path,
+            verbose=verbose,
+            checkpoint_config=CheckpointConfig(
+                checkpoint_frequency=checkpoint_freq),
+            failure_config=FailureConfig(max_failures=max_failures)))
+    return tuner.fit()
+
+
+def _with_res(trainable, resources):
+    from ray_tpu.tune.trainable import with_resources
+    return with_resources(trainable, resources)

@@ -1,0 +1,231 @@
+"""Tune-equivalent tests, modeled on the reference's `tune/tests/`
+(test_tune_run, test_trial_scheduler, test_searchers)."""
+
+import os
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.train.config import CheckpointConfig, RunConfig
+from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+from ray_tpu.tune.search import count_variants, generate_variants
+
+
+# ---------------------------------------------------------------------------
+# Search-space unit tests (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_generate_variants_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "nested": {"units": tune.choice([32, 64])},
+        "const": "adam",
+    }
+    variants = list(generate_variants(space, num_samples=3, seed=0))
+    assert len(variants) == 6
+    assert count_variants(space, 3) == 6
+    for v in variants:
+        assert v["lr"] in (0.1, 0.01)
+        assert 0.0 <= v["wd"] <= 1.0
+        assert v["nested"]["units"] in (32, 64)
+        assert v["const"] == "adam"
+
+
+def test_sample_domains():
+    import random
+    rng = random.Random(0)
+    for _ in range(50):
+        assert 1 <= tune.randint(1, 10).sample(rng) < 10
+        v = tune.loguniform(1e-4, 1e-1).sample(rng)
+        assert 1e-4 <= v <= 1e-1
+        q = tune.quniform(0, 1, 0.25).sample(rng)
+        assert q in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+def test_sample_from_sees_spec():
+    space = {"a": 4, "b": tune.sample_from(lambda spec: spec["a"] * 2)}
+    (v,) = generate_variants(space, 1, seed=0)
+    assert v["b"] == 8
+
+
+# ---------------------------------------------------------------------------
+# Scheduler unit tests (pure logic, mirrors scheduler tests in the ref)
+# ---------------------------------------------------------------------------
+
+
+class _T:
+    def __init__(self, tid, config=None):
+        self.trial_id = tid
+        self.config = config or {}
+
+
+def test_asha_stops_bad_trials():
+    sched = AsyncHyperBandScheduler(metric="acc", mode="max", max_t=100,
+                                    grace_period=1, reduction_factor=2)
+    good, bad = _T("good"), _T("bad")
+    sched.on_trial_add(good)
+    sched.on_trial_add(bad)
+    # Feed diverging curves; the bad trial must be stopped at some rung.
+    decisions = []
+    for it in range(1, 50):
+        sched.on_trial_result(good, {"training_iteration": it,
+                                     "acc": 0.9 + it * 0.001})
+        decisions.append(
+            sched.on_trial_result(bad, {"training_iteration": it,
+                                        "acc": 0.1}))
+    assert "STOP" in decisions
+
+
+def test_median_stopping():
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+    sched = MedianStoppingRule(metric="loss", mode="min", grace_period=2,
+                               min_samples_required=2)
+    trials = [_T(f"t{i}") for i in range(4)]
+    for it in range(1, 6):
+        for t in trials[:-1]:
+            assert sched.on_trial_result(
+                t, {"training_iteration": it, "loss": 0.1}) == "CONTINUE"
+    # last trial is much worse than the median → stopped
+    d = None
+    for it in range(1, 6):
+        d = sched.on_trial_result(
+            trials[-1], {"training_iteration": it, "loss": 100.0})
+    assert d == "STOP"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end runs on the shared local cluster
+# ---------------------------------------------------------------------------
+
+
+def _trainable(config):
+    for it in range(5):
+        tune.report({"score": config["x"] * (it + 1)})
+
+
+def test_tuner_function_trainable(ray_session, tmp_path):
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result("score", "max")
+    assert best.metrics["score"] == pytest.approx(15.0)
+    assert not grid.errors
+
+
+def test_tune_run_stop_criteria(ray_session, tmp_path):
+    def forever(config):
+        it = 0
+        while True:
+            it += 1
+            tune.report({"v": it})
+
+    grid = tune.run(forever, config={"x": tune.choice([1])},
+                    num_samples=2, metric="v", mode="max",
+                    stop={"training_iteration": 4},
+                    storage_path=str(tmp_path), name="stopme")
+    for r in grid:
+        assert r.metrics["training_iteration"] == 4
+
+
+class _Counter(tune.Trainable):
+    def setup(self, config):
+        self.count = config.get("start", 0)
+
+    def step(self):
+        self.count += 1
+        return {"count": self.count}
+
+    def save_checkpoint(self, d):
+        return {"count": self.count}
+
+    def load_checkpoint(self, data):
+        self.count = data["count"]
+
+
+def test_class_trainable_with_checkpointing(ray_session, tmp_path):
+    grid = tune.run(_Counter, config={"start": 10}, num_samples=1,
+                    stop={"training_iteration": 3},
+                    checkpoint_freq=1,
+                    storage_path=str(tmp_path), name="cls")
+    r = grid[0]
+    assert r.metrics["count"] == 13
+    assert r.checkpoint is not None
+    assert r.checkpoint.to_dict()["count"] == 13
+
+
+def test_trainable_error_is_reported(ray_session, tmp_path):
+    def boom(config):
+        tune.report({"ok": 1})
+        raise ValueError("kaput")
+
+    grid = tune.run(boom, num_samples=1, storage_path=str(tmp_path),
+                    name="boom")
+    assert len(grid.errors) == 1
+    assert "kaput" in grid.errors[0]
+
+
+def test_experiment_state_persisted(ray_session, tmp_path):
+    tuner = tune.Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        run_config=RunConfig(name="persist", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    state_file = os.path.join(grid.experiment_path,
+                              "experiment_state.json")
+    assert os.path.exists(state_file)
+    # Restore sees the terminated trials and does not rerun them.
+    grid2 = tune.Tuner.restore(grid.experiment_path, _trainable).fit()
+    assert len(grid2) == 2
+
+
+def test_asha_end_to_end(ray_session, tmp_path):
+    def trainable(config):
+        for it in range(20):
+            tune.report({"acc": config["lr"] * (it + 1)})
+
+    grid = tune.run(trainable,
+                    config={"lr": tune.grid_search([0.1, 0.5, 1.0, 2.0])},
+                    metric="acc", mode="max",
+                    scheduler=tune.ASHAScheduler(
+                        metric="acc", mode="max", max_t=20,
+                        grace_period=2, reduction_factor=2),
+                    storage_path=str(tmp_path), name="asha")
+    best = grid.get_best_result("acc", "max")
+    assert best.metrics["acc"] == pytest.approx(40.0)
+    # at least one weaker trial should have been cut early
+    iters = [r.metrics.get("training_iteration", 0) for r in grid]
+    assert min(iters) < 20
+
+
+def _tiny_train_loop(config):
+    from ray_tpu.train import session
+    for i in range(3):
+        session.report({"loss": config["lr"] * (3 - i)})
+
+
+def test_tuner_over_jax_trainer(ray_session, tmp_path):
+    """Tune sweeps a JaxTrainer's train_loop_config (the reference's
+    Trainer-as-Trainable path, base_trainer.py:829 — but one-way here)."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    trainer = JaxTrainer(
+        _tiny_train_loop,
+        train_loop_config={"lr": 0.0},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=str(tmp_path)))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="sweep", storage_path=str(tmp_path))).fit()
+    assert len(grid) == 2
+    assert not grid.errors
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] == pytest.approx(0.1)
