@@ -1,0 +1,33 @@
+"""ray_tpu.rllib — reinforcement learning library.
+
+Counterpart of the reference's `python/ray/rllib/` (SURVEY.md §2.9), built
+TPU-first rather than ported:
+
+- **In-graph rollouts**: environments written as pure JAX step functions
+  are vmapped over an env batch and unrolled with `lax.scan` INSIDE the
+  jitted train step — sampling rides the accelerator instead of a fleet of
+  CPU actors (the reference's `RolloutWorker.sample`,
+  `rllib/evaluation/rollout_worker.py:660`, is a Python env loop).
+- **Actor rollouts** remain available for arbitrary Python envs
+  (`rllib/evaluation/` parity): a WorkerSet of `@remote` actors builds
+  SampleBatches that return through the object store.
+- **Learner = SPMD**: gradient sync is a `psum` over the mesh's data axis,
+  not DDP (`rllib/core/learner/torch/torch_learner.py:261`).
+
+Algorithms are `tune.Trainable`s, so `tune.run(PPO, config=...)` works the
+way `Algorithm(Trainable)` does in the reference
+(`rllib/algorithms/algorithm.py:191`).
+"""
+
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rllib.algorithms import (
+    Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA, IMPALAConfig,
+    PPO, PPOConfig, get_algorithm_class, register_algorithm)
+from ray_tpu.rllib.env.jax_env import make_env, register_env
+
+__all__ = [
+    "SampleBatch", "concat_samples",
+    "Algorithm", "AlgorithmConfig", "get_algorithm_class",
+    "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig", "make_env", "register_env",
+]

@@ -1,0 +1,10 @@
+"""Algorithm registry (reference: `rllib/algorithms/registry.py`)."""
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, get_algorithm_class, register_algorithm)
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
+           "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig"]

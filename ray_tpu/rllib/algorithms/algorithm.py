@@ -1,0 +1,224 @@
+"""Algorithm base class + typed config builder.
+
+Counterpart of the reference's `rllib/algorithms/algorithm.py:191`
+(`Algorithm(Trainable)`: step :813, training_step :1400) and
+`algorithm_config.py` (`AlgorithmConfig` fluent builder). An Algorithm IS
+a `ray_tpu.tune.Trainable`, so `tune.run(PPO, ...)` and
+`Tuner(PPO, ...)` work like the reference's Tune integration.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.jax_env import is_jax_env, make_env
+from ray_tpu.tune.trainable import Trainable
+
+_ALGORITHMS: Dict[str, Type["Algorithm"]] = {}
+
+
+def register_algorithm(name: str, cls: Type["Algorithm"]) -> None:
+    _ALGORITHMS[name] = cls
+
+
+def get_algorithm_class(name: str) -> Type["Algorithm"]:
+    """Reference: `rllib/algorithms/registry.py`."""
+    try:
+        return _ALGORITHMS[name]
+    except KeyError:
+        raise KeyError(f"unknown algorithm {name!r} "
+                       f"(known: {sorted(_ALGORITHMS)})") from None
+
+
+class AlgorithmConfig:
+    """Fluent builder; `.build()` makes the Algorithm, `.to_dict()` feeds
+    Tune param spaces."""
+
+    # subclass override
+    algo_class: Optional[Type["Algorithm"]] = None
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        if algo_class is not None:
+            self.algo_class = algo_class
+        # environment
+        self.env = None
+        self.env_config: dict = {}
+        # rollouts
+        self.num_rollout_workers = 0
+        self.num_envs_per_worker = 8
+        self.rollout_fragment_length = 128
+        self.seed = 0
+        # training
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.train_batch_size = 1024
+        self.model: dict = {}
+        self.optimizer_name = "adam"
+        self.grad_clip: Optional[float] = None
+        # resources
+        self.num_cpus_per_worker = 1
+        self.num_tpus_per_learner = 0
+
+    # -- fluent sections (each returns self, like the reference) ---------
+
+    def environment(self, env=None, *, env_config: dict | None = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int | None = None,
+                 num_envs_per_worker: int | None = None,
+                 rollout_fragment_length: int | None = None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    env_runners = rollouts      # new-stack alias in the reference
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, *, num_cpus_per_worker: int | None = None,
+                  num_tpus_per_learner: int | None = None):
+        if num_cpus_per_worker is not None:
+            self.num_cpus_per_worker = num_cpus_per_worker
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def debugging(self, *, seed: int | None = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def framework(self, *_args, **_kw):     # API-compat no-op (JAX only)
+        return self
+
+    # ---------------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+    def update_from_dict(self, d: dict) -> "AlgorithmConfig":
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class bound")
+        return self.algo_class(config=self)
+
+
+class Algorithm(Trainable):
+    """One RL algorithm instance: env + module + learner state.
+
+    As a tune.Trainable: step() == one training iteration; checkpoints
+    carry params/opt-state; `tune.run(PPO, config={...})` sweeps the
+    config dict (merged into the default AlgorithmConfig).
+    """
+
+    _config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls._config_class()
+
+    def __init__(self, config=None, trial_dir: str | None = None, env=None):
+        if isinstance(config, AlgorithmConfig):
+            cfg = config.copy()
+        else:
+            cfg = self.get_default_config()
+            cfg.update_from_dict(dict(config or {}))
+        if env is not None:
+            cfg.env = env
+        self.algo_config = cfg
+        super().__init__(cfg.to_dict(), trial_dir)
+
+    # -- Trainable plumbing ----------------------------------------------
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.env = make_env(cfg.env, cfg.env_config)
+        self.module = RLModule(self.env.observation_space,
+                               self.env.action_space, cfg.model)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._rng, init_key = jax.random.split(self._rng)
+        self.params = self.module.init(init_key)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self.build_learner()
+
+    def next_key(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def build_learner(self) -> None:
+        """Subclass hook: create optimizer/sampler state."""
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        """Subclass hook: one iteration (sample + update), returns
+        metrics (reference: algorithm.py:1400)."""
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        result = self.training_step()
+        return result
+
+    # convenience mirroring the reference's train() use outside Tune
+    def get_policy_params(self):
+        return self.params
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+        obs = jnp.asarray(obs)[None]
+        actions, _, _ = self.module.compute_actions(
+            self.params, obs, self.next_key(), explore=explore)
+        a = np.asarray(actions)[0]
+        return a.item() if a.ndim == 0 else a
+
+    # -- checkpointing ----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        state = jax.tree.map(np.asarray, self.get_state())
+        return {"state": state}
+
+    def load_checkpoint(self, data) -> None:
+        if isinstance(data, dict) and "state" in data:
+            self.set_state(data["state"])
+
+    def get_state(self) -> dict:
+        return {"params": self.params}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+
+    def cleanup(self) -> None:
+        workers = getattr(self, "workers", None)
+        if workers is not None:
+            workers.stop()
+
+
+def _concat_env_check(env) -> bool:
+    return is_jax_env(env)

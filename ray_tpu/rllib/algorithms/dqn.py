@@ -1,0 +1,229 @@
+"""DQN — deep Q-learning with target network, double-Q, and optional
+prioritized replay.
+
+Counterpart of the reference's `rllib/algorithms/dqn/` (dqn.py
+training_step: sample → store → replay → train → target-update;
+loss `dqn_torch_policy.py` build_q_losses: double-Q + huber). The
+sampling fragment is compiled (vmap env + scan, epsilon-greedy inside the
+graph); replay lives host-side; the TD update is a jitted function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.core.rl_module import QModule
+from ray_tpu.rllib.env.jax_env import is_jax_env
+from ray_tpu.rllib.replay_buffers import (
+    PrioritizedReplayBuffer, ReplayBuffer)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.buffer_size = 50_000
+        self.learning_starts = 1000
+        # Gradient updates between target-network syncs. Deliberate unit
+        # change vs the reference (env steps, dqn.py config): the compiled
+        # vectorized sampler produces steps orders of magnitude faster
+        # than a Python env loop, so step-based sync gives too few fitted
+        # regression updates per Bellman backup and Q ratchets upward
+        # (deadly triad). Update-based sync is invariant to sampling rate.
+        self.target_network_update_freq = 500
+        self.double_q = True
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.n_updates_per_iter = 64
+        # epsilon-greedy linear schedule, in env steps
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 25_000
+        self.rollout_fragment_length = 16
+        self.num_envs_per_worker = 32
+        self.model = {"fcnet_hiddens": (64, 64),
+                      "fcnet_activation": "relu"}
+
+
+class DQN(Algorithm):
+    _config_class = DQNConfig
+
+    def setup(self, config: dict) -> None:
+        # QModule instead of the policy-gradient RLModule.
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_jax_env(self.env):
+            raise ValueError(
+                "DQN v1 requires a JaxEnv (in-graph sampler); wrap python "
+                "envs or use PPO's WorkerSet path")
+        self.module = QModule(self.env.observation_space,
+                              self.env.action_space, cfg.model)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._rng, k = jax.random.split(self._rng)
+        self.params = self.module.init(k)
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        if cfg.prioritized_replay:
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.buffer_size, cfg.prioritized_replay_alpha,
+                cfg.prioritized_replay_beta, seed=cfg.seed)
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._steps_sampled = 0
+        self._num_updates = 0
+        self._last_target_update = 0
+        self._env_keys = jax.random.split(
+            self.next_key(), cfg.num_envs_per_worker)
+        state, obs = jax.vmap(self.env.reset)(self._env_keys)
+        self._carry = {"env_state": state, "obs": obs,
+                       "ep_ret": jnp.zeros(cfg.num_envs_per_worker),
+                       "ep_len": jnp.zeros(cfg.num_envs_per_worker,
+                                           jnp.int32)}
+        self._sample_fn = jax.jit(self._sample_impl)
+        self._update_fn = jax.jit(self._td_update)
+        self._ep_returns: list = []
+        self._ep_lens: list = []
+
+    # -- compiled sampling fragment ---------------------------------------
+
+    def _sample_impl(self, params, carry, key, epsilon):
+        cfg = self.algo_config
+
+        def one_step(carry, step_key):
+            k_act, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            actions, _, _ = self.module.compute_actions(
+                params, obs, k_act, epsilon=epsilon)
+            env_keys = jax.random.split(k_env, cfg.num_envs_per_worker)
+            state, next_obs, reward, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], actions, env_keys)
+            ep_ret = carry["ep_ret"] + reward
+            ep_len = carry["ep_len"] + 1
+            out = {sb.OBS: obs, sb.ACTIONS: actions, sb.REWARDS: reward,
+                   sb.NEXT_OBS: next_obs, sb.DONES: done,
+                   "episode_return": jnp.where(done, ep_ret, jnp.nan),
+                   "episode_len": jnp.where(done, ep_len, -1)}
+            new_carry = {"env_state": state, "obs": next_obs,
+                         "ep_ret": jnp.where(done, 0.0, ep_ret),
+                         "ep_len": jnp.where(done, 0, ep_len)}
+            return new_carry, out
+
+        keys = jax.random.split(key, cfg.rollout_fragment_length)
+        carry, traj = jax.lax.scan(one_step, carry, keys)
+        return carry, traj
+
+    # NOTE: next_obs recorded on done is the auto-reset obs, but the done
+    # mask zeroes the bootstrap term so the target is unaffected.
+
+    def _td_update(self, params, target_params, opt_state, batch):
+        cfg = self.algo_config
+
+        def loss_fn(p):
+            q = self.module.q_values(p, batch[sb.OBS])
+            q_sel = jnp.take_along_axis(
+                q, batch[sb.ACTIONS][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            q_next_target = self.module.q_values(
+                target_params, batch[sb.NEXT_OBS])
+            if cfg.double_q:
+                q_next_online = self.module.q_values(p, batch[sb.NEXT_OBS])
+                best = jnp.argmax(q_next_online, axis=-1)
+            else:
+                best = jnp.argmax(q_next_target, axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, best[..., None], axis=-1)[..., 0]
+            nonterm = 1.0 - batch[sb.DONES].astype(jnp.float32)
+            target = batch[sb.REWARDS] + cfg.gamma * nonterm * \
+                jax.lax.stop_gradient(q_next)
+            td_error = q_sel - target
+            weights = batch.get("weights", jnp.ones_like(td_error))
+            loss = jnp.mean(weights * optax.huber_loss(q_sel, target))
+            return loss, td_error
+
+        (loss, td_error), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, td_error
+
+    # ---------------------------------------------------------------------
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        losses = []
+        # sample until one update's worth of new experience is in
+        self._carry, traj = self._sample_fn(
+            self.params, self._carry, self.next_key(),
+            jnp.asarray(self._epsilon()))
+        host = {k: np.asarray(v) for k, v in traj.items()}
+        rets = host.pop("episode_return").ravel()
+        lens = host.pop("episode_len").ravel()
+        fin = ~np.isnan(rets)
+        self._ep_returns.extend(rets[fin].tolist())
+        self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
+        self._ep_returns = self._ep_returns[-100:]
+        self._ep_lens = self._ep_lens[-100:]
+        flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in host.items()}
+        self.buffer.add_batch(flat)
+        self._steps_sampled += len(flat[sb.REWARDS])
+
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.n_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                device_batch = {k: jnp.asarray(v) for k, v in batch.items()
+                                if k != "batch_indexes"}
+                self.params, self.opt_state, loss, td = self._update_fn(
+                    self.params, self.target_params, self.opt_state,
+                    device_batch)
+                losses.append(float(loss))
+                self._num_updates += 1
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(
+                        batch["batch_indexes"], np.asarray(td))
+                if (self._num_updates - self._last_target_update
+                        >= cfg.target_network_update_freq):
+                    self.target_params = jax.tree.map(jnp.copy, self.params)
+                    self._last_target_update = self._num_updates
+
+        return {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(self._ep_lens))
+                                 if self._ep_lens else float("nan")),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "num_env_steps_sampled": self._steps_sampled,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "target_params": self.target_params,
+                "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("DQN", DQN)

@@ -1,0 +1,184 @@
+"""IMPALA — importance-weighted actor-learner architecture.
+
+Counterpart of the reference's `rllib/algorithms/impala/` (impala.py:
+decoupled rollout actors feeding an async learner via
+`execution/learner_thread.py` / `multi_gpu_learner_thread.py`; V-trace
+`rllib/algorithms/impala/vtrace_torch.py`, after Espeholt et al. 2018).
+
+Shape here: rollout actors run continuously with whatever weights they
+last received (off-policy by a few versions); the learner consumes
+batches as they arrive and corrects the lag with V-trace. The V-trace
+backward pass is a `lax.scan` inside the jitted update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu import exceptions as _exc
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.worker_set import WorkerSet, merge_episode_stats
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 6e-4
+        self.gamma = 0.99
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_pg_rho_threshold = 1.0
+        self.lambda_ = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_rollout_workers = 2
+        self.rollout_fragment_length = 64
+        self.batches_per_step = 4       # learner batches per train() call
+        self.broadcast_interval = 1     # resubmit with fresh weights every
+        self.grad_clip = 40.0
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, dones,
+           last_value, gamma, lambda_, clip_rho, clip_pg_rho):
+    """V-trace targets over a [T] fragment (Espeholt et al. 2018, eqns
+    1-2). All inputs time-major; returns (vs, pg_advantages)."""
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = lambda_ * jnp.minimum(1.0, rhos)
+    nonterm = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], last_value[None]])
+    deltas = clipped_rhos * (rewards + gamma * nonterm * next_values
+                             - values)
+
+    def back(acc, xs):
+        delta, c, nt = xs
+        acc = delta + gamma * nt * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(back, jnp.zeros(()),
+                                 (deltas, cs, nonterm), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], last_value[None]])
+    pg_adv = jnp.minimum(clip_pg_rho, rhos) * (
+        rewards + gamma * nonterm * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALA(Algorithm):
+    _config_class = IMPALAConfig
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        chain = []
+        if cfg.grad_clip:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        self.optimizer = optax.chain(*chain)
+        self.opt_state = self.optimizer.init(self.params)
+
+        env_spec, env_cfg, model_cfg = (cfg.env, dict(cfg.env_config),
+                                        dict(cfg.model))
+        from ray_tpu.rllib.core.rl_module import RLModule
+        from ray_tpu.rllib.env.jax_env import make_env
+
+        def env_creator(worker_index, _s=env_spec, _c=env_cfg):
+            return make_env(_s, _c)
+
+        def module_creator(env, _mc=model_cfg):
+            return RLModule(env.observation_space, env.action_space, _mc)
+
+        self.workers = WorkerSet(
+            max(1, cfg.num_rollout_workers), env_creator, module_creator,
+            cfg.rollout_fragment_length, seed=cfg.seed,
+            num_cpus_per_worker=cfg.num_cpus_per_worker)
+        self._update_fn = jax.jit(self._vtrace_update)
+        # async pipeline: one in-flight sample per worker
+        self._inflight: dict = {}
+        self._steps_trained = 0
+
+    def _vtrace_update(self, params, opt_state, batch, last_value):
+        cfg = self.algo_config
+
+        def loss_fn(p):
+            dist, values = self.module.forward(p, batch[sb.OBS])
+            target_logp = dist.logp(batch[sb.ACTIONS])
+            vs, pg_adv = vtrace(
+                batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
+                values, batch[sb.DONES], last_value, cfg.gamma,
+                cfg.lambda_, cfg.vtrace_clip_rho_threshold,
+                cfg.vtrace_clip_pg_rho_threshold)
+            pg_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
+            entropy = jnp.mean(dist.entropy())
+            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        (_, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, stats
+
+    def _submit(self, idx: int) -> None:
+        from ray_tpu.rllib.worker_set import _to_host
+        w = self.workers._workers[idx]
+        params_ref = ray_tpu.put(_to_host(self.params))
+        self._inflight[w.sample_with_weights.remote(params_ref)] = idx
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        for i in range(len(self.workers._workers)):
+            if i not in self._inflight.values():
+                self._submit(i)
+
+        stats_list, learn_stats = [], []
+        consumed = 0
+        while consumed < cfg.batches_per_step:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=120)
+            if not ready:
+                break
+            fut = ready[0]
+            idx = self._inflight.pop(fut)
+            try:
+                batch, last_v, ep_stats = ray_tpu.get(fut)
+            except _exc.RayTpuError:
+                self.workers._restart(idx)
+                self._submit(idx)
+                continue
+            self._submit(idx)       # keep the actor busy (async pipeline)
+            device = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.params, self.opt_state, stats = self._update_fn(
+                self.params, self.opt_state, device,
+                jnp.asarray(last_v))
+            learn_stats.append(stats)
+            stats_list.append(ep_stats)
+            consumed += 1
+            self._steps_trained += len(batch)
+
+        metrics = merge_episode_stats(stats_list) if stats_list else {
+            "episode_reward_mean": float("nan"), "episodes_this_iter": 0}
+        if learn_stats:
+            mean = jax.tree.map(
+                lambda *xs: float(np.mean([np.asarray(x) for x in xs])),
+                *learn_stats)
+            metrics.update(mean)
+        metrics["num_env_steps_trained"] = self._steps_trained
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("IMPALA", IMPALA)

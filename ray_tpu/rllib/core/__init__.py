@@ -1,0 +1,4 @@
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian
+
+__all__ = ["RLModule", "Categorical", "DiagGaussian"]

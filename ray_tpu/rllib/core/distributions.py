@@ -1,0 +1,66 @@
+"""Action distributions (reference: `rllib/models/torch/torch_action_dist.py`
+/ `rllib/models/distributions.py`) as stateless jnp functions — every method
+is jit-traceable so sampling can live inside the compiled rollout."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    """Parameterized by logits [..., n]."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def sample(self, key):
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def logp(self, actions):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def kl(self, other: "Categorical"):
+        lp, lq = (jax.nn.log_softmax(self.logits, axis=-1),
+                  jax.nn.log_softmax(other.logits, axis=-1))
+        return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+
+    def deterministic(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    """Parameterized by mean and log_std [..., act_dim]."""
+
+    def __init__(self, mean, log_std):
+        self.mean, self.log_std = mean, log_std
+
+    def sample(self, key):
+        eps = jax.random.normal(key, self.mean.shape)
+        return self.mean + jnp.exp(self.log_std) * eps
+
+    def logp(self, actions):
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * ((actions - self.mean) ** 2 / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self):
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def kl(self, other: "DiagGaussian"):
+        return jnp.sum(
+            other.log_std - self.log_std
+            + (jnp.exp(2 * self.log_std)
+               + (self.mean - other.mean) ** 2)
+            / (2 * jnp.exp(2 * other.log_std)) - 0.5, axis=-1)
+
+    def deterministic(self):
+        return self.mean

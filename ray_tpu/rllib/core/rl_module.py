@@ -1,0 +1,147 @@
+"""RLModule — the neural network of an algorithm.
+
+Counterpart of the reference's new-stack `RLModule`
+(`rllib/core/rl_module/rl_module.py`) + the `ModelV2` catalog
+(`rllib/models/catalog.py`): obs in → action-distribution inputs (+ value
+estimate) out. Implemented as flax modules with explicit param pytrees so
+the learner can shard/psum them like any other ray_tpu.train model.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+
+class _MLPTorso(nn.Module):
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, x):
+        act = {"tanh": nn.tanh, "relu": nn.relu,
+               "swish": nn.swish}[self.activation]
+        for h in self.hiddens:
+            x = act(nn.Dense(h)(x))
+        return x
+
+
+class _PolicyValueNet(nn.Module):
+    """Separate policy/value torsos (the reference's default fcnet with
+    vf_share_layers=False, `rllib/models/catalog.py`)."""
+    num_outputs: int
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, obs):
+        pi = _MLPTorso(self.hiddens, self.activation, name="pi")(obs)
+        logits = nn.Dense(self.num_outputs, name="pi_out",
+                          kernel_init=nn.initializers.orthogonal(0.01))(pi)
+        vf = _MLPTorso(self.hiddens, self.activation, name="vf")(obs)
+        value = nn.Dense(1, name="vf_out")(vf)[..., 0]
+        return logits, value
+
+
+class _QNet(nn.Module):
+    num_actions: int
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs):
+        x = _MLPTorso(self.hiddens, self.activation)(obs)
+        return nn.Dense(self.num_actions)(x)
+
+
+class RLModule:
+    """Algorithm-agnostic policy network wrapper.
+
+    Methods take explicit `params` (functional style) so the learner can
+    jit/shard them; there is no hidden state, unlike ModelV2.
+    """
+
+    def __init__(self, observation_space: Box, action_space,
+                 model_config: dict | None = None):
+        cfg = dict(model_config or {})
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.discrete = isinstance(action_space, Discrete)
+        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+        self.activation = cfg.get("fcnet_activation", "tanh")
+        if self.discrete:
+            self.num_outputs = action_space.n
+        else:
+            self.num_outputs = int(np.prod(action_space.shape)) * 2
+        self.net = _PolicyValueNet(self.num_outputs, self.hiddens,
+                                   self.activation)
+        self._obs_dim = int(np.prod(observation_space.shape))
+
+    def init(self, key) -> dict:
+        dummy = jnp.zeros((1, self._obs_dim))
+        return self.net.init(key, dummy)["params"]
+
+    def forward(self, params, obs):
+        """-> (dist, value). Traceable."""
+        out, value = self.net.apply({"params": params}, obs)
+        return self.dist(out), value
+
+    def dist(self, dist_inputs):
+        if self.discrete:
+            return Categorical(dist_inputs)
+        mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+        return DiagGaussian(mean, jnp.clip(log_std, -20.0, 2.0))
+
+    def compute_actions(self, params, obs, key, explore: bool = True):
+        """-> (actions, logp, value). Traceable; used by both rollout
+        paths."""
+        dist, value = self.forward(params, obs)
+        actions = dist.sample(key) if explore else dist.deterministic()
+        return actions, dist.logp(actions), value
+
+
+class QModule:
+    """Q-network for value-based algorithms (DQN family). Counterpart of
+    the reference's DQN torso in `rllib/algorithms/dqn/dqn_torch_model.py`
+    (without distributional/noisy extras)."""
+
+    def __init__(self, observation_space: Box, action_space: Discrete,
+                 model_config: dict | None = None):
+        if not isinstance(action_space, Discrete):
+            raise ValueError("QModule requires a Discrete action space")
+        cfg = dict(model_config or {})
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.num_actions = action_space.n
+        self.net = _QNet(self.num_actions,
+                         tuple(cfg.get("fcnet_hiddens", (64, 64))),
+                         cfg.get("fcnet_activation", "relu"))
+        self._obs_dim = int(np.prod(observation_space.shape))
+
+    def init(self, key) -> dict:
+        dummy = jnp.zeros((1, self._obs_dim))
+        return self.net.init(key, dummy)["params"]
+
+    def q_values(self, params, obs):
+        return self.net.apply({"params": params}, obs)
+
+    def compute_actions(self, params, obs, key, epsilon=0.0):
+        """Epsilon-greedy. Traceable (epsilon may be a traced scalar).
+        Returns (actions, q_selected, q_all) — logp slot repurposed."""
+        q = self.q_values(params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        k1, k2 = jax.random.split(key)
+        rand_actions = jax.random.randint(
+            k1, greedy.shape, 0, self.num_actions)
+        explore_mask = jax.random.uniform(k2, greedy.shape) < epsilon
+        actions = jnp.where(explore_mask, rand_actions, greedy)
+        q_sel = jnp.take_along_axis(
+            q, actions[..., None], axis=-1)[..., 0]
+        return actions, q_sel, q

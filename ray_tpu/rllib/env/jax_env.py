@@ -1,0 +1,199 @@
+"""JaxEnv — environments as pure functions, the TPU-native env API.
+
+The reference's env stack (`rllib/env/`: BaseEnv/VectorEnv/MultiAgentEnv)
+vectorizes by running many Python envs; here the env itself is a pair of
+pure functions, so `jax.vmap` gives a vector env and `lax.scan` gives a
+compiled unroll — whole-rollout-on-device, something the reference cannot
+express (SURVEY.md §2.4: its parallelism is orchestration-level).
+
+Contract (gymnax-style):
+    state, obs = env.reset(key)
+    state, obs, reward, done, info = env.step(state, action, key)
+
+Both must be jit-traceable; `state` is an arbitrary pytree. Auto-reset on
+done happens inside `step` so scans never branch on python bools.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env.spaces import Box, Discrete, Space
+
+
+class JaxEnv:
+    """Subclass and implement reset_fn/step_fn + spaces."""
+
+    observation_space: Space
+    action_space: Space
+
+    def reset(self, key) -> Tuple[Any, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(self, state, action, key):
+        """Returns (state, obs, reward, done, info). Must auto-reset."""
+        raise NotImplementedError
+
+
+_ENV_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_env(name: str, creator: Callable[..., Any]) -> None:
+    """Reference: `ray.tune.registry.register_env` (used by all RLlib
+    examples to look envs up by string id)."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(spec, env_config: dict | None = None):
+    """Resolve an env from a string id, creator callable, class, or
+    instance."""
+    env_config = env_config or {}
+    if isinstance(spec, str):
+        if spec not in _ENV_REGISTRY:
+            raise KeyError(
+                f"unknown env {spec!r}; register it with "
+                f"ray_tpu.rllib.register_env (known: "
+                f"{sorted(_ENV_REGISTRY)})")
+        return _ENV_REGISTRY[spec](env_config)
+    if isinstance(spec, type):
+        return spec(**env_config) if env_config else spec()
+    if callable(spec) and not hasattr(spec, "step"):
+        return spec(env_config)
+    return spec
+
+
+def is_jax_env(env) -> bool:
+    return isinstance(env, JaxEnv)
+
+
+# ---------------------------------------------------------------------------
+# Classic-control environments (dynamics follow the standard OpenAI Gym
+# definitions; implemented from the published equations, in jnp)
+# ---------------------------------------------------------------------------
+
+
+class CartPole(JaxEnv):
+    """CartPole-v1 dynamics. Episode caps at 500 steps, reward 1/step."""
+
+    max_steps = 500
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 500))
+        self.observation_space = Box(-jnp.inf, jnp.inf, (4,))
+        self.action_space = Discrete(2)
+
+    def reset(self, key):
+        obs = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = {"obs": obs, "t": jnp.asarray(0, jnp.int32)}
+        return state, obs
+
+    def _physics(self, obs, action):
+        gravity, masscart, masspole = 9.8, 1.0, 0.1
+        total_mass = masscart + masspole
+        length = 0.5                     # half pole length
+        polemass_length = masspole * length
+        force_mag, tau = 10.0, 0.02
+
+        x, x_dot, theta, theta_dot = obs[0], obs[1], obs[2], obs[3]
+        force = jnp.where(action == 1, force_mag, -force_mag)
+        costh, sinth = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) / total_mass
+        thetaacc = (gravity * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - masspole * costh ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costh / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        return jnp.stack([x, x_dot, theta, theta_dot])
+
+    def step(self, state, action, key):
+        obs = self._physics(state["obs"], action)
+        t = state["t"] + 1
+        x, theta = obs[0], obs[2]
+        failed = (jnp.abs(x) > 2.4) | (jnp.abs(theta) > 12 * jnp.pi / 180)
+        done = failed | (t >= self.max_steps)
+        reward = jnp.asarray(1.0)
+        # auto-reset: where done, swap in a fresh episode
+        reset_state, reset_obs = self.reset(key)
+        new_obs = jnp.where(done, reset_obs, obs)
+        new_t = jnp.where(done, reset_state["t"], t)
+        return ({"obs": new_obs, "t": new_t}, new_obs, reward, done, {})
+
+
+class Pendulum(JaxEnv):
+    """Pendulum-v1: continuous control, torque in [-2, 2]."""
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 200))
+        self.observation_space = Box(-jnp.inf, jnp.inf, (3,))
+        self.action_space = Box(-2.0, 2.0, (1,))
+
+    def _obs(self, th, thdot):
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        th = jax.random.uniform(k1, minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, minval=-1.0, maxval=1.0)
+        state = {"th": th, "thdot": thdot, "t": jnp.asarray(0, jnp.int32)}
+        return state, self._obs(th, thdot)
+
+    def step(self, state, action, key):
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        u = jnp.clip(jnp.squeeze(action), -2.0, 2.0)
+        th, thdot = state["th"], state["thdot"]
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * g / (2 * l) * jnp.sin(th)
+                         + 3.0 / (m * l ** 2) * u) * dt
+        thdot = jnp.clip(thdot, -8.0, 8.0)
+        th = th + thdot * dt
+        t = state["t"] + 1
+        done = t >= self.max_steps
+        reset_state, reset_obs = self.reset(key)
+        new = {
+            "th": jnp.where(done, reset_state["th"], th),
+            "thdot": jnp.where(done, reset_state["thdot"], thdot),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        obs = jnp.where(done, reset_obs, self._obs(th, thdot))
+        return new, obs, -cost, done, {}
+
+
+class EagerJaxEnv:
+    """Gym-API adapter over a JaxEnv, for actor-based rollout workers
+    (the reference's RolloutWorker steps gym envs eagerly; this lets the
+    same JaxEnv serve both the in-graph and the actor path)."""
+
+    def __init__(self, env: JaxEnv, seed: int = 0):
+        self.env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+        self._key = jax.random.PRNGKey(seed)
+        self._reset = jax.jit(env.reset)
+        self._step = jax.jit(env.step)
+        self._state = None
+
+    def _split(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def reset(self):
+        self._state, obs = self._reset(self._split())
+        return np.asarray(obs)
+
+    def step(self, action):
+        self._state, obs, r, done, info = self._step(
+            self._state, jnp.asarray(action), self._split())
+        return np.asarray(obs), float(r), bool(done), info
+
+
+register_env("CartPole-v1", lambda cfg: CartPole(cfg))
+register_env("Pendulum-v1", lambda cfg: Pendulum(cfg))

@@ -1,0 +1,63 @@
+"""Observation/action spaces.
+
+Minimal gym-compatible space types (the reference depends on `gym.spaces`
+throughout, e.g. `rllib/env/base_env.py`; this image ships no gym, and the
+framework only needs shape/dtype/bounds metadata + sampling).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Space:
+    shape: tuple = ()
+    dtype = np.float32
+
+    def sample(self, rng: np.random.Generator | None = None):
+        raise NotImplementedError
+
+    def contains(self, x) -> bool:
+        raise NotImplementedError
+
+
+class Discrete(Space):
+    def __init__(self, n: int):
+        self.n = int(n)
+        self.shape = ()
+        self.dtype = np.int32
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        return int(rng.integers(self.n))
+
+    def contains(self, x) -> bool:
+        return 0 <= int(x) < self.n
+
+    def __repr__(self):
+        return f"Discrete({self.n})"
+
+
+class Box(Space):
+    def __init__(self, low, high, shape=None, dtype=np.float32):
+        if shape is None:
+            shape = np.broadcast(np.asarray(low), np.asarray(high)).shape
+        self.shape = tuple(shape)
+        self.low = np.broadcast_to(np.asarray(low, dtype), self.shape)
+        self.high = np.broadcast_to(np.asarray(high, dtype), self.shape)
+        self.dtype = dtype
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        low = np.where(np.isfinite(self.low), self.low, -1.0)
+        high = np.where(np.isfinite(self.high), self.high, 1.0)
+        return rng.uniform(low, high).astype(self.dtype)
+
+    def contains(self, x) -> bool:
+        x = np.asarray(x)
+        return x.shape == self.shape and \
+            bool(np.all(x >= self.low - 1e-6)) and \
+            bool(np.all(x <= self.high + 1e-6))
+
+    def __repr__(self):
+        return f"Box{self.shape}"

@@ -1,0 +1,123 @@
+"""Replay buffers for off-policy algorithms.
+
+Counterpart of the reference's `rllib/utils/replay_buffers/`
+(`replay_buffer.py` ReplayBuffer, `prioritized_replay_buffer.py` +
+segment tree `rllib/execution/segment_tree.py`). Host-numpy ring storage
+(replay stays in host RAM; only sampled minibatches move to device, the
+same division of labor the reference has between plasma and GPU).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over transition columns."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = int(capacity)
+        self._store: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(batch.values())))
+        if not self._store:
+            for k, v in batch.items():
+                v = np.asarray(v)
+                self._store[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                          v.dtype)
+        idx = (self._idx + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._store[k][idx] = np.asarray(v)
+        self._idx = int((self._idx + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        self._on_added(idx)
+
+    def _on_added(self, idx: np.ndarray) -> None:
+        pass
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
+
+
+class _SumTree:
+    """Binary indexed sum tree for O(log n) prioritized sampling
+    (reference: rllib/execution/segment_tree.py)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        size = 1
+        while size < capacity:
+            size *= 2
+        self.size = size
+        self.tree = np.zeros(2 * size, np.float64)
+
+    def set(self, idx: np.ndarray, value: np.ndarray) -> None:
+        pos = idx + self.size
+        self.tree[pos] = value
+        pos //= 2
+        # vectorized bottom-up refresh (duplicate parents collapse via
+        # unique; loop depth = log2(size))
+        while pos[0] >= 1 if len(pos) else False:
+            pos = np.unique(pos)
+            self.tree[pos] = self.tree[2 * pos] + self.tree[2 * pos + 1]
+            if pos[0] == 1:
+                break
+            pos //= 2
+
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    def sample_idx(self, prefix_sums: np.ndarray) -> np.ndarray:
+        idx = np.ones(len(prefix_sums), np.int64)
+        s = prefix_sums.copy()
+        while idx[0] < self.size:
+            left = 2 * idx
+            go_right = s > self.tree[left]
+            s = np.where(go_right, s - self.tree[left], s)
+            idx = np.where(go_right, left + 1, left)
+        return idx - self.size
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    `prioritized_replay_buffer.py`; Schaul et al. 2016 scheme)."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha, self.beta = alpha, beta
+        self._tree = _SumTree(self.capacity)
+        self._max_priority = 1.0
+
+    def _on_added(self, idx: np.ndarray) -> None:
+        self._tree.set(idx,
+                       np.full(len(idx), self._max_priority ** self.alpha))
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        total = self._tree.total()
+        prefix = self._rng.uniform(0, total, batch_size)
+        idx = np.minimum(self._tree.sample_idx(prefix), self._size - 1)
+        out = {k: v[idx] for k, v in self._store.items()}
+        probs = self._tree.tree[idx + self._tree.size] / max(total, 1e-9)
+        weights = (self._size * probs + 1e-9) ** (-self.beta)
+        out["weights"] = (weights / weights.max()).astype(np.float32)
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        priorities = np.abs(priorities) + 1e-6
+        self._max_priority = max(self._max_priority,
+                                 float(priorities.max()))
+        self._tree.set(np.asarray(idx),
+                       priorities.astype(np.float64) ** self.alpha)

@@ -1,0 +1,165 @@
+"""Rollout collection — the sampling half of every algorithm.
+
+Two paths, mirroring SURVEY.md §2.9's rollout layer but TPU-first:
+
+- `InGraphSampler`: env batch stepped by `vmap`, unrolled by `lax.scan`,
+  the whole thing jitted — sampling is a compiled program. This replaces
+  the reference's `SyncSampler` Python loop (`rllib/evaluation/sampler.py:144`)
+  for JAX-native envs.
+- `PythonEnvRunner`: eager loop over arbitrary gym-API Python envs, used
+  inside RolloutWorker actors (`rollout_worker.py`) for reference parity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class InGraphSampler:
+    """Compiled vectorized rollout for JaxEnv environments."""
+
+    def __init__(self, env, module, num_envs: int, rollout_length: int):
+        self.env = env
+        self.module = module
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self._unroll = jax.jit(self._unroll_impl)
+
+    def init_state(self, key):
+        keys = jax.random.split(key, self.num_envs)
+        state, obs = jax.vmap(self.env.reset)(keys)
+        return {"env_state": state, "obs": obs,
+                "ep_ret": jnp.zeros(self.num_envs),
+                "ep_len": jnp.zeros(self.num_envs, jnp.int32)}
+
+    def _unroll_impl(self, params, carry, key):
+        """lax.scan over time of a vmapped env step + policy forward."""
+
+        def one_step(carry, step_key):
+            k_act, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            actions, logp, value = self.module.compute_actions(
+                params, obs, k_act)
+            env_keys = jax.random.split(k_env, self.num_envs)
+            state, next_obs, reward, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], actions, env_keys)
+            ep_ret = carry["ep_ret"] + reward
+            ep_len = carry["ep_len"] + 1
+            # record finished-episode stats, then zero the accumulators
+            finished_ret = jnp.where(done, ep_ret, jnp.nan)
+            finished_len = jnp.where(done, ep_len, -1)
+            new_carry = {
+                "env_state": state,
+                "obs": next_obs,
+                "ep_ret": jnp.where(done, 0.0, ep_ret),
+                "ep_len": jnp.where(done, 0, ep_len),
+            }
+            out = {sb.OBS: obs, sb.ACTIONS: actions, sb.REWARDS: reward,
+                   sb.DONES: done, sb.ACTION_LOGP: logp, sb.VF_PREDS: value,
+                   "episode_return": finished_ret,
+                   "episode_len": finished_len}
+            return new_carry, out
+
+        step_keys = jax.random.split(key, self.rollout_length)
+        carry, traj = jax.lax.scan(one_step, carry, step_keys)
+        # bootstrap value for the final observation of every env
+        _, last_value = self.module.forward(params, carry["obs"])
+        return carry, traj, last_value
+
+    def sample(self, params, carry, key):
+        """-> (new_carry, traj pytree [T, num_envs, ...], last_value
+        [num_envs]). Device arrays; algorithms keep them on device."""
+        return self._unroll(params, carry, key)
+
+
+def episode_stats(traj) -> dict:
+    """Mean/len of the episodes that finished inside a trajectory."""
+    rets = np.asarray(traj["episode_return"]).ravel()
+    lens = np.asarray(traj["episode_len"]).ravel()
+    done = ~np.isnan(rets)
+    if not done.any():
+        return {"episode_reward_mean": float("nan"),
+                "episode_len_mean": float("nan"), "episodes_this_iter": 0}
+    return {
+        "episode_reward_mean": float(np.nanmean(rets[done])),
+        "episode_len_mean": float(np.mean(lens[done & (lens >= 0)])),
+        "episodes_this_iter": int(done.sum()),
+    }
+
+
+class PythonEnvRunner:
+    """Eager sampler for gym-API Python envs (reset/step methods)."""
+
+    def __init__(self, env, module, rollout_length: int, seed: int = 0):
+        self.env = env
+        self.module = module
+        self.rollout_length = rollout_length
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = None
+        self._ep_ret = 0.0
+        self._ep_len = 0
+        self._episode_returns: list = []
+        self._episode_lens: list = []
+        self._compute = jax.jit(self.module.compute_actions)
+
+    def _reset_env(self):
+        out = self.env.reset()
+        self._obs = out[0] if isinstance(out, tuple) else out
+
+    def sample(self, params) -> Tuple[SampleBatch, float]:
+        if self._obs is None:
+            self._reset_env()
+        rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                                sb.ACTION_LOGP, sb.VF_PREDS)}
+        for _ in range(self.rollout_length):
+            self._key, k = jax.random.split(self._key)
+            obs = np.asarray(self._obs, np.float32)
+            a, logp, v = self._compute(params, obs[None], k)
+            action = np.asarray(a)[0]
+            out = self.env.step(
+                action.item() if action.ndim == 0 else action)
+            if len(out) == 5:       # gymnasium-style
+                nxt, r, term, trunc, _ = out
+                done = bool(term or trunc)
+            else:
+                nxt, r, done, _ = out
+            rows[sb.OBS].append(obs)
+            rows[sb.ACTIONS].append(action)
+            rows[sb.REWARDS].append(np.float32(r))
+            rows[sb.DONES].append(done)
+            rows[sb.ACTION_LOGP].append(np.asarray(logp)[0])
+            rows[sb.VF_PREDS].append(np.asarray(v)[0])
+            self._ep_ret += float(r)
+            self._ep_len += 1
+            if done:
+                self._episode_returns.append(self._ep_ret)
+                self._episode_lens.append(self._ep_len)
+                self._ep_ret, self._ep_len = 0.0, 0
+                self._reset_env()
+            else:
+                self._obs = nxt
+        obs = np.asarray(self._obs, np.float32)
+        _, _, last_v = self._compute(
+            params, obs[None], jax.random.PRNGKey(0))
+        batch = SampleBatch({k: np.stack(v) for k, v in rows.items()})
+        return batch, float(np.asarray(last_v)[0])
+
+    def pop_episode_stats(self) -> dict:
+        stats = {
+            "episode_reward_mean": (float(np.mean(self._episode_returns))
+                                    if self._episode_returns
+                                    else float("nan")),
+            "episode_len_mean": (float(np.mean(self._episode_lens))
+                                 if self._episode_lens else float("nan")),
+            "episodes_this_iter": len(self._episode_returns),
+        }
+        self._episode_returns, self._episode_lens = [], []
+        return stats

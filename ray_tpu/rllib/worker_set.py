@@ -1,0 +1,143 @@
+"""RolloutWorker actors + WorkerSet.
+
+Counterpart of the reference's `rllib/evaluation/rollout_worker.py:159`
+(RolloutWorker.sample :660) and `worker_set.py:80` (WorkerSet:
+sync_weights :340, fault-tolerant foreach_worker :634). Used for Python
+(non-JAX) envs and for scaling sampling across CPU hosts; JAX envs
+normally use the in-graph sampler instead (rollout.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import exceptions as _exc
+from ray_tpu.rllib.rollout import PythonEnvRunner
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+logger = logging.getLogger("ray_tpu.rllib")
+
+
+class RolloutWorker:
+    """Actor body: env(s) + policy copy; produces SampleBatches."""
+
+    def __init__(self, env_creator: Callable, module_creator: Callable,
+                 rollout_length: int, worker_index: int, seed: int):
+        env = env_creator(worker_index)
+        from ray_tpu.rllib.env.jax_env import EagerJaxEnv, is_jax_env
+        if is_jax_env(env):
+            env = EagerJaxEnv(env, seed=seed + worker_index)
+        self.module = module_creator(env)
+        self.runner = PythonEnvRunner(env, self.module, rollout_length,
+                                      seed=seed + worker_index)
+        self.params = None
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self) -> tuple:
+        """-> (SampleBatch, last_value, episode_stats)"""
+        if self.params is None:
+            raise RuntimeError("set_weights must be called before sample")
+        batch, last_v = self.runner.sample(self.params)
+        return batch, last_v, self.runner.pop_episode_stats()
+
+    def sample_with_weights(self, params) -> tuple:
+        """One round-trip: sync + sample (the reference splits these; fusing
+        halves actor-call latency on the hot path)."""
+        self.set_weights(params)
+        return self.sample()
+
+    def ping(self) -> bool:
+        return True
+
+
+class WorkerSet:
+    """Manages N rollout-worker actors with restart-on-failure
+    (reference: WorkerSet + FaultTolerantActorManager,
+    `rllib/utils/actor_manager.py`)."""
+
+    def __init__(self, num_workers: int, env_creator: Callable,
+                 module_creator: Callable, rollout_length: int,
+                 seed: int = 0, num_cpus_per_worker: float = 1.0,
+                 max_restarts: int = 2):
+        self.num_workers = num_workers
+        self._make = lambda i: ray_tpu.remote(
+            num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
+                env_creator, module_creator, rollout_length, i, seed)
+        self._workers: List = [self._make(i) for i in range(num_workers)]
+        self._restarts = [0] * num_workers
+        self.max_restarts = max_restarts
+
+    def sample_all(self, params) -> tuple:
+        """Parallel sample across all workers; dead workers are restarted
+        and skipped this round. -> (batches, last_values, stats_list)"""
+        params_ref = ray_tpu.put(_to_host(params))
+        futures = {w.sample_with_weights.remote(params_ref): i
+                   for i, w in enumerate(self._workers)}
+        batches, last_values, stats = [], [], []
+        for fut, i in futures.items():
+            try:
+                b, lv, st = ray_tpu.get(fut, timeout=300)
+                batches.append(b)
+                last_values.append(lv)
+                stats.append(st)
+            except (_exc.RayTpuError, TimeoutError) as e:
+                logger.warning("rollout worker %d failed: %s; restarting",
+                               i, e)
+                self._restart(i)
+        if not batches:
+            raise RuntimeError("all rollout workers failed")
+        return batches, last_values, stats
+
+    def _restart(self, i: int) -> None:
+        if self._restarts[i] >= self.max_restarts:
+            raise RuntimeError(
+                f"rollout worker {i} exceeded {self.max_restarts} restarts")
+        self._restarts[i] += 1
+        try:
+            ray_tpu.kill(self._workers[i])
+        except _exc.RayTpuError:
+            pass
+        self._workers[i] = self._make(i)
+
+    def foreach_worker(self, fn_name: str, *args) -> list:
+        futs = [getattr(w, fn_name).remote(*args) for w in self._workers]
+        return ray_tpu.get(futs, timeout=300)
+
+    def sync_weights(self, params) -> None:
+        params_ref = ray_tpu.put(_to_host(params))
+        self.foreach_worker("set_weights", params_ref)
+
+    def stop(self) -> None:
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except _exc.RayTpuError:
+                pass
+        self._workers = []
+
+
+def _to_host(params):
+    """Device pytree → numpy pytree (object-store transit is host memory;
+    the reference ships torch tensors the same way, worker_set.py:340)."""
+    import jax
+    return jax.tree.map(np.asarray, params)
+
+
+def merge_episode_stats(stats_list: List[dict]) -> dict:
+    eps = sum(s.get("episodes_this_iter", 0) for s in stats_list)
+    rets = [s["episode_reward_mean"] for s in stats_list
+            if s.get("episodes_this_iter", 0) > 0]
+    lens = [s["episode_len_mean"] for s in stats_list
+            if s.get("episodes_this_iter", 0) > 0]
+    return {
+        "episode_reward_mean": float(np.mean(rets)) if rets
+        else float("nan"),
+        "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        "episodes_this_iter": eps,
+    }

@@ -58,6 +58,11 @@ def _is_array_tree(value) -> bool:
         isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
 
 
+def _tree_to_numpy(value):
+    import jax
+    return jax.tree.map(np.asarray, value)
+
+
 class Checkpoint:
     """A dict- or directory-backed checkpoint. Construct with `from_dict` /
     `from_directory`; read with `to_dict` / `to_directory` / `as_directory`.
@@ -73,12 +78,10 @@ class Checkpoint:
     def from_dict(cls, data: dict) -> "Checkpoint":
         # Snapshot arrays to host numpy now: detaches from device buffers
         # (donation-safe) and makes the object picklable across processes.
-        snap = {}
-        for k, v in data.items():
-            if _is_array_tree(v):
-                import jax
-                v = jax.tree.map(np.asarray, v)
-            snap[k] = v
+        snap = {
+            k: (_tree_to_numpy(v) if _is_array_tree(v) else v)
+            for k, v in data.items()
+        }
         return cls(_data=snap)
 
     @classmethod

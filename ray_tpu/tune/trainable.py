@@ -130,6 +130,10 @@ class FunctionTrainable(Trainable):
         self._error: list = []
         self._restore_checkpoint: Checkpoint | None = None
         self._last_report_checkpoint: Checkpoint | None = None
+        # checkpoint of the last CONSUMED report — what save() persists;
+        # _last_report_checkpoint may already belong to a report the
+        # controller hasn't seen (the fn thread runs ahead by one).
+        self._consumed_checkpoint: Checkpoint | None = None
         self._last_metrics: dict = {}
         self._thread: threading.Thread | None = None
 
@@ -137,12 +141,19 @@ class FunctionTrainable(Trainable):
         _session._install(self)
         try:
             self._fn(self.config)
-            self._queue.put(("return", None))
+            kind = "return"
         except SystemExit:
-            self._queue.put(("return", None))
+            kind = "return"
         except BaseException:       # surfaces in train() as an error result
             self._error.append(traceback.format_exc())
-            self._queue.put(("error", None))
+            kind = "error"
+        # After a stop(), an unconsumed report may still occupy the
+        # size-1 queue; a blocking put here would hang this thread
+        # forever. Nobody reads the sentinel post-stop, so best-effort.
+        try:
+            self._queue.put_nowait((kind, None))
+        except _queue.Full:
+            pass
 
     # called from the user thread via tune.report
     def _report(self, metrics: dict, checkpoint=None) -> None:
@@ -171,12 +182,13 @@ class FunctionTrainable(Trainable):
             raise RuntimeError(self._error[0])
         metrics = payload["metrics"]
         self._last_metrics = dict(metrics)
+        self._consumed_checkpoint = payload["checkpoint"]
         self._consumed.release()
         return metrics
 
     def save_checkpoint(self, checkpoint_dir: str):
-        if self._last_report_checkpoint is not None:
-            return dict(self._last_report_checkpoint.to_dict())
+        if self._consumed_checkpoint is not None:
+            return dict(self._consumed_checkpoint.to_dict())
         return {"_no_user_checkpoint": True}
 
     def load_checkpoint(self, checkpoint) -> None:
@@ -190,7 +202,14 @@ class FunctionTrainable(Trainable):
 
     def stop(self) -> None:
         self._stop_event.set()
-        self._consumed.release()        # unblock a pending report
+        # Drop an unconsumed report so the runner's final sentinel (or a
+        # report in flight) can't block on the full size-1 queue, then
+        # unblock a report waiting on the consumption semaphore.
+        try:
+            self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._consumed.release()
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.cleanup()
@@ -257,7 +276,22 @@ def with_parameters(fn, **heavy_kwargs):
 
 
 def with_resources(trainable, resources: dict):
-    """Attach per-trial resource requests (reference: tune.with_resources)."""
-    target = trainable
-    setattr(target, "_tune_resources", dict(resources))
-    return target
+    """Attach per-trial resource requests (reference: tune.with_resources).
+
+    Returns a wrapper; the original class/function is left untouched so
+    resource requests cannot leak into unrelated tune.run calls that
+    reuse the same trainable object.
+    """
+    import functools
+    import inspect
+    if inspect.isclass(trainable):
+        wrapped = type(trainable.__name__, (trainable,),
+                       {"_tune_resources": dict(resources)})
+        return wrapped
+
+    @functools.wraps(trainable)
+    def fn_wrapper(*args, **kwargs):
+        return trainable(*args, **kwargs)
+
+    fn_wrapper._tune_resources = dict(resources)
+    return fn_wrapper

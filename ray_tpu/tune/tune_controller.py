@@ -146,7 +146,13 @@ class TuneController:
             trial.local_dir)
         ckpt = trial.latest_checkpoint()
         if ckpt is not None:
-            trial.actor.restore.remote(ckpt)
+            try:
+                ray_tpu.get(trial.actor.restore.remote(ckpt), timeout=300)
+            except _exc.RayTpuError as e:
+                # A silently-failed restore would retrain from scratch
+                # while bookkeeping thinks it resumed; treat as failure.
+                self._handle_failure(trial, e)
+                return
         trial.status = RUNNING
         for cb in self.callbacks:
             _safe(cb, "on_trial_start", trial=trial)
@@ -300,7 +306,11 @@ class TuneController:
             if trial.actor is not None:
                 self._kill_actor(trial)
             if trial.status == RUNNING:
-                trial.status = TERMINATED
+                # Interrupted (Ctrl-C/driver exit), NOT finished: persist
+                # as PENDING so Tuner.restore resumes it from its latest
+                # checkpoint (reference: trials in flight are re-pended on
+                # resume, experiment_state.py:441).
+                trial.status = PENDING
 
 
 def _actor_opts(resources: dict) -> dict:

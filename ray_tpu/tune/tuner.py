@@ -130,11 +130,20 @@ class Tuner:
                      resources: dict) -> List[Trial]:
         tc = self.tune_config
         if tc.search_alg is not None:
+            # Trials are generated upfront; a ConcurrencyLimiter caps
+            # running trials via max_concurrent_trials instead (its
+            # suggest() gate would truncate the experiment here).
+            from ray_tpu.tune.search import ConcurrencyLimiter
+            searcher = tc.search_alg
+            if isinstance(searcher, ConcurrencyLimiter):
+                if tc.max_concurrent_trials is None:
+                    tc.max_concurrent_trials = searcher.max_concurrent
+                searcher = searcher.searcher
             trials = []
             tid = new_trial_id()
             total = tc.num_samples
             while len(trials) < total:
-                cfg = tc.search_alg.suggest(tid)
+                cfg = searcher.suggest(tid)
                 if cfg is None:
                     break
                 trials.append(Trial(tid, cfg, experiment_dir, resources))

@@ -189,9 +189,13 @@ def test_asha_end_to_end(ray_session, tmp_path):
         for it in range(20):
             tune.report({"acc": config["lr"] * (it + 1)})
 
+    # Sequential + weakest trial last: its rung cutoffs are fully
+    # populated by the stronger earlier trials, so the early stop is
+    # deterministic (parallel arrival order would make it racy).
     grid = tune.run(trainable,
-                    config={"lr": tune.grid_search([0.1, 0.5, 1.0, 2.0])},
+                    config={"lr": tune.grid_search([2.0, 1.0, 0.5, 0.1])},
                     metric="acc", mode="max",
+                    max_concurrent_trials=1,
                     scheduler=tune.ASHAScheduler(
                         metric="acc", mode="max", max_t=20,
                         grace_period=2, reduction_factor=2),
@@ -229,3 +233,20 @@ def test_tuner_over_jax_trainer(ray_session, tmp_path):
     assert not grid.errors
     best = grid.get_best_result("loss", "min")
     assert best.metrics["loss"] == pytest.approx(0.1)
+
+
+def test_concurrency_limiter_runs_all_samples(ray_session, tmp_path):
+    """A ConcurrencyLimiter caps parallelism, not the trial count."""
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    searcher = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5),
+        max_concurrent=2)
+    grid = tune.Tuner(
+        _trainable,
+        tune_config=tune.TuneConfig(num_samples=5, search_alg=searcher,
+                                    metric="score", mode="max"),
+        run_config=RunConfig(name="limiter",
+                             storage_path=str(tmp_path))).fit()
+    assert len(grid) == 5
+    assert not grid.errors
