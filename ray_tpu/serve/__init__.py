@@ -1,0 +1,29 @@
+"""ray_tpu.serve — model serving.
+
+Counterpart of the reference's `python/ray/serve/` (SURVEY.md §2.8):
+controller-reconciled deployments, replica actors, HTTP ingress,
+deployment handles with power-of-two-choices routing, queue-depth
+autoscaling, request batching, and `.bind()` model composition.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    set_route,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.http_proxy import Request
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "status",
+    "shutdown", "delete", "set_route", "get_deployment_handle",
+    "DeploymentHandle", "batch", "Request",
+]

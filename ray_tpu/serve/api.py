@@ -1,0 +1,225 @@
+"""Public Serve API.
+
+Counterpart of the reference's `serve/api.py` (`@serve.deployment` :242,
+`serve.run` :414, `serve.start` :62) and the `.bind()` application graph
+(`serve/deployment.py`, `_private/deployment_graph_build.py`): bound
+deployments referenced in another deployment's init args are delivered
+as DeploymentHandles at replica construction (model composition).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclass
+class Application:
+    """A bound deployment graph rooted at an ingress deployment."""
+    root: "BoundDeployment"
+
+    def _collect(self) -> list:
+        seen: dict = {}
+
+        def walk(node: "BoundDeployment"):
+            if id(node) in seen:
+                return
+            for a in list(node.init_args) + list(
+                    node.init_kwargs.values()):
+                if isinstance(a, BoundDeployment):
+                    walk(a)
+            seen[id(node)] = node
+
+        walk(self.root)
+        return list(seen.values())
+
+
+class BoundDeployment:
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+    @property
+    def name(self) -> str:
+        return self.deployment.name
+
+
+class Deployment:
+    """The declarative unit (reference: serve/deployment.py Deployment)."""
+
+    def __init__(self, target: Callable, name: str,
+                 num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 max_concurrent_queries: int = 8,
+                 autoscaling_config: Optional[dict] = None,
+                 route_prefix: Optional[str] = None):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = dict(ray_actor_options or {})
+        self.max_concurrent_queries = max_concurrent_queries
+        self.autoscaling_config = autoscaling_config
+        self.route_prefix = route_prefix
+
+    def options(self, **opts) -> "Deployment":
+        merged = {
+            "name": self.name,
+            "num_replicas": self.num_replicas,
+            "ray_actor_options": self.ray_actor_options,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "autoscaling_config": self.autoscaling_config,
+            "route_prefix": self.route_prefix,
+        }
+        merged.update(opts)
+        return Deployment(self._target, **merged)
+
+    def bind(self, *args, **kwargs) -> "BoundDeployment":
+        """Returns a graph node: pass it to serve.run as the app root, or
+        as an init arg of another bind (it arrives as a handle)."""
+        return BoundDeployment(self, args, kwargs)
+
+    def to_spec(self, init_args: tuple, init_kwargs: dict,
+                route_prefix: Optional[str]) -> dict:
+        return {
+            "name": self.name,
+            "callable": self._target,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "num_replicas": self.num_replicas,
+            "ray_actor_options": self.ray_actor_options,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "autoscaling_config": self.autoscaling_config,
+            "route_prefix": route_prefix,
+        }
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments are not called directly; use .bind() + serve.run, "
+            "then handle.remote()")
+
+
+def deployment(target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               max_concurrent_queries: int = 8,
+               autoscaling_config: Optional[dict] = None,
+               route_prefix: Optional[str] = None):
+    """`@serve.deployment` decorator (bare or with options)."""
+
+    def wrap(t):
+        return Deployment(t, name or t.__name__,
+                          num_replicas=num_replicas,
+                          ray_actor_options=ray_actor_options,
+                          max_concurrent_queries=max_concurrent_queries,
+                          autoscaling_config=autoscaling_config,
+                          route_prefix=route_prefix)
+
+    if target is not None:
+        return wrap(target)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# run / start / shutdown
+# ---------------------------------------------------------------------------
+
+_http_proxy = None
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: str = "/", _blocking: bool = False
+        ) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment
+    (reference: serve.run, api.py:414)."""
+    from ray_tpu.serve.controller import get_controller
+    if isinstance(app, BoundDeployment):
+        app = Application(app)
+    controller = get_controller()
+
+    nodes = app._collect()
+    specs = []
+    for node in nodes:
+        # bound-deployment init args become handles (composition)
+        init_args = tuple(
+            DeploymentHandle(a.name, name) if isinstance(a, BoundDeployment)
+            else a for a in node.init_args)
+        init_kwargs = {
+            k: (DeploymentHandle(v.name, name)
+                if isinstance(v, BoundDeployment) else v)
+            for k, v in node.init_kwargs.items()}
+        prefix = route_prefix if node is app.root else \
+            node.deployment.route_prefix
+        specs.append(node.deployment.to_spec(init_args, init_kwargs, prefix))
+
+    ray_tpu.get(controller.deploy_application.remote(name, specs),
+                timeout=120)
+    handle = DeploymentHandle(app.root.name, name)
+    # wait for the ingress to be live
+    handle._pick_replica()
+    return handle
+
+
+def start(*, http_options: Optional[dict] = None):
+    """Start the HTTP proxy (reference: serve.start creates per-node
+    HTTPProxyActors; single-node here)."""
+    global _http_proxy
+    from ray_tpu.serve.controller import get_controller
+    from ray_tpu.serve.http_proxy import HTTPProxy
+    get_controller()
+    if _http_proxy is None:
+        opts = dict(http_options or {})
+        actor_cls = ray_tpu.remote(
+            num_cpus=0.1, max_concurrency=32,
+            name="SERVE_HTTP_PROXY")(HTTPProxy)
+        _http_proxy = actor_cls.remote(opts.get("host", "127.0.0.1"),
+                                       opts.get("port", 8000))
+        ray_tpu.get(_http_proxy.ready.remote(), timeout=60)
+    return _http_proxy
+
+
+def set_route(route_prefix: str, deployment_name: str,
+              app_name: str = "default"):
+    """Register an HTTP route on the proxy."""
+    proxy = start()
+    ray_tpu.get(proxy.set_route.remote(route_prefix, deployment_name,
+                                       app_name), timeout=30)
+
+
+def status() -> dict:
+    from ray_tpu.serve.controller import get_controller
+    return ray_tpu.get(get_controller().status.remote(), timeout=30)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def delete(name: str = "default"):
+    from ray_tpu.serve.controller import get_controller
+    ray_tpu.get(get_controller().delete_application.remote(name),
+                timeout=60)
+
+
+def shutdown():
+    global _http_proxy
+    from ray_tpu import exceptions as _exc
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    if _http_proxy is not None:
+        try:
+            ray_tpu.get(_http_proxy.stop.remote(), timeout=10)
+            ray_tpu.kill(_http_proxy)
+        except _exc.RayTpuError:
+            pass
+        _http_proxy = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except (KeyError, ValueError, _exc.RayTpuError):
+        pass

@@ -1,0 +1,301 @@
+"""ServeController — the control plane.
+
+Counterpart of the reference's `ServeController`
+(`serve/controller.py:82`) with its `DeploymentStateManager`
+(`_private/deployment_state.py:2127`): a detached named actor that
+reconciles desired deployment specs into replica actors, runs health
+checks, and autoscales on queue depth. Replica-set changes are versioned;
+handles poll `get_replicas` with their last seen version (the pull
+analogue of the reference's long-poll push, `_private/long_poll.py:187`).
+
+Concurrency model: control RPCs (running on the actor's thread pool) only
+record desired state under the lock; ALL replica actor creation/teardown
+happens on the single reconcile thread, so replica sets cannot be
+mutated concurrently and a mid-flight redeploy cannot leak actors.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import ray_tpu
+from ray_tpu import exceptions as _exc
+
+logger = logging.getLogger("ray_tpu.serve")
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_RECONCILE_PERIOD_S = 1.0
+
+
+class _DeploymentState:
+    def __init__(self, name: str, app_name: str, spec: dict):
+        self.name = name
+        self.app_name = app_name
+        self.spec = spec
+        self.replicas: list = []
+        self.version = 0
+        self.target_num = spec.get("num_replicas", 1)
+        self.autoscaling = spec.get("autoscaling_config")
+        self.status = "UPDATING"
+        self.message = ""
+        # set by deploy_application on redeploy; consumed by reconcile
+        self.pending_spec: dict | None = None
+        # autoscaling smoothing (reference: autoscaling_policy.py
+        # downscale_delay_s): scale down only after sustained low demand.
+        self._downscale_candidate_since: float | None = None
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: dict = {}      # (app, name) -> _DeploymentState
+        self._graveyard: list = []        # replica lists awaiting drain
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile")
+        self._thread.start()
+
+    # -- control RPCs (record desired state only) -------------------------
+
+    def deploy_application(self, app_name: str, deployments: list) -> bool:
+        with self._lock:
+            new_names = {d["name"] for d in deployments}
+            for key in [k for k in self._deployments
+                        if k[0] == app_name and k[1] not in new_names]:
+                st = self._deployments.pop(key)
+                self._graveyard.append(st.replicas)
+                st.replicas = []
+            for spec in deployments:
+                key = (app_name, spec["name"])
+                cur = self._deployments.get(key)
+                if cur is None:
+                    self._deployments[key] = _DeploymentState(
+                        spec["name"], app_name, spec)
+                else:
+                    cur.pending_spec = spec
+                    cur.status = "UPDATING"
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            for key in [k for k in self._deployments if k[0] == app_name]:
+                st = self._deployments.pop(key)
+                self._graveyard.append(st.replicas)
+                st.replicas = []
+        return True
+
+    def get_replicas(self, deployment_name: str, app_name: str,
+                     known_version: int):
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is None:
+                return (0, [])
+            if st.version == known_version:
+                return None
+            return (st.version, list(st.replicas))
+
+    def get_routes(self) -> dict:
+        """route_prefix -> (deployment, app) for every routed deployment."""
+        with self._lock:
+            out = {}
+            for (app, name), st in self._deployments.items():
+                prefix = st.spec.get("route_prefix")
+                if prefix:
+                    out[prefix] = (name, app)
+            return out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                f"{app}:{name}": {
+                    "status": st.status,
+                    "message": st.message,
+                    "replicas": len(st.replicas),
+                    "target_replicas": st.target_num,
+                }
+                for (app, name), st in self._deployments.items()
+            }
+
+    def graceful_shutdown(self) -> bool:
+        self._shutdown.set()
+        with self._lock:
+            for st in self._deployments.values():
+                self._kill_replicas(st.replicas)
+                st.replicas = []
+            self._deployments.clear()
+            for replicas in self._graveyard:
+                self._kill_replicas(replicas)
+            self._graveyard.clear()
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    # -- reconciliation (sole mutator of replica sets) --------------------
+
+    def _kill_replicas(self, replicas: list) -> None:
+        # Best-effort graceful teardown, then kill (reference: replicas
+        # get a graceful_shutdown call before force-kill,
+        # deployment_state.py).
+        pending = []
+        for r in replicas:
+            try:
+                pending.append(r.prepare_shutdown.remote())
+            except _exc.RayTpuError:
+                pass
+        if pending:
+            try:
+                ray_tpu.wait(pending, num_returns=len(pending), timeout=5)
+            except _exc.RayTpuError:
+                pass
+        for r in replicas:
+            try:
+                ray_tpu.kill(r)
+            except _exc.RayTpuError:
+                pass
+
+    def _make_replica(self, st: _DeploymentState):
+        from ray_tpu.serve.replica import Replica
+        opts = dict(st.spec.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = st.spec.get("max_concurrent_queries", 8)
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        return actor_cls.remote({
+            "callable": st.spec["callable"],
+            "init_args": st.spec.get("init_args", ()),
+            "init_kwargs": st.spec.get("init_kwargs", {}),
+            "deployment_name": st.name,
+        })
+
+    def _health_check(self, replicas: list) -> list:
+        """Parallel health checks; returns the live subset."""
+        futs = {}
+        for r in replicas:
+            try:
+                futs[r.check_health.remote()] = r
+            except _exc.RayTpuError:
+                pass
+        if not futs:
+            return []
+        ready, not_ready = ray_tpu.wait(
+            list(futs), num_returns=len(futs), timeout=10)
+        alive = []
+        for fut in ready:
+            try:
+                ray_tpu.get(fut)
+                alive.append(futs[fut])
+            except _exc.RayTpuError:
+                logger.warning("replica failed health check")
+        return alive
+
+    def _reconcile_one(self, st: _DeploymentState) -> None:
+        # adopt a pending redeploy: retire every old replica
+        pending = None
+        with self._lock:
+            if st.pending_spec is not None:
+                pending = st.pending_spec
+                st.pending_spec = None
+        if pending is not None:
+            old = st.replicas
+            st.spec = pending
+            st.target_num = pending.get("num_replicas", 1)
+            st.autoscaling = pending.get("autoscaling_config")
+            self._kill_replicas(old)
+            with self._lock:
+                st.replicas = []
+                st.version += 1
+
+        alive = self._health_check(st.replicas)
+        changed = len(alive) != len(st.replicas)
+
+        replica_stats = None
+        if st.autoscaling and alive:
+            try:
+                replica_stats = ray_tpu.get(
+                    [r.stats.remote() for r in alive], timeout=10)
+                total_inflight = sum(s["inflight"] for s in replica_stats)
+                target_per = st.autoscaling.get(
+                    "target_num_ongoing_requests_per_replica", 1.0)
+                desired = int(max(
+                    st.autoscaling.get("min_replicas", 1),
+                    min(st.autoscaling.get("max_replicas", 8),
+                        -(-total_inflight // max(target_per, 1e-6))
+                        or st.autoscaling.get("min_replicas", 1))))
+                if desired >= len(alive):
+                    st.target_num = desired
+                    st._downscale_candidate_since = None
+                else:
+                    delay = st.autoscaling.get("downscale_delay_s", 30)
+                    now = time.time()
+                    if st._downscale_candidate_since is None:
+                        st._downscale_candidate_since = now
+                    elif now - st._downscale_candidate_since >= delay:
+                        st.target_num = desired
+                        st._downscale_candidate_since = None
+            except _exc.RayTpuError:
+                pass
+
+        while len(alive) < st.target_num:
+            alive.append(self._make_replica(st))
+            changed = True
+        if len(alive) > st.target_num:
+            if replica_stats and len(replica_stats) == len(alive):
+                order = sorted(range(len(alive)),
+                               key=lambda i: replica_stats[i]["inflight"])
+                alive = [alive[i] for i in order]
+            victims = alive[st.target_num:] if replica_stats is None \
+                else alive[:len(alive) - st.target_num]
+            alive = [r for r in alive if r not in victims]
+            self._kill_replicas(victims)
+            changed = True
+
+        with self._lock:
+            # a concurrent delete/redeploy moved this state aside: retire
+            # whatever we just created instead of leaking it
+            if self._deployments.get((st.app_name, st.name)) is not st:
+                self._graveyard.append(alive)
+                return
+            st.replicas = alive
+            if changed:
+                st.version += 1
+            st.status = ("RUNNING" if len(alive) == st.target_num
+                         else "UPDATING")
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+            graveyard, self._graveyard = self._graveyard, []
+        for replicas in graveyard:
+            self._kill_replicas(replicas)
+        for st in states:
+            try:
+                self._reconcile_one(st)
+            except Exception:
+                logger.exception("reconcile of %s failed", st.name)
+
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("reconcile step failed")
+            self._shutdown.wait(_RECONCILE_PERIOD_S)
+
+
+def get_controller():
+    """Look up (or lazily create) the controller actor."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except (KeyError, ValueError, _exc.RayTpuError):
+        return start_controller()
+
+
+def start_controller():
+    actor_cls = ray_tpu.remote(
+        num_cpus=0.1, name=CONTROLLER_NAME, max_concurrency=16,
+        lifetime="detached")(ServeController)
+    controller = actor_cls.remote()
+    ray_tpu.get(controller.ping.remote(), timeout=60)
+    return controller

@@ -1,0 +1,132 @@
+"""DeploymentHandle — the RPC path to a deployment's replicas.
+
+Counterpart of the reference's `serve/handle.py` (RayServeHandle) +
+`_private/router.py:875` (Router with power-of-two-choices replica
+assignment, `_try_assign_replica` :747). The handle keeps a local view of
+the replica set (refreshed from the controller, the reference's long-poll
+`LongPollClient` :69) and routes each call to the less-loaded of two
+random replicas, tracking in-flight counts client-side.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as _exc
+
+_REFRESH_PERIOD_S = 2.0
+
+
+class DeploymentHandle:
+    # outstanding refs tracked per replica for load-aware routing; capped
+    # so a caller that never ray_tpu.get()s can't grow the dict unboundedly
+    _MAX_TRACKED = 64
+
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._replicas: List = []
+        # actor_id -> list of outstanding ObjectRefs (pruned lazily)
+        self._outstanding: dict = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        self._version = -1
+
+    # handles must survive pickling into replicas/proxies (composition)
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name))
+
+    def _controller(self):
+        from ray_tpu.serve.controller import get_controller
+        return get_controller()
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_refresh < _REFRESH_PERIOD_S:
+            return
+        with self._lock:
+            if not force and now - self._last_refresh < _REFRESH_PERIOD_S:
+                return
+            info = ray_tpu.get(
+                self._controller().get_replicas.remote(
+                    self.deployment_name, self.app_name, self._version),
+                timeout=30)
+            if info is not None:
+                version, replicas = info
+                self._version = version
+                self._replicas = list(replicas)
+                live_ids = {r._actor_id for r in replicas}
+                self._outstanding = {
+                    aid: refs for aid, refs in self._outstanding.items()
+                    if aid in live_ids}
+            self._last_refresh = now
+
+    def _pick_replica(self):
+        """Power-of-two-choices on client-side in-flight counts
+        (reference: router.py _try_assign_replica)."""
+        self._refresh()
+        replicas = self._replicas
+        if not replicas:
+            # cold start: block until the deployment has replicas
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                self._refresh(force=True)
+                if self._replicas:
+                    replicas = self._replicas
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        return a if self._inflight.get(id(a), 0) <= \
+            self._inflight.get(id(b), 0) else b
+
+    def remote(self, *args, **kwargs):
+        """-> ObjectRef of the user callable's result."""
+        replica = self._pick_replica()
+        self._inflight[id(replica)] = self._inflight.get(id(replica), 0) + 1
+        try:
+            return replica.handle_request.remote(args, kwargs)
+        finally:
+            # decremented optimistically after submit; queue-depth signal
+            # comes from replica-side stats for autoscaling.
+            self._inflight[id(replica)] = max(
+                0, self._inflight.get(id(replica), 1) - 1)
+
+    def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
+        """Synchronous convenience: remote + get."""
+        last_err = None
+        for _ in range(3):      # retry through replica death (rollouts)
+            try:
+                return ray_tpu.get(self.remote(*args, **kwargs),
+                                   timeout=timeout)
+            except (_exc.ActorDiedError, _exc.WorkerCrashedError) as e:
+                last_err = e
+                self._refresh(force=True)
+        raise last_err
+
+    # reference-API sugar: handle.method.remote(...)
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        replica = self._handle._pick_replica()
+        return replica.handle_method.remote(self._method, args, kwargs)
+
+    def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
+        return ray_tpu.get(self.remote(*args, **kwargs), timeout=timeout)

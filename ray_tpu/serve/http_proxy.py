@@ -1,0 +1,131 @@
+"""HTTP ingress.
+
+Counterpart of the reference's `HTTPProxy`
+(`serve/_private/http_proxy.py:189`, actor wrapper :858). The reference
+rides uvicorn/ASGI; this image has no HTTP framework, so the proxy actor
+runs a stdlib ThreadingHTTPServer on a background thread and forwards
+requests through DeploymentHandles (the same proxy→replica actor-call
+data plane).
+
+Request mapping: the deployment callable receives a `Request` with
+method/path/query/headers/body; `json()` parses the body. Responses:
+bytes/str passed through; any other object is JSON-encoded.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict = field(default_factory=dict)
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+
+    def json(self):
+        return json.loads(self.body or b"null")
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host, self.port = host, port
+        self._routes: dict = {}           # prefix -> (deployment, app)
+        self._handles: dict = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):     # quiet
+                pass
+
+            def _dispatch(self):
+                try:
+                    proxy._serve_one(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:     # 500 with the error text
+                    try:
+                        body = str(e).encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except Exception:
+                        pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port     # resolves port=0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serve-http")
+        self._thread.start()
+
+    def ready(self) -> dict:
+        return {"host": self.host, "port": self.port}
+
+    def set_route(self, prefix: str, deployment_name: str,
+                  app_name: str) -> bool:
+        self._routes[prefix.rstrip("/") or "/"] = (deployment_name, app_name)
+        return True
+
+    def get_routes(self) -> dict:
+        return dict(self._routes)
+
+    def _match(self, path: str):
+        best = None
+        for prefix, target in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best
+
+    def _serve_one(self, handler) -> None:
+        parsed = urllib.parse.urlsplit(handler.path)
+        match = self._match(parsed.path)
+        if match is None:
+            handler.send_response(404)
+            handler.send_header("Content-Length", "0")
+            handler.end_headers()
+            return
+        _, (dep, app) = match
+        key = (dep, app)
+        if key not in self._handles:
+            self._handles[key] = DeploymentHandle(dep, app)
+        length = int(handler.headers.get("Content-Length") or 0)
+        req = Request(
+            method=handler.command,
+            path=parsed.path,
+            query=dict(urllib.parse.parse_qsl(parsed.query)),
+            headers=dict(handler.headers.items()),
+            body=handler.rfile.read(length) if length else b"")
+        result = self._handles[key].call(req, timeout=120)
+        if isinstance(result, bytes):
+            body, ctype = result, "application/octet-stream"
+        elif isinstance(result, str):
+            body, ctype = result.encode(), "text/plain"
+        else:
+            body, ctype = json.dumps(result).encode(), "application/json"
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def stop(self) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        return True

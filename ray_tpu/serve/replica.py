@@ -1,0 +1,92 @@
+"""Replica actor — hosts one copy of the user's deployment callable.
+
+Counterpart of the reference's `RayServeReplica`
+(`serve/_private/replica.py:429`, handle_request :695): wraps the user
+class/function, counts in-flight requests for autoscaling, and exposes
+health checks. Runs with max_concurrency > 1 so a slow request doesn't
+serialize the replica (the reference uses asyncio; our actor runtime uses
+a thread pool, worker_main.py max_concurrency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Replica:
+    def __init__(self, serialized_init: dict):
+        """serialized_init: {"callable": cls_or_fn, "init_args": tuple,
+        "init_kwargs": dict, "deployment_name": str}"""
+        self.deployment_name = serialized_init["deployment_name"]
+        target = serialized_init["callable"]
+        args = serialized_init.get("init_args", ())
+        kwargs = serialized_init.get("init_kwargs", {})
+        if isinstance(target, type):
+            self.callable = target(*args, **kwargs)
+            self._is_function = False
+        else:
+            self.callable = target
+            self._is_function = True
+        self._inflight = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._started = time.time()
+
+    def ready(self) -> bool:
+        return True
+
+    def check_health(self) -> bool:
+        """Reference: user-defined check_health on the deployment class
+        (deployment_state.py health checks)."""
+        fn = getattr(self.callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def _enter(self):
+        with self._lock:
+            self._inflight += 1
+            self._total += 1
+
+    def _exit(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def handle_request(self, args: tuple, kwargs: dict):
+        """__call__ path (HTTP and plain handle calls)."""
+        self._enter()
+        try:
+            target = (self.callable if self._is_function
+                      else self.callable.__call__)
+            return target(*args, **kwargs)
+        finally:
+            self._exit()
+
+    def handle_method(self, method: str, args: tuple, kwargs: dict):
+        """handle.method.remote path (model composition)."""
+        self._enter()
+        try:
+            return getattr(self.callable, method)(*args, **kwargs)
+        finally:
+            self._exit()
+
+    def stats(self) -> dict:
+        """Autoscaling signal (reference: autoscaling_metrics.py pulls
+        per-replica queue lengths)."""
+        with self._lock:
+            return {"inflight": self._inflight, "total": self._total,
+                    "uptime_s": time.time() - self._started}
+
+    def prepare_shutdown(self) -> bool:
+        """Graceful-teardown hook called by the controller before kill:
+        runs the user's __del__ (resource release) while the process is
+        still healthy (reference: replica graceful_shutdown,
+        deployment_state.py)."""
+        fn = getattr(self.callable, "__del__", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:
+                pass
+        return True
