@@ -22,15 +22,15 @@ _REFRESH_PERIOD_S = 2.0
 
 
 class DeploymentHandle:
-    # outstanding refs tracked per replica for load-aware routing; capped
-    # so a caller that never ray_tpu.get()s can't grow the dict unboundedly
+    # outstanding refs tracked per replica, capped so a caller that never
+    # resolves its ObjectRefs can't grow the per-replica list unboundedly
     _MAX_TRACKED = 64
 
     def __init__(self, deployment_name: str, app_name: str = "default"):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._replicas: List = []
-        # actor_id -> list of outstanding ObjectRefs (pruned lazily)
+        # actor_id -> list of outstanding ObjectRefs (pruned at pick time)
         self._outstanding: dict = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
@@ -65,6 +65,20 @@ class DeploymentHandle:
                     if aid in live_ids}
             self._last_refresh = now
 
+    def _load(self, actor_id) -> int:
+        """In-flight count for one replica: prune completed refs
+        (non-blocking wait) and return how many are still outstanding."""
+        refs = self._outstanding.get(actor_id)
+        if not refs:
+            return 0
+        try:
+            _, not_ready = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0, fetch_local=False)
+        except Exception:
+            not_ready = []
+        self._outstanding[actor_id] = not_ready
+        return len(not_ready)
+
     def _pick_replica(self):
         """Power-of-two-choices on client-side in-flight counts
         (reference: router.py _try_assign_replica)."""
@@ -85,20 +99,17 @@ class DeploymentHandle:
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
-        return a if self._inflight.get(id(a), 0) <= \
-            self._inflight.get(id(b), 0) else b
+        return a if self._load(a._actor_id) <= self._load(b._actor_id) else b
 
     def remote(self, *args, **kwargs):
         """-> ObjectRef of the user callable's result."""
         replica = self._pick_replica()
-        self._inflight[id(replica)] = self._inflight.get(id(replica), 0) + 1
-        try:
-            return replica.handle_request.remote(args, kwargs)
-        finally:
-            # decremented optimistically after submit; queue-depth signal
-            # comes from replica-side stats for autoscaling.
-            self._inflight[id(replica)] = max(
-                0, self._inflight.get(id(replica), 1) - 1)
+        ref = replica.handle_request.remote(args, kwargs)
+        refs = self._outstanding.setdefault(replica._actor_id, [])
+        refs.append(ref)
+        if len(refs) > self._MAX_TRACKED:
+            del refs[:-self._MAX_TRACKED]
+        return ref
 
     def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
         """Synchronous convenience: remote + get."""
