@@ -1,0 +1,43 @@
+"""Native (C++) runtime components, built on demand with the host toolchain.
+
+The reference ships its native core prebuilt via bazel
+(`src/ray/BUILD.bazel`); here the native pieces are small enough to compile
+at first import with `g++ -O2 -shared -fPIC` and cache next to the source.
+Set RAY_TPU_DISABLE_NATIVE=1 to force the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+def native_disabled() -> bool:
+    return os.environ.get("RAY_TPU_DISABLE_NATIVE", "") == "1"
+
+
+def build_extension(name: str) -> str | None:
+    """Compile native/<name>.cc -> native/lib<name>.so if stale; return the
+    .so path, or None if native is disabled or the toolchain fails."""
+    if native_disabled():
+        return None
+    src = os.path.join(_DIR, name + ".cc")
+    out = os.path.join(_DIR, "lib" + name + ".so")
+    with _BUILD_LOCK:
+        try:
+            if (os.path.exists(out)
+                    and os.path.getmtime(out) >= os.path.getmtime(src)):
+                return out
+            tmp = out + ".tmp.%d" % os.getpid()
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp, src, "-lpthread"],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)  # atomic: concurrent builders race safely
+            return out
+        except (OSError, subprocess.SubprocessError):
+            return None

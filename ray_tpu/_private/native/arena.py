@@ -1,0 +1,171 @@
+"""ctypes client for the native shared-memory arena store (store.cc).
+
+Counterpart of the reference's plasma client (`plasma/client.h`): create →
+write payload → seal; lookup returns a zero-copy memoryview into this
+process's mapping of the arena. One arena per session lives at
+`<session_dir>/arena.shm`; creation is serialized across processes with an
+flock'd sidecar file so exactly one process initializes the header.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import mmap
+import os
+
+from ray_tpu._private import native as _native
+
+def _default_capacity() -> int:
+    return int(os.environ.get(
+        "RAY_TPU_OBJECT_STORE_BYTES", str(512 * 1024 * 1024)))
+
+
+class _Lib:
+    """Lazily-loaded libstore.so with typed signatures."""
+    _instance = None
+
+    def __init__(self, path: str):
+        lib = ctypes.CDLL(path)
+        lib.rts_open.restype = ctypes.c_void_p
+        lib.rts_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+        lib.rts_close.argtypes = [ctypes.c_void_p]
+        lib.rts_create.restype = ctypes.c_uint64
+        lib.rts_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+        lib.rts_seal.restype = ctypes.c_int
+        lib.rts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_lookup.restype = ctypes.c_uint64
+        lib.rts_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+        lib.rts_contains.restype = ctypes.c_int
+        lib.rts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_delete.restype = ctypes.c_int
+        lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_pin.restype = ctypes.c_int
+        lib.rts_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.rts_acquire.restype = ctypes.c_uint64
+        lib.rts_acquire.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+        lib.rts_poisoned.restype = ctypes.c_int
+        lib.rts_poisoned.argtypes = [ctypes.c_void_p]
+        lib.rts_evict.restype = ctypes.c_uint64
+        lib.rts_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rts_stats.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+        self.lib = lib
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            so = _native.build_extension("store")
+            if so is None:
+                return None
+            cls._instance = cls(so)
+        return cls._instance
+
+
+class Arena:
+    """Per-process handle to the session arena. None-safe factory: use
+    Arena.open(session_dir), which returns None when native is unavailable."""
+
+    def __init__(self, lib: _Lib, handle: int, path: str):
+        self._lib = lib.lib
+        self._h = handle
+        self._path = path
+        stats = (ctypes.c_uint64 * 6)()
+        self._lib.rts_stats(self._h, stats)
+        self._map_len = stats[5]
+        # Map the arena once in this process for zero-copy reads/writes.
+        # ctypes gives us the .so's mapping base; re-deriving a Python
+        # memoryview needs our own mmap of the same file.
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, self._map_len)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+
+    @classmethod
+    def open(cls, session_dir: str,
+             capacity: int | None = None) -> "Arena | None":
+        if capacity is None:
+            capacity = _default_capacity()
+        lib = _Lib.get()
+        if lib is None:
+            return None
+        path = os.path.join(session_dir, "arena.shm")
+        lockpath = path + ".lock"
+        lock_fd = os.open(lockpath, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            handle = lib.lib.rts_open(path.encode(), capacity, 1)
+        finally:
+            fcntl.flock(lock_fd, fcntl.LOCK_UN)
+            os.close(lock_fd)
+        if not handle:
+            return None
+        return cls(lib, handle, path)
+
+    # -- plasma-style verbs --------------------------------------------------
+
+    def create(self, object_id: str, size: int) -> memoryview | None:
+        """Reserve `size` bytes; returns a writable view or None if full."""
+        off = self._lib.rts_create(self._h, object_id.encode(), size)
+        if off == 0:
+            return None
+        return self._view[off:off + size]
+
+    def seal(self, object_id: str) -> bool:
+        return self._lib.rts_seal(self._h, object_id.encode()) == 0
+
+    def lookup(self, object_id: str) -> memoryview | None:
+        """Zero-copy read view of a sealed object, or None if absent."""
+        size = ctypes.c_uint64()
+        off = self._lib.rts_lookup(self._h, object_id.encode(),
+                                   ctypes.byref(size))
+        if off == 0:
+            return None
+        return self._view[off:off + size.value].toreadonly()
+
+    def acquire(self, object_id: str) -> memoryview | None:
+        """Pin + zero-copy read view, atomically: the returned view stays
+        valid even if the object is later deleted (block is condemned, not
+        freed, until the pin is released)."""
+        size = ctypes.c_uint64()
+        off = self._lib.rts_acquire(self._h, object_id.encode(),
+                                    ctypes.byref(size))
+        if off == 0:
+            return None
+        return self._view[off:off + size.value].toreadonly()
+
+    def poisoned(self) -> bool:
+        return self._lib.rts_poisoned(self._h) == 1
+
+    def contains(self, object_id: str) -> bool:
+        return self._lib.rts_contains(self._h, object_id.encode()) == 1
+
+    def delete(self, object_id: str) -> bool:
+        return self._lib.rts_delete(self._h, object_id.encode()) == 0
+
+    def pin(self, object_id: str, delta: int = 1) -> int:
+        return self._lib.rts_pin(self._h, object_id.encode(), delta)
+
+    def evict(self, nbytes: int) -> int:
+        return self._lib.rts_evict(self._h, nbytes)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.rts_stats(self._h, out)
+        return {"capacity": out[0], "used": out[1], "num_objects": out[2],
+                "num_evictions": out[3]}
+
+    def close(self) -> None:
+        if self._h:
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                pass  # live object views reference the map; dies with process
+            self._lib.rts_close(self._h)
+            self._h = 0

@@ -4,12 +4,16 @@ Counterpart of the reference's plasma store (`src/ray/object_manager/plasma/`,
 `store.h:55`): one node-local store holding immutable serialized objects that
 any process on the host can map zero-copy. Design differences, on purpose:
 
-- One tmpfs-backed file per object under /dev/shm/<session>/ instead of one
-  dlmalloc arena: ownership and cleanup become trivial (driver unlinks the
-  session dir), at the cost of a file create per large object. The interface
-  (`create/seal/get/delete/contains`) matches plasma's client verbs
-  (plasma/client.h) so a C++ slab allocator can replace the backend without
-  touching callers.
+- The hot path is a native C++ arena (`_private/native/store.cc`: boundary-
+  tag allocator + object index in one shm mapping, the counterpart of
+  plasma's dlmalloc arena + `object_lifecycle_manager.h`), reached via the
+  ctypes client in `_private/native/arena.py`. Objects put by the runtime
+  are pinned (plasma Get/Release analog) so LRU eviction only reclaims
+  explicitly released space; lifetime is owner-driven via `delete`.
+- When the native library is unavailable (RAY_TPU_DISABLE_NATIVE=1, no
+  toolchain) or the arena is full, objects fall back to one tmpfs-backed
+  file per object under /dev/shm/<session>/ — same create/seal/get/delete
+  verbs, so callers never see the difference.
 - Small objects never touch the store; they ride inline in control messages
   (the reference similarly returns small task outputs inline in the gRPC
   reply and keeps them in the in-process memory store,
@@ -34,11 +38,12 @@ from ray_tpu.exceptions import ObjectLostError
 
 @dataclass(frozen=True)
 class Descriptor:
-    """Location of a sealed object's bytes. Either inline or file-backed."""
+    """Location of a sealed object's bytes: inline, arena, or file-backed."""
     object_id: str
     size: int
     inline: bytes | None = None  # set iff the object is small
     path: str | None = None      # set iff the object lives in the store dir
+    arena: bool = False          # set iff the object lives in the shm arena
 
 
 class ObjectStore:
@@ -48,20 +53,38 @@ class ObjectStore:
         self._dir = os.path.join(session_dir, "objects")
         os.makedirs(self._dir, exist_ok=True)
         # Keep mmaps alive while deserialized views may reference them.
-        # obj_id -> (mmap, file size). Never evicted within a session in v1;
-        # the eviction/spilling policy slot is here (reference: eviction_policy.h).
+        # obj_id -> (mmap, file size) for file-backed objects only.
         self._maps: dict[str, mmap.mmap] = {}
         self._lock = threading.Lock()
+        from ray_tpu._private.native.arena import Arena
+        self._arena = Arena.open(session_dir)
+        # object_id -> pinned arena view held for the process lifetime
+        self._views: dict[str, memoryview] = {}
 
     # -- write path ---------------------------------------------------------
 
     def put(self, object_id: str, value) -> Descriptor:
-        """Serialize `value`; small -> inline descriptor, large -> shm file."""
+        """Serialize `value`; small -> inline descriptor, large -> shm arena
+        (native) with per-object file fallback."""
         size, meta, buffers = serialization.serialized_size(value)
         if size <= INLINE_OBJECT_MAX_BYTES:
             out = bytearray(size)
             n = serialization.write_envelope(memoryview(out), meta, buffers)
             return Descriptor(object_id, n, inline=bytes(out[:n]))
+        if self._arena is not None:
+            buf = self._arena.create(object_id, size)
+            if buf is not None:
+                try:
+                    n = serialization.write_envelope(buf, meta, buffers)
+                except BaseException:
+                    # reclaim the reservation or it leaks for the session
+                    self._arena.delete(object_id)
+                    raise
+                # pin BEFORE sealing: a sealed unpinned object is a valid
+                # LRU-eviction victim for a concurrent out-of-space create
+                self._arena.pin(object_id, 1)
+                self._arena.seal(object_id)
+                return Descriptor(object_id, n, arena=True)
         path = os.path.join(self._dir, object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb+") as f:
@@ -78,6 +101,13 @@ class ObjectStore:
         """Store an already-serialized envelope (e.g. received over DCN)."""
         if len(payload) <= INLINE_OBJECT_MAX_BYTES:
             return Descriptor(object_id, len(payload), inline=payload)
+        if self._arena is not None:
+            buf = self._arena.create(object_id, len(payload))
+            if buf is not None:
+                buf[:] = payload
+                self._arena.pin(object_id, 1)   # before seal; see put()
+                self._arena.seal(object_id)
+                return Descriptor(object_id, len(payload), arena=True)
         path = os.path.join(self._dir, object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb") as f:
@@ -91,6 +121,9 @@ class ObjectStore:
         """Deserialize the object a descriptor points at (zero-copy mmap)."""
         if desc.inline is not None:
             return serialization.loads(desc.inline)
+        if desc.arena:
+            view = self._arena_view(desc)
+            return serialization.loads(view)
         with self._lock:
             m = self._maps.get(desc.object_id)
             if m is None:
@@ -105,16 +138,45 @@ class ObjectStore:
                 self._maps[desc.object_id] = m
         return serialization.loads(m)
 
+    def _arena_view(self, desc: Descriptor) -> memoryview:
+        """Pinned read view. The pin (acquire) is taken once per process per
+        object and held for the process lifetime, so deserialized zero-copy
+        arrays can never be freed/reused underneath a live reference —
+        the analog of a plasma client holding the buffer until Release."""
+        if self._arena is None:
+            raise ObjectLostError(
+                f"object {desc.object_id} is arena-backed but this process "
+                "has no native arena (RAY_TPU_DISABLE_NATIVE mismatch?)")
+        with self._lock:
+            view = self._views.get(desc.object_id)
+            if view is None:
+                view = self._arena.acquire(desc.object_id)
+                if view is None:
+                    raise ObjectLostError(
+                        f"object {desc.object_id} missing from arena "
+                        "(evicted or deleted)")
+                self._views[desc.object_id] = view
+        return view[:desc.size]
+
     def raw_bytes(self, desc: Descriptor) -> bytes:
         """The serialized envelope (for forwarding across nodes)."""
         if desc.inline is not None:
             return desc.inline
+        if desc.arena:
+            return bytes(self._arena_view(desc))
         with open(desc.path, "rb") as f:
             return f.read()
 
     # -- lifecycle ----------------------------------------------------------
 
     def delete(self, desc: Descriptor) -> None:
+        if desc.arena:
+            if self._arena is not None:
+                # drop the put-time owner pin, then delete: frees now if no
+                # reader pins, else condemns until the last reader releases
+                self._arena.pin(desc.object_id, -1)
+                self._arena.delete(desc.object_id)
+            return
         with self._lock:
             m = self._maps.pop(desc.object_id, None)
         if m is not None:
@@ -136,3 +198,13 @@ class ObjectStore:
                 m.close()
             except BufferError:
                 pass
+        if self._arena is not None:
+            with self._lock:
+                views, self._views = self._views, {}
+            for v in views.values():
+                try:
+                    v.release()
+                except BufferError:
+                    pass
+            self._arena.close()
+            self._arena = None

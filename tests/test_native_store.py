@@ -1,0 +1,195 @@
+"""Native shared-memory arena store (ray_tpu/_private/native/store.cc).
+
+Counterpart of the reference's plasma tests
+(src/ray/object_manager/plasma/test/, python/ray/tests/test_object_store.py):
+create/seal visibility, zero-copy reads, delete/coalescing, LRU eviction of
+unpinned objects, pin protection, cross-process sharing, and the
+ObjectStore integration (arena-backed descriptors end to end).
+"""
+
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.native.arena import Arena
+from ray_tpu._private.object_store import ObjectStore
+
+
+@pytest.fixture
+def arena(tmp_path):
+    a = Arena.open(str(tmp_path), capacity=4 * 1024 * 1024)
+    if a is None:
+        pytest.skip("native toolchain unavailable")
+    yield a
+    a.close()
+
+
+def test_create_seal_lookup(arena):
+    buf = arena.create("obj_a", 100)
+    assert buf is not None and len(buf) == 100
+    buf[:3] = b"xyz"
+    # invisible until sealed (plasma create->seal contract)
+    assert arena.lookup("obj_a") is None
+    assert not arena.contains("obj_a")
+    assert arena.seal("obj_a")
+    view = arena.lookup("obj_a")
+    assert bytes(view[:3]) == b"xyz"
+    assert view.readonly
+    assert arena.contains("obj_a")
+
+
+def test_duplicate_create_rejected(arena):
+    assert arena.create("obj_d", 10) is not None
+    assert arena.create("obj_d", 10) is None
+
+
+def test_delete_frees_and_coalesces(arena):
+    used0 = arena.stats()["used"]
+    for i in range(8):
+        arena.create(f"obj_{i}", 50_000)
+        arena.seal(f"obj_{i}")
+    for i in range(8):
+        assert arena.delete(f"obj_{i}")
+    assert arena.stats()["used"] == used0
+    # freed space is reusable as one large block (coalescing)
+    assert arena.create("obj_big", 350_000) is not None
+
+
+def test_lru_eviction_unpinned_only(arena):
+    cap = arena.stats()["capacity"]
+    n = 0
+    while True:
+        buf = arena.create(f"obj_e{n}", 100_000)
+        if buf is None:
+            break
+        arena.seal(f"obj_e{n}")
+        n += 1
+        if n > 200:
+            break
+    st = arena.stats()
+    assert st["num_evictions"] > 0          # old ones were evicted to fit
+    assert not arena.contains("obj_e0")     # LRU victim
+    assert st["used"] <= cap
+
+
+def test_pin_blocks_eviction(arena):
+    arena.create("obj_pinned", 100_000)
+    arena.seal("obj_pinned")
+    assert arena.pin("obj_pinned", 1) == 1
+    for i in range(100):
+        if arena.create(f"obj_f{i}", 100_000) is None:
+            break
+        arena.seal(f"obj_f{i}")
+    assert arena.contains("obj_pinned")
+    assert arena.pin("obj_pinned", -1) == 0
+
+
+def test_acquire_protects_live_views_from_delete(arena):
+    buf = arena.create("obj_live", 50_000)
+    buf[:4] = b"data"
+    arena.seal("obj_live")
+    view = arena.acquire("obj_live")          # reader pin
+    assert arena.delete("obj_live")           # condemned, not freed
+    # object invisible to new readers
+    assert arena.lookup("obj_live") is None
+    assert not arena.contains("obj_live")
+    # but the pinned view's bytes must still be intact after new allocations
+    for i in range(10):
+        w = arena.create(f"obj_churn{i}", 50_000)
+        if w is None:
+            break
+        w[:4] = b"XXXX"
+        arena.seal(f"obj_churn{i}")
+    assert bytes(view[:4]) == b"data"
+
+
+def test_condemned_block_freed_on_release(arena):
+    arena.create("obj_rel", 60_000)
+    arena.seal("obj_rel")
+    arena.pin("obj_rel", 1)                   # owner pin (put() path)
+    view = arena.acquire("obj_rel")           # reader pin -> refcnt 2
+    used_full = arena.stats()["used"]
+    assert arena.pin("obj_rel", -1) == 1      # owner releases (delete path)
+    assert arena.delete("obj_rel")            # reader remains -> condemned
+    assert arena.stats()["used"] == used_full  # still allocated (reader)
+    view.release()
+    assert arena.pin("obj_rel", -1) == 0      # reader releases -> freed
+    assert arena.stats()["used"] < used_full
+
+
+def test_create_failure_cleanup_path(tmp_path):
+    """put() must reclaim the reservation if serialization fails midway."""
+    store = ObjectStore(str(tmp_path))
+    if store._arena is None:
+        pytest.skip("native toolchain unavailable")
+
+    class Evil:
+        def __reduce__(self):
+            raise RuntimeError("unpicklable")
+
+    used0 = store._arena.stats()["used"]
+    big = np.zeros(200_000, dtype=np.uint8)
+    with pytest.raises(Exception):
+        store.put("obj_evil", [big, Evil()])
+    assert store._arena.stats()["used"] == used0
+    store.close()
+
+
+def _xproc_child(session_dir, q):
+    a = Arena.open(session_dir)
+    v = a.lookup("obj_shared")
+    q.put(bytes(v[:6]) if v is not None else None)
+    a.close()
+
+
+def test_cross_process_visibility(tmp_path):
+    a = Arena.open(str(tmp_path), capacity=2 * 1024 * 1024)
+    if a is None:
+        pytest.skip("native toolchain unavailable")
+    buf = a.create("obj_shared", 150_000)
+    buf[:6] = b"shared"
+    a.seal("obj_shared")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_xproc_child, args=(str(tmp_path), q))
+    p.start()
+    assert q.get(timeout=60) == b"shared"
+    p.join(60)
+    a.close()
+
+
+def test_object_store_arena_roundtrip(tmp_path):
+    store = ObjectStore(str(tmp_path))
+    if store._arena is None:
+        pytest.skip("native toolchain unavailable")
+    arr = np.arange(200_000, dtype=np.float32)   # > inline threshold
+    desc = store.put("obj_np", arr)
+    assert desc.arena and desc.path is None
+    out = store.get(desc)
+    np.testing.assert_array_equal(out, arr)
+    # zero-copy: result is read-only (backed by the shm mapping)
+    assert not out.flags.writeable
+    payload = store.raw_bytes(desc)
+    desc2 = store.put_serialized("obj_np2", payload)
+    np.testing.assert_array_equal(store.get(desc2), arr)
+    store.delete(desc)
+    store.close()
+
+
+def test_object_store_file_fallback_when_arena_full(tmp_path):
+    os.environ["RAY_TPU_OBJECT_STORE_BYTES"] = "1048576"
+    try:
+        store = ObjectStore(str(tmp_path))
+        if store._arena is None:
+            pytest.skip("native toolchain unavailable")
+        # bigger than the whole arena -> file-backed, still readable
+        arr = np.arange(1_000_000, dtype=np.float64)
+        desc = store.put("obj_huge", arr)
+        assert not desc.arena and desc.path is not None
+        np.testing.assert_array_equal(store.get(desc), arr)
+        store.close()
+    finally:
+        del os.environ["RAY_TPU_OBJECT_STORE_BYTES"]
