@@ -154,3 +154,9 @@ def available_resources() -> dict:
 def nodes() -> list:
     res = cluster_resources()
     return [{"NodeID": "local", "Alive": True, "Resources": res}]
+
+
+def timeline(filename: str | None = None):
+    """Chrome-trace task timeline (`ray.timeline` counterpart)."""
+    from ray_tpu.util import state as _state
+    return _state.timeline(filename)

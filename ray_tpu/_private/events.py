@@ -1,0 +1,118 @@
+"""Task lifecycle event recording (driver side).
+
+Counterpart of the reference's task-event pipeline: workers buffer task
+state transitions (`src/ray/core_worker/task_event_buffer.h:193`
+TaskEventBuffer), the GCS aggregates them (`gcs_task_manager.h:61`, with a
+bounded in-memory ring), and the state API / chrome-trace timeline read
+them back (`dashboard/state_aggregator.py:141`, `ray timeline`). Here the
+driver process *is* the node, so transitions are recorded in place when the
+NodeServer mutates task state — no buffering hop needed; the bounded-ring
+retention policy is kept.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+# Reference keeps at most RAY_task_events_max_num_task_in_gcs (default 100k)
+# tasks; same order of magnitude here.
+MAX_TRACKED_TASKS = 100_000
+
+
+class TaskEventRecorder:
+    """Bounded table of per-task lifecycle records + transition log."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # task_id -> record dict (insertion-ordered for FIFO trimming)
+        self._tasks: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+
+    def _rec(self, task_id: str) -> dict:
+        r = self._tasks.get(task_id)
+        if r is None:
+            r = {"task_id": task_id, "name": "", "state": "NIL",
+                 "actor_id": None, "worker_id": None, "error": None,
+                 "submitted_ts": None, "start_ts": None, "end_ts": None,
+                 "attempt": 0}
+            self._tasks[task_id] = r
+            while len(self._tasks) > MAX_TRACKED_TASKS:
+                self._tasks.popitem(last=False)
+        return r
+
+    # -- transitions (called by NodeServer under its own lock) --------------
+
+    def submitted(self, spec, waiting_args: bool) -> None:
+        with self._lock:
+            r = self._rec(spec.task_id)
+            r["name"] = spec.name or spec.function_desc
+            r["actor_id"] = spec.actor_id
+            r["state"] = ("PENDING_ARGS_AVAIL" if waiting_args
+                          else "PENDING_NODE_ASSIGNMENT")
+            r["submitted_ts"] = time.time()
+
+    def running(self, spec, worker_id: str) -> None:
+        with self._lock:
+            r = self._rec(spec.task_id)
+            r["state"] = "RUNNING"
+            r["worker_id"] = worker_id
+            r["start_ts"] = time.time()
+
+    def requeued(self, spec) -> None:
+        with self._lock:
+            r = self._rec(spec.task_id)
+            r["state"] = "PENDING_NODE_ASSIGNMENT"
+            r["attempt"] += 1
+
+    def finished(self, task_id: str, error: str | None = None) -> None:
+        with self._lock:
+            r = self._rec(task_id)
+            r["state"] = "FAILED" if error else "FINISHED"
+            r["error"] = error
+            r["end_ts"] = time.time()
+
+    # -- reads --------------------------------------------------------------
+
+    def snapshot(self, filters: dict | None = None,
+                 limit: int = 10_000) -> list[dict]:
+        with self._lock:
+            out = []
+            for r in reversed(self._tasks.values()):   # newest first
+                if filters and any(r.get(k) != v for k, v in filters.items()):
+                    continue
+                out.append(dict(r))
+                if len(out) >= limit:
+                    break
+            return out
+
+    def summary(self) -> dict:
+        """Counts by (name, state) — `ray summary tasks` equivalent."""
+        with self._lock:
+            counts: dict = {}
+            for r in self._tasks.values():
+                key = r["name"]
+                per = counts.setdefault(key, {})
+                per[r["state"]] = per.get(r["state"], 0) + 1
+            return counts
+
+    def chrome_trace(self) -> list[dict]:
+        """Task spans in chrome://tracing 'complete event' format
+        (`ray timeline` counterpart)."""
+        now = time.time()
+        with self._lock:
+            events = []
+            for r in self._tasks.values():
+                if r["start_ts"] is None:
+                    continue
+                end = r["end_ts"] or now
+                events.append({
+                    "name": r["name"], "cat": "task", "ph": "X",
+                    "ts": r["start_ts"] * 1e6,
+                    "dur": (end - r["start_ts"]) * 1e6,
+                    "pid": "node", "tid": r["worker_id"] or "driver",
+                    "args": {"task_id": r["task_id"], "state": r["state"],
+                             "actor_id": r["actor_id"]},
+                })
+            return events

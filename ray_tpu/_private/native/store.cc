@@ -409,6 +409,7 @@ int rts_delete(void* vh, const char* id) {
       }
       h->hdr->num_objects--;
     } else {
+      // num_objects stays: decremented when the last pin frees the block
       s->state = kCondemned;
     }
     rc = 0;
@@ -432,6 +433,7 @@ int rts_pin(void* vh, const char* id, int delta) {
     if (s->state == kCondemned && s->refcnt == 0 && !h->hdr->poisoned) {
       free_block(h, s->offset);
       s->state = kTombstone;
+      h->hdr->num_objects--;
     }
     rc = (int)s->refcnt;
   }

@@ -21,6 +21,7 @@ is the round-2+ path to the reference's 2000-node envelope (BASELINE.md).
 from __future__ import annotations
 
 import atexit
+import itertools
 import logging
 import os
 import shutil
@@ -154,6 +155,11 @@ class NodeServer:
         self.kv: dict[tuple, bytes] = {}
 
         self._task_errors: dict[str, str] = {}
+        # Observability: task lifecycle records (reference: TaskEventBuffer →
+        # GcsTaskManager) + per-process metrics snapshots pushed by workers.
+        from ray_tpu._private.events import TaskEventRecorder
+        self.task_events = TaskEventRecorder()
+        self.metrics_by_proc: dict[str, list] = {}
         self._shutdown = False
         self._spawning = 0      # generic workers currently starting up
         self._spawn_failures = 0  # consecutive startup failures
@@ -292,6 +298,79 @@ class NodeServer:
             return self.remove_placement_group(payload)
         if method == "cancel":
             return self.cancel(payload["object_id"], payload.get("force", False))
+        if method == "list_tasks":
+            return self.task_events.snapshot(
+                filters=(payload or {}).get("filters"),
+                limit=(payload or {}).get("limit", 10_000))
+        if method == "summarize_tasks":
+            return self.task_events.summary()
+        if method == "timeline":
+            return self.task_events.chrome_trace()
+        if method == "list_actors":
+            with self.lock:
+                return [{
+                    "actor_id": a.actor_id,
+                    "class_name": a.creation_spec.function_desc,
+                    "name": a.name,
+                    "state": ("DEAD" if a.dead else
+                              "ALIVE" if a.ready else "PENDING_CREATION"),
+                    "death_cause": a.death_cause or None,
+                    "pending_tasks": len(a.queue),
+                    "resources": dict(a.resources),
+                    "worker_id": a.worker.worker_id if a.worker else None,
+                } for a in itertools.islice(
+                    self.actors.values(),
+                    (payload or {}).get("limit", 10_000))]
+        if method == "list_objects":
+            with self.lock:
+                return [{
+                    "object_id": oid, "size_bytes": desc.size,
+                    "store": ("inline" if desc.inline is not None else
+                              "arena" if desc.arena else "file"),
+                } for oid, desc in itertools.islice(
+                    self.directory.items(),
+                    (payload or {}).get("limit", 10_000))]
+        if method == "list_workers":
+            with self.lock:
+                return [{
+                    "worker_id": w.worker_id, "kind": w.kind,
+                    "alive": w.alive, "idle": w.idle,
+                    "current_task": (w.current.spec.task_id
+                                     if w.current else None),
+                    "pid": getattr(w.proc, "pid", None),
+                } for w in itertools.islice(
+                    self.workers.values(),
+                    (payload or {}).get("limit", 10_000))]
+        if method == "list_placement_groups":
+            with self.lock:
+                return [{
+                    "placement_group_id": pg.pg_id,
+                    "strategy": pg.strategy,
+                    "bundles": [dict(b) for b in pg.bundles],
+                    "available": [dict(b) for b in pg.available],
+                } for pg in itertools.islice(
+                    self.placement_groups.values(),
+                    (payload or {}).get("limit", 10_000))]
+        if method == "list_nodes":
+            with self.lock:
+                return [{
+                    "node_id": self.node_id, "alive": True,
+                    "resources_total": dict(self.total_resources),
+                    "resources_available": dict(self.available),
+                    "session_dir": self.session_dir,
+                }]
+        if method == "push_metrics":
+            wid, snap = payload
+            with self.lock:
+                self.metrics_by_proc[wid] = snap
+            return True
+        if method == "get_metrics":
+            from ray_tpu.util import metrics as _metrics
+            with self.lock:
+                snaps = list(self.metrics_by_proc.values())
+            # driver-process metrics participate directly
+            snaps.append(_metrics.snapshot())
+            return _metrics.merge_snapshots(snaps)
         if method == "actor_state":
             with self.lock:
                 a = self.actors.get(payload)
@@ -397,6 +476,7 @@ class NodeServer:
                 if kind == "ref" and v not in self.directory:
                     t.deps.add(v)
                     self.obj_waiting_tasks.setdefault(v, []).append(t)
+            self.task_events.submitted(spec, bool(t.deps))
             if spec.actor_creation:
                 _name = (spec.runtime_env or {}).get("_name")
                 if _name and _name in self.named_actors:
@@ -425,7 +505,8 @@ class NodeServer:
                     self._store_error(
                         spec.return_ids,
                         ActorDiedError(f"actor {spec.actor_id} is dead: "
-                                       f"{cause}"))
+                                       f"{cause}"),
+                        task_id=spec.task_id)
                     return
                 a.queue.append(t)
             else:
@@ -544,7 +625,8 @@ class NodeServer:
                 self.workers.pop(worker_id, None)
             self._store_error(
                 t.spec.return_ids,
-                WorkerCrashedError("TPU worker failed to start"))
+                WorkerCrashedError("TPU worker failed to start"),
+                task_id=t.spec.task_id)
             return
         with self.lock:
             w.current = t
@@ -561,6 +643,7 @@ class NodeServer:
         for kind, v in list(spec.args) + list(spec.kwargs.values()):
             if kind == "ref":
                 locs[v] = self.directory[v]
+        self.task_events.running(t.spec, worker.worker_id)
         return protocol.PushTask(spec=spec, arg_locations=locs)
 
     def _try_dispatch_actor_creation(self, t: _TaskState, to_send):
@@ -754,8 +837,11 @@ class NodeServer:
             if (msg.error and t.retry_exceptions and t.retries_left > 0
                     and not spec.actor_creation):
                 t.retries_left -= 1
+                self.task_events.requeued(spec)
                 self._requeue_after_failure(w, t, a)
                 return
+            self.task_events.finished(
+                msg.task_id, error="application_error" if msg.error else None)
             for oid, desc in zip(spec.return_ids, msg.return_descs):
                 self.directory[oid] = desc
                 for dep_t in self.obj_waiting_tasks.pop(oid, ()):
@@ -774,7 +860,8 @@ class NodeServer:
                             self._store_error(
                                 qt.spec.return_ids,
                                 ActorDiedError(
-                                    f"actor {a.actor_id} constructor raised"))
+                                    f"actor {a.actor_id} constructor raised"),
+                                task_id=qt.spec.task_id)
                     else:
                         a.ready = True
                 if a.worker is w:
@@ -843,8 +930,12 @@ class NodeServer:
             self.free_tpu_chips.extend(a.tpu_chips)
             a.tpu_chips = []
 
-    def _store_error(self, return_ids, exc):
-        """Store `exc` as the value of every return id (under or out of lock)."""
+    def _store_error(self, return_ids, exc, task_id=None):
+        """Store `exc` as the value of every return id (under or out of lock).
+        `task_id` records the terminal FAILED transition in the state API —
+        this is the chokepoint every failure path goes through."""
+        if task_id is not None:
+            self.task_events.finished(task_id, error=type(exc).__name__)
         for oid in return_ids:
             desc = self.store.put(oid, exc)
             self.directory[oid] = desc
@@ -874,6 +965,7 @@ class NodeServer:
                 if t.retries_left > 0:
                     t.retries_left -= 1
                     self.pending.append(t)
+                    self.task_events.requeued(t.spec)
                     retry = True
                 else:
                     retry = False
@@ -881,7 +973,8 @@ class NodeServer:
                 self._store_error(
                     t.spec.return_ids,
                     WorkerCrashedError(
-                        f"worker died while running {t.spec.function_desc}"))
+                        f"worker died while running {t.spec.function_desc}"),
+                    task_id=t.spec.task_id)
         self._schedule()
 
     def _on_actor_worker_death(self, a: _ActorState):
@@ -921,7 +1014,8 @@ class NodeServer:
             self._store_error(
                 t.spec.return_ids,
                 ActorDiedError(f"actor {a.actor_id} died"
-                               f" ({a.death_cause or 'restarting'})"))
+                               f" ({a.death_cause or 'restarting'})"),
+                task_id=t.spec.task_id)
         self._schedule()
 
     def _fail_actor(self, a: _ActorState, cause: str):
@@ -932,9 +1026,11 @@ class NodeServer:
             a.inflight, a.queue = [], []
             self._release_actor_resources(a)
         for t in tasks:
-            self._store_error(t.spec.return_ids, ActorDiedError(cause))
+            self._store_error(t.spec.return_ids, ActorDiedError(cause),
+                              task_id=t.spec.task_id)
         # creation return id too
-        self._store_error(a.creation_spec.return_ids, ActorDiedError(cause))
+        self._store_error(a.creation_spec.return_ids, ActorDiedError(cause),
+                          task_id=a.creation_spec.task_id)
 
     # ------------------------------------------------------------------
     # actor control
@@ -976,7 +1072,8 @@ class NodeServer:
                     t.cancelled = True
                     self.pending.remove(t)
                     self._store_error(t.spec.return_ids,
-                                      TaskCancelledError("task cancelled"))
+                                      TaskCancelledError("task cancelled"),
+                                      task_id=t.spec.task_id)
                     return True
             for a in self.actors.values():
                 for t in a.queue:
@@ -984,7 +1081,8 @@ class NodeServer:
                         t.cancelled = True
                         a.queue.remove(t)
                         self._store_error(t.spec.return_ids,
-                                          TaskCancelledError("task cancelled"))
+                                          TaskCancelledError("task cancelled"),
+                                          task_id=t.spec.task_id)
                         return True
         return False
 

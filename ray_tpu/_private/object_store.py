@@ -58,8 +58,10 @@ class ObjectStore:
         self._lock = threading.Lock()
         from ray_tpu._private.native.arena import Arena
         self._arena = Arena.open(session_dir)
-        # object_id -> pinned arena view held for the process lifetime
+        # object_id -> pinned arena view held until delete() or close()
         self._views: dict[str, memoryview] = {}
+        # ids this process put (and therefore owner-pinned)
+        self._owned: set[str] = set()
 
     # -- write path ---------------------------------------------------------
 
@@ -84,6 +86,8 @@ class ObjectStore:
                 # LRU-eviction victim for a concurrent out-of-space create
                 self._arena.pin(object_id, 1)
                 self._arena.seal(object_id)
+                with self._lock:
+                    self._owned.add(object_id)
                 return Descriptor(object_id, n, arena=True)
         path = os.path.join(self._dir, object_id)
         tmp = path + ".tmp.%d" % os.getpid()
@@ -107,6 +111,8 @@ class ObjectStore:
                 buf[:] = payload
                 self._arena.pin(object_id, 1)   # before seal; see put()
                 self._arena.seal(object_id)
+                with self._lock:
+                    self._owned.add(object_id)
                 return Descriptor(object_id, len(payload), arena=True)
         path = os.path.join(self._dir, object_id)
         tmp = path + ".tmp.%d" % os.getpid()
@@ -172,10 +178,25 @@ class ObjectStore:
     def delete(self, desc: Descriptor) -> None:
         if desc.arena:
             if self._arena is not None:
-                # drop the put-time owner pin, then delete: frees now if no
-                # reader pins, else condemns until the last reader releases
-                self._arena.pin(desc.object_id, -1)
-                self._arena.delete(desc.object_id)
+                oid = desc.object_id
+                with self._lock:
+                    view = self._views.pop(oid, None)
+                    owned = oid in self._owned
+                    self._owned.discard(oid)
+                # drop THIS process's pins only (owner pin from put, reader
+                # pin from get) — never another process's reader pin — then
+                # delete: frees now if unpinned, else condemns until the
+                # last remaining reader releases
+                if view is not None:
+                    try:
+                        view.release()
+                    except BufferError:
+                        pass  # a live numpy view borrows it; pin stays held
+                    else:
+                        self._arena.pin(oid, -1)
+                if owned:
+                    self._arena.pin(oid, -1)
+                self._arena.delete(oid)
             return
         with self._lock:
             m = self._maps.pop(desc.object_id, None)

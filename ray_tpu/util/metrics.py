@@ -1,0 +1,245 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+Counterpart of `ray.util.metrics` (`python/ray/util/metrics.py:150,215,290`)
+over the reference's OpenCensus pipeline (`src/ray/stats/metric.h:103` →
+per-node metrics agent → Prometheus scrape). Here each process keeps a
+registry; worker processes flush snapshots to the driver over the control
+channel (the metrics-agent hop), and the driver aggregates across
+processes. `render_prometheus` emits the text exposition format the
+dashboard's /metrics endpoint serves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+_FLUSH_PERIOD_S = 5.0
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000]
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.metrics: dict[str, "Metric"] = {}
+        self._flusher_started = False
+
+    def register(self, metric: "Metric"):
+        with self.lock:
+            existing = self.metrics.get(metric.name)
+            if existing is not None and type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered as "
+                    f"{type(existing).__name__}")
+            self.metrics[metric.name] = metric
+        self._ensure_flusher()
+
+    def snapshot(self) -> list[dict]:
+        with self.lock:
+            return [m._snapshot() for m in self.metrics.values()]
+
+    def _ensure_flusher(self):
+        """Workers push snapshots to the driver periodically (the
+        worker → metrics-agent hop in the reference)."""
+        if self._flusher_started:
+            return
+        from ray_tpu._private import worker as _worker
+        client = _worker._global_client
+        if client is None or client.mode != "worker":
+            return
+        self._flusher_started = True
+        wid = getattr(client.rt, "worker_id", "worker")
+
+        def _loop():
+            while True:
+                time.sleep(_FLUSH_PERIOD_S)
+                try:
+                    client.control("push_metrics", (wid, self.snapshot()))
+                except Exception:
+                    return  # driver gone; session over
+
+        threading.Thread(target=_loop, name="ray_tpu-metrics-flush",
+                         daemon=True).start()
+
+    def flush_now(self):
+        from ray_tpu._private import worker as _worker
+        client = _worker._global_client
+        if client is not None and client.mode == "worker":
+            try:
+                wid = getattr(client.rt, "worker_id", "worker")
+                client.control("push_metrics", (wid, self.snapshot()))
+            except Exception:
+                pass
+
+
+_registry = _Registry()
+
+
+def _check_tags(declared: Tuple[str, ...], given: Optional[Dict[str, str]],
+                default: Optional[Dict[str, str]]):
+    tags = dict(default or {})
+    if given:
+        tags.update(given)
+    extra = set(tags) - set(declared)
+    missing = set(declared) - set(tags)
+    if extra or missing:
+        raise ValueError(
+            f"tag keys mismatch: declared {declared}, got {sorted(tags)}")
+    return tuple(sorted(tags.items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        if not name:
+            raise ValueError("metric name is required")
+        if isinstance(tag_keys, str) or not all(
+                isinstance(k, str) for k in tag_keys):
+            raise TypeError("tag_keys must be a tuple of strings")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        self._series: dict[tuple, float] = {}
+        _registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "type": type(self).__name__.lower(),
+                    "description": self.description,
+                    "series": dict(self._series)}
+
+
+class Counter(Metric):
+    """Monotonically increasing value (util/metrics.py:150)."""
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        key = _check_tags(self.tag_keys, tags, self._default_tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    """Last-set value (util/metrics.py:215)."""
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        key = _check_tags(self.tag_keys, tags, self._default_tags)
+        with self._lock:
+            self._series[key] = float(value)
+
+
+class Histogram(Metric):
+    """Bucketed observations (util/metrics.py:290)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[list] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        key = _check_tags(self.tag_keys, tags, self._default_tags)
+        with self._lock:
+            buckets, total, count = self._series.get(
+                key, ([0] * (len(self.boundaries) + 1), 0.0, 0))
+            buckets = list(buckets)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._series[key] = (buckets, total + value, count + 1)
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "type": "histogram",
+                    "description": self.description,
+                    "boundaries": list(self.boundaries),
+                    "series": {k: (list(v[0]), v[1], v[2])
+                               for k, v in self._series.items()}}
+
+
+def snapshot() -> list[dict]:
+    """This process's metrics."""
+    return _registry.snapshot()
+
+
+def flush() -> None:
+    """Push this worker's metrics to the driver immediately."""
+    _registry.flush_now()
+
+
+def merge_snapshots(snapshots: list[list[dict]]) -> list[dict]:
+    """Aggregate per-process snapshots (driver side): counters/histograms
+    sum across processes; gauges keep the last writer."""
+    out: dict[str, dict] = {}
+    for snap in snapshots:
+        for m in snap:
+            cur = out.get(m["name"])
+            if cur is None:
+                out[m["name"]] = {**m, "series": dict(m["series"])}
+                continue
+            for key, val in m["series"].items():
+                if m["type"] == "counter":
+                    cur["series"][key] = cur["series"].get(key, 0.0) + val
+                elif m["type"] == "histogram":
+                    prev = cur["series"].get(key)
+                    if prev is None:
+                        cur["series"][key] = val
+                    else:
+                        cur["series"][key] = (
+                            [a + b for a, b in zip(prev[0], val[0])],
+                            prev[1] + val[1], prev[2] + val[2])
+                else:
+                    cur["series"][key] = val
+    return list(out.values())
+
+
+def _esc(value) -> str:
+    """Escape a label value per the prometheus exposition format."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(pairs) -> str:
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
+
+
+def render_prometheus(metrics: list[dict]) -> str:
+    """Prometheus text exposition of an aggregated snapshot."""
+    lines = []
+    for m in metrics:
+        name = "ray_tpu_" + m["name"]
+        lines.append(f"# HELP {name} {_esc(m['description'])}")
+        lines.append(f"# TYPE {name} {m['type']}")
+        for key, val in m["series"].items():
+            label = _labels(key)
+            if m["type"] == "histogram":
+                buckets, total, count = val
+                cum = 0
+                for i, b in enumerate(m["boundaries"]):
+                    cum += buckets[i]
+                    lines.append(
+                        f"{name}_bucket{_labels(key + ((('le'), repr(b)),))}"
+                        f" {cum}")
+                cum += buckets[-1]
+                lines.append(
+                    f"{name}_bucket{_labels(key + (('le', '+Inf'),))} {cum}")
+                lines.append(f"{name}_sum{label} {total}")
+                lines.append(f"{name}_count{label} {count}")
+            else:
+                lines.append(f"{name}{label} {val}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,69 @@
+"""State API: list/summarize live cluster state.
+
+Counterpart of the reference's `ray.experimental.state.api`
+(`experimental/state/api.py` list_tasks/list_actors/list_objects/… served
+by `dashboard/state_aggregator.py:141` StateAPIManager over GCS + task
+events). Here the driver's NodeServer holds all the state, so these are
+thin control-channel reads.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu._private import worker as _worker
+
+
+def _control(method: str, payload=None):
+    return _worker.get_client().control(method, payload)
+
+
+def list_tasks(filters: dict | None = None, limit: int = 10_000) -> list[dict]:
+    """Lifecycle records for recent tasks (state `ray list tasks`)."""
+    return _control("list_tasks", {"filters": filters, "limit": limit})
+
+
+def list_actors(limit: int = 10_000) -> list[dict]:
+    return _control("list_actors", {"limit": limit})
+
+
+def list_objects(limit: int = 10_000) -> list[dict]:
+    return _control("list_objects", {"limit": limit})
+
+
+def list_workers(limit: int = 10_000) -> list[dict]:
+    return _control("list_workers", {"limit": limit})
+
+
+def list_placement_groups(limit: int = 10_000) -> list[dict]:
+    return _control("list_placement_groups", {"limit": limit})
+
+
+def list_nodes() -> list[dict]:
+    return _control("list_nodes")
+
+
+def summarize_tasks() -> dict:
+    """Counts by task name and state (`ray summary tasks`)."""
+    return _control("summarize_tasks")
+
+
+def get_metrics() -> list[dict]:
+    """Aggregated metrics snapshot across driver + workers."""
+    return _control("get_metrics")
+
+
+def prometheus_metrics() -> str:
+    """Prometheus text exposition of the aggregated snapshot."""
+    from ray_tpu.util import metrics as _metrics
+    return _metrics.render_prometheus(get_metrics())
+
+
+def timeline(filename: str | None = None):
+    """Chrome-trace task timeline (`ray timeline` CLI counterpart). Returns
+    the event list; also writes JSON to `filename` when given."""
+    events = _control("timeline")
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,153 @@
+"""State API, task events, timeline, and metrics.
+
+Counterpart of the reference's `python/ray/tests/test_state_api.py` and
+`test_metrics_agent.py` coverage: lifecycle records for tasks/actors,
+list_* endpoints, chrome-trace export, and the Counter/Gauge/Histogram
+application-metrics pipeline (worker flush → driver aggregation →
+prometheus text).
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def cluster(ray_session):
+    return ray_session
+
+
+def test_list_tasks_lifecycle(cluster):
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    refs = [traced.remote(i) for i in range(3)]
+    assert ray_tpu.get(refs) == [1, 2, 3]
+    tasks = state.list_tasks()
+    mine = [t for t in tasks if "traced" in t["name"]]
+    assert len(mine) >= 3
+    assert all(t["state"] == "FINISHED" for t in mine[:3])
+    assert all(t["start_ts"] is not None and t["end_ts"] is not None
+               for t in mine[:3])
+    assert all(t["worker_id"] for t in mine[:3])
+
+
+def test_failed_task_recorded(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("no")
+
+    ref = boom.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(ref)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        failed = [t for t in state.list_tasks({"state": "FAILED"})
+                  if "boom" in t["name"]]
+        if failed:
+            break
+        time.sleep(0.1)
+    assert failed and failed[0]["error"] == "application_error"
+
+
+def test_list_actors_and_workers(cluster):
+    @ray_tpu.remote
+    class Stateful:
+        def ping(self):
+            return "pong"
+
+    a = Stateful.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    actors = state.list_actors()
+    mine = [x for x in actors if "Stateful" in x["class_name"]]
+    assert mine and mine[0]["state"] == "ALIVE"
+    workers = state.list_workers()
+    assert any(w["alive"] for w in workers)
+    objs = state.list_objects()
+    assert isinstance(objs, list)
+    nodes = state.list_nodes()
+    assert nodes and nodes[0]["resources_total"].get("CPU", 0) > 0
+
+
+def test_summary_and_timeline(cluster, tmp_path):
+    @ray_tpu.remote
+    def traced2():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced2.remote() for _ in range(2)])
+    summary = state.summarize_tasks()
+    key = next(k for k in summary if "traced2" in k)
+    assert summary[key].get("FINISHED", 0) >= 2
+
+    out = tmp_path / "timeline.json"
+    events = ray_tpu.timeline(str(out))
+    assert any("traced2" in e["name"] for e in events)
+    loaded = json.loads(out.read_text())
+    span = next(e for e in loaded if "traced2" in e["name"])
+    assert span["ph"] == "X" and span["dur"] >= 50_000  # >= 50ms in us
+
+
+def test_metrics_counter_gauge_histogram(cluster):
+    c = metrics_mod.Counter("test_requests", "desc", tag_keys=("route",))
+    c.inc(2.0, {"route": "/a"})
+    c.inc(1.0, {"route": "/b"})
+    with pytest.raises(ValueError):
+        c.inc(0)
+    with pytest.raises(ValueError):
+        c.inc(1, {"bogus": "x"})
+    g = metrics_mod.Gauge("test_depth", "d")
+    g.set(7)
+    h = metrics_mod.Histogram("test_lat", "l", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    snap = {m["name"]: m for m in state.get_metrics()}
+    assert snap["test_requests"]["series"][(("route", "/a"),)] == 2.0
+    assert snap["test_depth"]["series"][()] == 7
+    buckets, total, count = snap["test_lat"]["series"][()]
+    assert buckets == [1, 1, 1] and count == 3 and abs(total - 5.55) < 1e-9
+
+    text = state.prometheus_metrics()
+    assert 'ray_tpu_test_requests{route="/a"} 2.0' in text
+    assert "ray_tpu_test_lat_count 3" in text
+    assert 'ray_tpu_test_lat_bucket{le="+Inf"} 3' in text
+
+
+def test_metrics_flow_from_workers(cluster):
+    @ray_tpu.remote
+    def emit(i):
+        from ray_tpu.util import metrics as m
+        cnt = m.Counter("test_worker_side", "w")
+        cnt.inc(1.0)
+        m.flush()
+        return i
+
+    assert sorted(ray_tpu.get([emit.remote(i) for i in range(3)])) == [0, 1, 2]
+    deadline = time.time() + 10
+    total = 0
+    while time.time() < deadline:
+        snap = {m["name"]: m for m in state.get_metrics()}
+        if "test_worker_side" in snap:
+            total = sum(snap["test_worker_side"]["series"].values())
+            if total >= 1.0:
+                break
+        time.sleep(0.2)
+    # counters sum across the worker processes that pushed
+    assert total >= 1.0
+
+
+def test_merge_snapshots_semantics():
+    a = [{"name": "c", "type": "counter", "description": "",
+          "series": {(): 1.0}}]
+    b = [{"name": "c", "type": "counter", "description": "",
+          "series": {(): 2.0}}]
+    merged = metrics_mod.merge_snapshots([a, b])
+    assert merged[0]["series"][()] == 3.0
