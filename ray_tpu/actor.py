@@ -66,6 +66,12 @@ class ActorClass:
             f"actor class {self.__name__} cannot be instantiated directly; "
             f"use .remote()")
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node: the actor is created at execute() time
+        (reference: `dag/class_node.py`)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> "ActorHandle":
         blob, function_id = self._materialize()
         o = self._options

@@ -1,0 +1,189 @@
+"""Lazy task/actor DAGs built with `.bind()`.
+
+Counterpart of the reference's `python/ray/dag/` (`dag_node.py` DAGNode,
+`function_node.py`, `class_node.py`, `input_node.py`; ~2.5k LoC): binding
+builds an expression tree without executing anything; `execute()` walks it,
+submitting each function node as a task and instantiating each class node
+as an actor, memoizing shared subtrees so diamond dependencies run once.
+Used directly by users and as the substrate for `ray_tpu.workflow`
+(durable execution) and serve graph composition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu._private.worker import ObjectRef
+
+
+class DAGNode:
+    """Base: an unexecuted node whose args may contain other DAGNodes."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal ----------------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        out = []
+
+        def scan(v):
+            if isinstance(v, DAGNode):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    scan(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    scan(x)
+        for a in self._bound_args:
+            scan(a)
+        for a in self._bound_kwargs.values():
+            scan(a)
+        return out
+
+    def _resolve_args(self, memo: Dict[int, Any], input_value):
+        def sub(v):
+            if isinstance(v, DAGNode):
+                return v._execute_memo(memo, input_value)
+            if isinstance(v, list):
+                return [sub(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(sub(x) for x in v)
+            if isinstance(v, dict):
+                return {k: sub(x) for k, x in v.items()}
+            return v
+        args = [sub(a) for a in self._bound_args]
+        kwargs = {k: sub(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_memo(self, memo: Dict[int, Any], input_value):
+        key = id(self)
+        if key not in memo:
+            memo[key] = self._execute_impl(memo, input_value)
+        return memo[key]
+
+    def _execute_impl(self, memo, input_value):
+        raise NotImplementedError
+
+    # -- public -------------------------------------------------------------
+
+    def execute(self, *input_value):
+        """Run the DAG. Returns the root's result: an ObjectRef for
+        function/method roots, an ActorHandle for class roots."""
+        inp = None
+        if len(input_value) == 1:
+            inp = input_value[0]
+        elif input_value:
+            inp = tuple(input_value)
+        return self._execute_memo({}, inp)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input passed to `execute()`
+    (reference: `input_node.py`). Supports `with InputNode() as x:` and
+    attribute/index access on the eventual value."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, memo, input_value):
+        return input_value
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name, kind="attr")
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key, kind="item")
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: DAGNode, key, kind: str):
+        super().__init__((parent,), {})
+        self._key = key
+        self._kind = kind
+
+    def _execute_impl(self, memo, input_value):
+        base = self._bound_args[0]._execute_memo(memo, input_value)
+        return base[self._key] if self._kind == "item" \
+            else getattr(base, self._key)
+
+
+class FunctionNode(DAGNode):
+    """`remote_fn.bind(...)` (reference: `function_node.py`)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _execute_impl(self, memo, input_value) -> ObjectRef:
+        args, kwargs = self._resolve_args(memo, input_value)
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """`ActorCls.bind(...)` — instantiated as an actor on execute
+    (reference: `class_node.py`)."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = actor_cls
+
+    def _execute_impl(self, memo, input_value):
+        args, kwargs = self._resolve_args(memo, input_value)
+        return self._cls.remote(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundMethod(self, name)
+
+
+class _UnboundMethod:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """`class_node.method.bind(...)`; the actor is shared via the memo, so
+    several method nodes on one ClassNode hit one actor instance."""
+
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__((class_node,) + tuple(args), kwargs)
+        self._method = method
+
+    def _execute_impl(self, memo, input_value) -> ObjectRef:
+        resolved, kwargs = self._resolve_args(memo, input_value)
+        handle, args = resolved[0], resolved[1:]
+        return getattr(handle, self._method).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves as the DAG output (reference:
+    `output_node.py`): execute() -> list of results."""
+
+    def __init__(self, outputs: list):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_impl(self, memo, input_value):
+        return [n._execute_memo(memo, input_value)
+                if isinstance(n, DAGNode) else n for n in self._bound_args]
+
+
+__all__ = [
+    "DAGNode", "InputNode", "InputAttributeNode", "FunctionNode",
+    "ClassNode", "ClassMethodNode", "MultiOutputNode",
+]

@@ -74,6 +74,12 @@ class RemoteFunction:
             f"remote function {self._function.__name__} cannot be called "
             f"directly; use .remote()")
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node instead of immediate submission (reference:
+        `dag/function_node.py`); run with `.execute()` or ray_tpu.workflow."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         blob, function_id = self._materialize()
         o = self._options
