@@ -50,6 +50,7 @@ def init(num_cpus: int | None = None,
          ignore_reinit_error: bool = False,
          namespace: str | None = None,
          logging_level: str = "INFO",
+         dashboard_port: int | None = None,
          **kwargs):
     """Start a local ray_tpu session (driver mode).
 
@@ -78,7 +79,17 @@ def init(num_cpus: int | None = None,
         constants.SESSION_PREFIX + ids.new_node_id())
     os.makedirs(session_dir, exist_ok=True)
     node = NodeServer(total, session_dir, num_tpu_chips=int(num_tpus or 0))
-    return _worker.connect_driver_mode(node)
+    client = _worker.connect_driver_mode(node)
+    if dashboard_port is not None:
+        from ray_tpu.dashboard import start_dashboard
+        try:
+            start_dashboard(dashboard_port)
+        except BaseException:
+            # don't leak a live, un-reinitializable session behind a
+            # failed init (e.g. dashboard port already in use)
+            shutdown()
+            raise
+    return client
 
 
 def _gc_stale_sessions():
@@ -100,6 +111,8 @@ def _gc_stale_sessions():
 def shutdown():
     if not _worker.is_initialized():
         return
+    from ray_tpu.dashboard import stop_dashboard
+    stop_dashboard()
     client = _worker.get_client()
     if client.mode == "driver":
         client.node.shutdown()

@@ -1,0 +1,97 @@
+"""Attach to a live session from another process (CLI, job inspection).
+
+Counterpart of the reference's out-of-band clients: the `ray` CLI and state
+API attach to a running cluster through GCS using the address + password in
+the session files. Here attachment is a control-plane-only connection to
+the driver's NodeServer socket: it registers with an `attach_` worker id
+(the node never dispatches tasks to those) and speaks ActorCallRequest for
+every `control()` verb. No object transfer — attach clients read state,
+submit jobs, and fetch metrics.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from multiprocessing import connection
+
+from ray_tpu._private import protocol
+from ray_tpu._private.constants import SESSION_PREFIX
+
+
+def find_sessions(root: str = "/dev/shm") -> list[str]:
+    """Live session dirs, newest first (a dir is live if its driver pid
+    responds)."""
+    out = []
+    for d in sorted(glob.glob(os.path.join(root, SESSION_PREFIX + "*")),
+                    key=os.path.getmtime, reverse=True):
+        try:
+            with open(os.path.join(d, "driver.pid")) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, 0)
+        except (OSError, ValueError):
+            continue
+        out.append(d)
+    return out
+
+
+class AttachClient:
+    """Control-channel client for an existing session."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        with open(os.path.join(session_dir, "authkey"), "rb") as f:
+            authkey = f.read()
+        self._conn = connection.Client(
+            os.path.join(session_dir, "node.sock"),
+            family="AF_UNIX", authkey=authkey)
+        # unique per client, not per process: two AttachClients in one
+        # process must not collide on the server's worker table
+        import uuid
+        self._wid = f"attach_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        self._conn.send(protocol.RegisterWorker(self._wid, os.getpid()))
+        self._lock = threading.Lock()
+        self._req = 0
+        self._replies: dict[int, object] = {}
+        self._have = threading.Condition(self._lock)
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                with self._have:
+                    self._replies[-1] = None   # poison: connection gone
+                    self._have.notify_all()
+                return
+            if isinstance(msg, protocol.ActorCallReply):
+                with self._have:
+                    self._replies[msg.req_id] = msg
+                    self._have.notify_all()
+            # anything else (KillWorker on shutdown, pushes) is ignored
+
+    def control(self, method: str, payload=None, timeout: float = 30.0):
+        with self._lock:
+            self._req += 1
+            rid = self._req
+        self._conn.send(protocol.ActorCallRequest(rid, method, payload))
+        with self._have:
+            ok = self._have.wait_for(
+                lambda: rid in self._replies or -1 in self._replies,
+                timeout=timeout)
+            if not ok or -1 in self._replies and rid not in self._replies:
+                raise ConnectionError(
+                    "session control channel closed or timed out")
+            reply = self._replies.pop(rid)
+        if reply.error:
+            raise RuntimeError(reply.error)
+        return reply.result
+
+    def close(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass

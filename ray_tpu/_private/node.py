@@ -171,6 +171,13 @@ class NodeServer:
             f.write(str(os.getpid()))
 
         self._authkey = os.urandom(16)
+        # Persisted (0600) so external processes — the CLI, job drivers —
+        # can attach to this session (reference: Redis password / GCS
+        # address in the session dir).
+        keypath = os.path.join(session_dir, "authkey")
+        fd = os.open(keypath, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(self._authkey)
         self._address = os.path.join(session_dir, "node.sock")
         self._listener = connection.Listener(
             family="AF_UNIX", address=self._address, authkey=self._authkey)
@@ -209,8 +216,12 @@ class NodeServer:
         with self.lock:
             w = self.workers.get(reg.worker_id)
             if w is None:
-                # Late registration of a worker we spawned.
+                # Late registration of a worker we spawned, or an external
+                # attach client (CLI / job driver): never dispatch to those.
                 w = _WorkerConn(reg.worker_id, conn)
+                if reg.worker_id.startswith("attach_"):
+                    w.kind = "attach"
+                    w.idle = False
                 self.workers[reg.worker_id] = w
             else:
                 w.conn = conn
@@ -359,6 +370,21 @@ class NodeServer:
                     "resources_available": dict(self.available),
                     "session_dir": self.session_dir,
                 }]
+        if method.startswith("job_"):
+            jm = self._job_manager()
+            if method == "job_submit":
+                return jm.submit(payload["entrypoint"],
+                                 job_id=payload.get("job_id"),
+                                 runtime_env=payload.get("runtime_env"),
+                                 metadata=payload.get("metadata"))
+            if method == "job_status":
+                return jm.status(payload)
+            if method == "job_list":
+                return jm.list()
+            if method == "job_logs":
+                return jm.logs(payload)
+            if method == "job_stop":
+                return jm.stop(payload)
         if method == "push_metrics":
             wid, snap = payload
             with self.lock:
@@ -383,6 +409,15 @@ class NodeServer:
     # ------------------------------------------------------------------
     # object directory
     # ------------------------------------------------------------------
+
+    def _job_manager(self):
+        if not hasattr(self, "_jobs"):
+            from ray_tpu.job_submission import JobManager
+            with self.lock:
+                if not hasattr(self, "_jobs"):
+                    self._jobs = JobManager(
+                        os.path.join(self.session_dir, "jobs"))
+        return self._jobs
 
     def register_object(self, object_id: str, desc: Descriptor):
         with self.lock:
@@ -947,6 +982,11 @@ class NodeServer:
 
     def _on_worker_death(self, w: _WorkerConn):
         with self.lock:
+            if w.kind == "attach":
+                # external CLI/monitoring connection: reap the entry, no
+                # task/actor state to recover
+                self.workers.pop(w.worker_id, None)
+                return
             if not w.alive and w.current is None:
                 return
             w.alive = False

@@ -1,0 +1,23 @@
+"""Autoscaler: scale a cluster of TPU hosts to match resource demand.
+
+Counterpart of the reference's `python/ray/autoscaler/` — `StandardAutoscaler`
+(`_private/autoscaler.py:166`, `update` :368), `LoadMetrics`
+(`load_metrics.py`), the bin-packing `resource_demand_scheduler.py`, and the
+`NodeProvider` abstraction (`node_provider.py`) with its fake implementation
+(`fake_multi_node/node_provider.py:237`). TPU-native difference: node types
+describe whole ICI domains (a v5e-8 host, a v4-64 slice) and gang demands
+(placement groups with STRICT_PACK) must land on one slice type, so the
+packer treats a slice as indivisible for gang bundles.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    ResourceDemandScheduler,
+)
+
+__all__ = [
+    "StandardAutoscaler", "LoadMetrics", "NodeProvider", "FakeNodeProvider",
+    "ResourceDemandScheduler",
+]

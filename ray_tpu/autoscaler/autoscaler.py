@@ -1,0 +1,109 @@
+"""StandardAutoscaler: reconcile node count with demand each update().
+
+Counterpart of the reference's `autoscaler/_private/autoscaler.py:166`
+(`StandardAutoscaler.update` :368): each tick it (1) reads the node list
+from the provider, (2) terminates workers idle beyond the timeout or in
+excess of max_workers, (3) asks the demand scheduler what to launch, and
+(4) launches in bounded batches. The head-side Monitor loop
+(`_private/monitor.py:371`) becomes whatever driver loop calls update()
+periodically — test code calls it directly, like the reference's
+autoscaler unit tests.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import (
+    TAG_NODE_KIND,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    ResourceDemandScheduler,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONFIG = {
+    "max_workers": 8,
+    "idle_timeout_minutes": 5.0,
+    "max_launch_batch": 5,
+    "available_node_types": {},
+    # name of the type used when a demand fits nothing (None = error)
+}
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, config: dict,
+                 load_metrics: LoadMetrics):
+        self.provider = provider
+        self.config = {**DEFAULT_CONFIG, **config}
+        self.load_metrics = load_metrics
+        self.scheduler = ResourceDemandScheduler(
+            self.config["available_node_types"],
+            self.config["max_workers"])
+        self.infeasible_gangs: list = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _workers(self) -> list[str]:
+        return self.provider.non_terminated_nodes(
+            {TAG_NODE_KIND: "worker"})
+
+    def _workers_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for nid in self._workers():
+            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    # -- main loop body ------------------------------------------------------
+
+    def update(self) -> None:
+        workers = self._workers()
+
+        # 1) terminate idle workers past the timeout, but never below a
+        # type's min_workers (reference: autoscaler.py idle termination)
+        idle_cutoff = self.config["idle_timeout_minutes"] * 60.0
+        counts = self._workers_by_type()
+        for nid in list(workers):
+            ntype = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            spec = self.config["available_node_types"].get(ntype, {})
+            if counts.get(ntype, 0) <= spec.get("min_workers", 0):
+                continue
+            if (nid in self.load_metrics.static_resources
+                    and self.load_metrics.idle_seconds(nid) > idle_cutoff):
+                logger.info("terminating idle node %s (%s)", nid, ntype)
+                self.provider.terminate_node(nid)
+                self.load_metrics.remove_node(nid)
+                counts[ntype] = counts.get(ntype, 0) - 1
+
+        # 2) enforce global max_workers (scale-down on config change)
+        workers = self._workers()
+        excess = len(workers) - self.config["max_workers"]
+        for nid in workers[:max(0, excess)]:
+            logger.info("terminating excess node %s", nid)
+            self.provider.terminate_node(nid)
+            self.load_metrics.remove_node(nid)
+
+        # 3) launch for unmet demand
+        avail = [dict(a) for a
+                 in self.load_metrics.available_resources.values()]
+        to_launch, infeasible = self.scheduler.get_nodes_to_launch(
+            self._workers_by_type(), avail,
+            self.load_metrics.pending_demands,
+            self.load_metrics.pending_gangs)
+        self.infeasible_gangs = infeasible
+        for ntype, count in to_launch.items():
+            spec = self.config["available_node_types"][ntype]
+            batch = min(count, self.config["max_launch_batch"])
+            logger.info("launching %d x %s", batch, ntype)
+            self.provider.create_node(
+                spec.get("node_config", {}),
+                {TAG_NODE_KIND: "worker", TAG_NODE_TYPE: ntype,
+                 TAG_NODE_STATUS: "pending"},
+                batch)

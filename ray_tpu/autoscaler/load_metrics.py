@@ -1,0 +1,46 @@
+"""Cluster load view consumed by the autoscaler.
+
+Counterpart of the reference's `autoscaler/_private/load_metrics.py`
+(LoadMetrics: per-node resource totals/availability, pending resource
+demands, placement-group gang demands, last-used timestamps), which the
+head-side Monitor fills from GCS resource reports
+(`_private/monitor.py:249` update_load_metrics).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+
+class LoadMetrics:
+    def __init__(self):
+        # node_id -> static/dynamic resources
+        self.static_resources: Dict[str, dict] = {}
+        self.available_resources: Dict[str, dict] = {}
+        self.last_used: Dict[str, float] = {}
+        # flat list of unschedulable task/actor demands: [{"CPU": 1}, ...]
+        self.pending_demands: List[dict] = []
+        # gang demands: list of bundle-lists, each gang must co-schedule
+        # (STRICT_PACK placement groups / SPMD slices)
+        self.pending_gangs: List[List[dict]] = []
+
+    def update_node(self, node_id: str, static: dict, available: dict,
+                    busy: bool) -> None:
+        self.static_resources[node_id] = dict(static)
+        self.available_resources[node_id] = dict(available)
+        if busy or node_id not in self.last_used:
+            self.last_used[node_id] = time.time()
+
+    def remove_node(self, node_id: str) -> None:
+        self.static_resources.pop(node_id, None)
+        self.available_resources.pop(node_id, None)
+        self.last_used.pop(node_id, None)
+
+    def set_demands(self, demands: List[dict],
+                    gangs: List[List[dict]] | None = None) -> None:
+        self.pending_demands = [dict(d) for d in demands]
+        self.pending_gangs = [[dict(b) for b in g] for g in (gangs or [])]
+
+    def idle_seconds(self, node_id: str) -> float:
+        return time.time() - self.last_used.get(node_id, time.time())

@@ -1,0 +1,93 @@
+"""Cloud-node lifecycle interface + in-memory fake.
+
+Counterpart of the reference's `autoscaler/node_provider.py` (abstract
+`NodeProvider`: `create_node`, `terminate_node`, `non_terminated_nodes`,
+`node_tags`, …) and the fake used for autoscaler e2e tests without a cloud
+(`_private/fake_multi_node/node_provider.py:237` FakeMultiNodeProvider).
+A real deployment implements this against the TPU-VM API (the reference's
+`gcp/` provider is the template); the framework only depends on the verbs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+TAG_NODE_KIND = "ray_tpu-node-kind"      # "head" | "worker"
+TAG_NODE_TYPE = "ray_tpu-user-node-type"
+TAG_NODE_STATUS = "ray_tpu-node-status"  # "pending" | "up-to-date"
+
+
+class NodeProvider:
+    """Minimal lifecycle verbs the autoscaler needs."""
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def create_node(self, node_config: dict, tags: Dict[str, str],
+                    count: int) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        return None
+
+
+class FakeNodeProvider(NodeProvider):
+    """Instant in-memory nodes (optionally with a simulated startup delay)
+    for autoscaler tests — the reference's fake-multinode trick."""
+
+    def __init__(self, startup_delay_s: float = 0.0):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._nodes: dict[str, dict] = {}   # id -> {tags, created_ts}
+        self.startup_delay_s = startup_delay_s
+        self.created_log: list[tuple] = []   # (node_type, count)
+        self.terminated_log: list[str] = []
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            out = []
+            for nid, info in self._nodes.items():
+                if all(info["tags"].get(k) == v
+                       for k, v in tag_filters.items()):
+                    out.append(nid)
+            return out
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def create_node(self, node_config: dict, tags: Dict[str, str],
+                    count: int) -> None:
+        with self._lock:
+            self.created_log.append((tags.get(TAG_NODE_TYPE), count))
+            for _ in range(count):
+                nid = f"node-{self._next_id}"
+                self._next_id += 1
+                self._nodes[nid] = {
+                    "tags": dict(tags), "created_ts": time.time()}
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self.terminated_log.append(node_id)
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return False
+            return time.time() - info["created_ts"] >= self.startup_delay_s
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        return "127.0.0.1"

@@ -1,0 +1,149 @@
+"""Bin-packing of pending resource demands onto node types.
+
+Counterpart of the reference's
+`autoscaler/_private/resource_demand_scheduler.py`: given (a) the resources
+of currently-running nodes, (b) a flat list of unschedulable demands plus
+gang (placement-group) demands, and (c) the configured node types with
+min/max counts, decide how many new nodes of each type to launch.
+
+Algorithm, like the reference: first-fit the demands onto existing nodes'
+remaining capacity; for what's left, greedily pick the node type that
+satisfies the most remaining demand (utility scoring), capped by per-type
+and global max_workers. TPU twist: a gang demand (SPMD slice) is
+indivisible — all bundles of a gang must fit on ONE node (one ICI domain);
+a gang too big for every type is reported as infeasible rather than
+silently split across hosts, because XLA collectives can't span a split.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Tuple
+
+_EPS = 1e-9
+
+
+def _fits(avail: dict, demand: dict) -> bool:
+    return all(avail.get(k, 0.0) + _EPS >= v for k, v in demand.items())
+
+
+def _sub(avail: dict, demand: dict) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, node_types: Dict[str, dict], max_workers: int):
+        """node_types: name -> {"resources": {...}, "min_workers": int,
+        "max_workers": int}. Counts exclude the head node."""
+        self.node_types = node_types
+        self.max_workers = max_workers
+
+    def get_nodes_to_launch(
+        self,
+        running_by_type: Dict[str, int],
+        available_resources: List[dict],
+        demands: List[dict],
+        gangs: List[List[dict]] | None = None,
+    ) -> Tuple[Dict[str, int], List[List[dict]]]:
+        """-> ({node_type: count_to_launch}, infeasible_gangs)."""
+        gangs = gangs or []
+        avail = [dict(a) for a in available_resources]
+
+        # 1) first-fit flat demands onto existing capacity
+        unmet: List[dict] = []
+        for d in sorted(demands, key=lambda d: -sum(d.values())):
+            for a in avail:
+                if _fits(a, d):
+                    _sub(a, d)
+                    break
+            else:
+                unmet.append(d)
+
+        # 2) gang demands: each gang packs onto ONE node (ICI domain)
+        unmet_gangs: List[dict] = []       # gang collapsed to a single bundle
+        infeasible: List[List[dict]] = []
+        for gang in gangs:
+            total: dict = {}
+            for b in gang:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            placed = False
+            for a in avail:
+                if _fits(a, total):
+                    _sub(a, total)
+                    placed = True
+                    break
+            if placed:
+                continue
+            if any(_fits(dict(t["resources"]), total)
+                   for t in self.node_types.values()):
+                unmet_gangs.append(total)
+            else:
+                infeasible.append(gang)
+
+        # 3) pick node types for what's left (min_workers honored first)
+        to_launch: Dict[str, int] = {}
+        counts = dict(running_by_type)
+
+        def total_workers() -> int:
+            return sum(counts.values())
+
+        for name, spec in self.node_types.items():
+            need = spec.get("min_workers", 0) - counts.get(name, 0)
+            for _ in range(max(0, need)):
+                if total_workers() >= self.max_workers:
+                    break
+                to_launch[name] = to_launch.get(name, 0) + 1
+                counts[name] = counts.get(name, 0) + 1
+                avail.append(dict(spec["resources"]))
+
+        remaining = sorted(unmet_gangs, key=lambda d: -sum(d.values())) + \
+            sorted(unmet, key=lambda d: -sum(d.values()))
+        # retry against capacity added by min_workers launches
+        still: List[dict] = []
+        for d in remaining:
+            for a in avail:
+                if _fits(a, d):
+                    _sub(a, d)
+                    break
+            else:
+                still.append(d)
+
+        while still:
+            # utility = demands satisfied per unit of node capacity, so a
+            # big TPU host is only chosen over a small CPU node when its
+            # extra capacity is actually used (reference's utilization
+            # scoring in resource_demand_scheduler._utilization_score)
+            best_name, best_key, best_score, best_leftover = \
+                None, (-1.0, -1), -1, None
+            for name, spec in self.node_types.items():
+                if counts.get(name, 0) >= spec.get("max_workers",
+                                                   self.max_workers):
+                    continue
+                if total_workers() >= self.max_workers:
+                    break
+                cap = dict(spec["resources"])
+                capacity = sum(spec["resources"].values()) or 1.0
+                score = 0
+                leftover = []
+                for d in still:
+                    if _fits(cap, d):
+                        _sub(cap, d)
+                        score += 1
+                    else:
+                        leftover.append(d)
+                key = (score / capacity, score)
+                if key > best_key:
+                    best_name, best_key, best_score, best_leftover = \
+                        name, key, score, leftover
+            if best_name is None or best_score <= 0:
+                # nothing helps (all types maxed or demands unplaceable)
+                for d in still:
+                    infeasible.append([d])
+                break
+            to_launch[best_name] = to_launch.get(best_name, 0) + 1
+            counts[best_name] = counts.get(best_name, 0) + 1
+            still = best_leftover
+
+        return to_launch, infeasible

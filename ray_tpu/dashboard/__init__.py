@@ -1,0 +1,142 @@
+"""Dashboard: HTTP endpoints over the session's state, metrics, and jobs.
+
+Counterpart of the reference's dashboard head (`dashboard/head.py:81`) and
+its REST modules (`dashboard/modules/{node,actor,job,metrics,state,...}`).
+The reference ships a React SPA; here the surface is the JSON/Prometheus
+API those frontends consume — the part tooling depends on:
+
+  GET /healthz                      liveness
+  GET /api/nodes|tasks|actors|workers|objects|placement_groups
+  GET /api/summary                  task counts by name/state
+  GET /api/jobs                     job table
+  POST /api/jobs                    {"entrypoint": ...} -> {"job_id": ...}
+  GET /api/jobs/<id>                job info
+  GET /api/jobs/<id>/logs           captured stdout/stderr
+  GET /metrics                      Prometheus text exposition
+  GET /api/timeline                 chrome://tracing events
+
+Runs as a daemon thread in the driver process (the driver embeds the
+node, so handlers read NodeServer state through the same control verbs the
+CLI uses). Start with ray_tpu.init(dashboard_port=...) or
+start_dashboard().
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_server: Optional[ThreadingHTTPServer] = None
+
+_LIST_ROUTES = {
+    "nodes": "list_nodes",
+    "tasks": "list_tasks",
+    "actors": "list_actors",
+    "workers": "list_workers",
+    "objects": "list_objects",
+    "placement_groups": "list_placement_groups",
+}
+
+
+def _jsonable(value):
+    """Tuple-keyed metric series etc. -> JSON-safe structures."""
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+class _Handler(BaseHTTPRequestHandler):
+    control = None   # injected
+
+    def log_message(self, *a):   # no stderr spam
+        pass
+
+    def _send(self, code: int, body, content_type="application/json"):
+        data = (json.dumps(_jsonable(body)).encode()
+                if content_type == "application/json"
+                else body.encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        try:
+            path = self.path.split("?")[0].rstrip("/")
+            if path == "/healthz":
+                return self._send(200, {"status": "ok"})
+            if path == "/metrics":
+                from ray_tpu.util import metrics as _metrics
+                text = _metrics.render_prometheus(
+                    type(self).control("get_metrics"))
+                return self._send(200, text, "text/plain; version=0.0.4")
+            if path == "/api/summary":
+                return self._send(200, type(self).control("summarize_tasks"))
+            if path == "/api/timeline":
+                return self._send(200, type(self).control("timeline"))
+            if path == "/api/jobs":
+                return self._send(200, type(self).control("job_list"))
+            if path.startswith("/api/jobs/"):
+                parts = path.split("/")
+                job_id = parts[3]
+                if len(parts) > 4 and parts[4] == "logs":
+                    return self._send(
+                        200, type(self).control("job_logs", job_id),
+                        "text/plain")
+                return self._send(200, type(self).control("job_status",
+                                                          job_id))
+            if path.startswith("/api/"):
+                kind = path[len("/api/"):]
+                method = _LIST_ROUTES.get(kind)
+                if method:
+                    return self._send(200, type(self).control(method))
+            return self._send(404, {"error": f"no route {path}"})
+        except Exception as e:
+            return self._send(500, {"error": repr(e)})
+
+    def do_POST(self):
+        try:
+            path = self.path.rstrip("/")
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if path == "/api/jobs":
+                job_id = type(self).control("job_submit", {
+                    "entrypoint": body["entrypoint"],
+                    "job_id": body.get("job_id"),
+                    "runtime_env": body.get("runtime_env"),
+                    "metadata": body.get("metadata")})
+                return self._send(200, {"job_id": job_id})
+            if path.startswith("/api/jobs/") and path.endswith("/stop"):
+                job_id = path.split("/")[3]
+                return self._send(
+                    200, {"stopped": type(self).control("job_stop", job_id)})
+            return self._send(404, {"error": f"no route {path}"})
+        except Exception as e:
+            return self._send(500, {"error": repr(e)})
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
+    """Start (or return) the dashboard server; returns the bound port."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    from ray_tpu._private import worker as _worker
+    handler = type("BoundHandler", (_Handler,),
+                   {"control": staticmethod(_worker.get_client().control)})
+    _server = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=_server.serve_forever,
+                     name="ray_tpu-dashboard", daemon=True).start()
+    return _server.server_address[1]
+
+
+def stop_dashboard() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None

@@ -1,0 +1,175 @@
+"""Job submission: run driver scripts as managed subprocesses.
+
+Counterpart of the reference's job layer
+(`dashboard/modules/job/job_manager.py:508` JobManager — `submit_job` :823
+spawns the entrypoint as a subprocess `_exec_entrypoint` :208, tracks
+JobStatus, captures logs; SDK `job/sdk.py:40` JobSubmissionClient). The
+manager lives in the driver/NodeServer process; external processes reach
+it through the control channel (CLI `job submit/...`) or HTTP
+(dashboard module). Each job runs `entrypoint` as a shell command whose
+own `ray_tpu.init()` creates an independent session, exactly like
+reference jobs start their own driver.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = "PENDING"   # PENDING RUNNING SUCCEEDED FAILED STOPPED
+    submitted_ts: float = field(default_factory=time.time)
+    finished_ts: Optional[float] = None
+    returncode: Optional[int] = None
+    metadata: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+class JobManager:
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def submit(self, entrypoint: str, *, job_id: str | None = None,
+               runtime_env: dict | None = None,
+               metadata: dict | None = None) -> str:
+        job_id = job_id or f"job_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+            info = JobInfo(job_id, entrypoint, metadata=metadata or {})
+            self._jobs[job_id] = info
+        env = dict(os.environ)
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[str(k)] = str(v)
+        env["RAY_TPU_JOB_ID"] = job_id
+        log_path = self.log_path(job_id)
+        logf = open(log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=logf, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True,
+                cwd=(runtime_env or {}).get("working_dir") or None)
+        except OSError as e:
+            logf.close()
+            with self._lock:
+                info.status = "FAILED"
+                info.finished_ts = time.time()
+            raise RuntimeError(f"failed to exec job: {e}") from e
+        with self._lock:
+            info.status = "RUNNING"
+            self._procs[job_id] = proc
+        threading.Thread(target=self._wait, args=(job_id, proc, logf),
+                         daemon=True).start()
+        return job_id
+
+    def _wait(self, job_id: str, proc: subprocess.Popen, logf):
+        rc = proc.wait()
+        logf.close()
+        with self._lock:
+            info = self._jobs[job_id]
+            if info.status != "STOPPED":
+                info.status = "SUCCEEDED" if rc == 0 else "FAILED"
+            info.returncode = rc
+            info.finished_ts = time.time()
+            self._procs.pop(job_id, None)
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            info = self._jobs.get(job_id)
+            if info is None:
+                raise ValueError(f"no job {job_id!r}")
+            if proc is None:
+                return False
+            info.status = "STOPPED"
+        try:
+            # the job runs in its own process group (start_new_session)
+            os.killpg(proc.pid, 15)
+        except OSError:
+            pass
+        return True
+
+    def status(self, job_id: str) -> dict:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None:
+                raise ValueError(f"no job {job_id!r}")
+            return info.to_dict()
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [j.to_dict() for j in self._jobs.values()]
+
+    def log_path(self, job_id: str) -> str:
+        return os.path.join(self.log_dir, f"{job_id}.log")
+
+    def logs(self, job_id: str, tail_bytes: int = 1 << 20) -> str:
+        path = self.log_path(job_id)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+
+class JobSubmissionClient:
+    """Client API (reference: `job/sdk.py:40`) — works in-process against
+    the current session, or attached to another session's socket."""
+
+    def __init__(self, session_dir: str | None = None):
+        if session_dir is None:
+            from ray_tpu._private import worker as _worker
+            self._control = _worker.get_client().control
+        else:
+            from ray_tpu._private.attach import AttachClient
+            self._control = AttachClient(session_dir).control
+
+    def submit_job(self, *, entrypoint: str, job_id: str | None = None,
+                   runtime_env: dict | None = None,
+                   metadata: dict | None = None) -> str:
+        return self._control("job_submit", {
+            "entrypoint": entrypoint, "job_id": job_id,
+            "runtime_env": runtime_env, "metadata": metadata})
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._control("job_status", job_id)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return self._control("job_status", job_id)
+
+    def list_jobs(self) -> list[dict]:
+        return self._control("job_list")
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._control("job_logs", job_id)
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._control("job_stop", job_id)
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.get_job_status(job_id)
+            if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return st
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still {st!r} after {timeout}s")

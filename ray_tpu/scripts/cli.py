@@ -1,0 +1,200 @@
+"""`python -m ray_tpu.scripts.cli` — the operator CLI.
+
+Counterpart of the reference's `ray` CLI (`python/ray/scripts/scripts.py`):
+`ray status` → status, `ray list tasks/actors/...` (state CLI,
+`experimental/state/state_cli.py`) → list, `ray summary` → summary,
+`ray timeline` → timeline, `ray job submit/status/logs/stop/list`
+(`dashboard/modules/job/cli.py`) → job, `ray microbenchmark`
+(`_private/ray_perf.py`) → microbenchmark. Attaches to the newest live
+session's control socket (or --session DIR).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _attach(args):
+    from ray_tpu._private.attach import AttachClient, find_sessions
+    session = args.session
+    if session is None:
+        sessions = find_sessions()
+        if not sessions:
+            print("no live ray_tpu session found", file=sys.stderr)
+            sys.exit(1)
+        session = sessions[0]
+    return AttachClient(session)
+
+
+def _print(obj):
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def cmd_status(args):
+    c = _attach(args)
+    nodes = c.control("list_nodes")
+    workers = c.control("list_workers")
+    print(f"session: {c.session_dir}")
+    for n in nodes:
+        total, avail = n["resources_total"], n["resources_available"]
+        usage = ", ".join(
+            f"{total[k] - avail.get(k, 0):g}/{total[k]:g} {k}"
+            for k in sorted(total))
+        print(f"node {n['node_id']}: {usage}")
+    alive = sum(1 for w in workers if w["alive"])
+    print(f"workers: {alive} alive / {len(workers)} total")
+
+
+def cmd_list(args):
+    c = _attach(args)
+    method = {
+        "tasks": "list_tasks", "actors": "list_actors",
+        "workers": "list_workers", "objects": "list_objects",
+        "nodes": "list_nodes",
+        "placement-groups": "list_placement_groups",
+    }[args.kind]
+    _print(c.control(method))
+
+
+def cmd_summary(args):
+    _print(_attach(args).control("summarize_tasks"))
+
+
+def cmd_timeline(args):
+    events = _attach(args).control("timeline")
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+
+
+def cmd_metrics(args):
+    from ray_tpu.util.metrics import render_prometheus
+    print(render_prometheus(_attach(args).control("get_metrics")), end="")
+
+
+def cmd_job(args):
+    c = _attach(args)
+    if args.job_cmd == "submit":
+        job_id = c.control("job_submit", {
+            "entrypoint": " ".join(args.entrypoint),
+            "job_id": args.job_id, "runtime_env": None, "metadata": None})
+        print(job_id)
+        if args.wait:
+            while True:
+                st = c.control("job_status", job_id)["status"]
+                if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+                    print(st)
+                    print(c.control("job_logs", job_id), end="")
+                    sys.exit(0 if st == "SUCCEEDED" else 1)
+                time.sleep(0.5)
+    elif args.job_cmd == "status":
+        _print(c.control("job_status", args.job_id))
+    elif args.job_cmd == "logs":
+        print(c.control("job_logs", args.job_id), end="")
+    elif args.job_cmd == "stop":
+        print(c.control("job_stop", args.job_id))
+    elif args.job_cmd == "list":
+        _print(c.control("job_list"))
+
+
+def cmd_microbenchmark(args):
+    """Core-runtime throughput suite (reference: ray_perf.py:93)."""
+    import ray_tpu
+    import numpy as np
+    ray_tpu.init(num_cpus=args.num_cpus)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # warm the worker pool
+    ray_tpu.get([nop.remote() for _ in range(args.num_cpus)])
+
+    t0 = time.time()
+    n = 200
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    dt = time.time() - t0
+    print(f"tasks_per_second: {n / dt:.1f}")
+
+    t0 = time.time()
+    n = 200
+    arr = np.zeros(1024, np.float32)      # small put/get
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(arr))
+    dt = time.time() - t0
+    print(f"small_put_get_per_second: {n / dt:.1f}")
+
+    big = np.zeros(25_000_000 // 4, np.float32)   # 25 MB through the arena
+    t0 = time.time()
+    n = 40
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(big))
+    dt = time.time() - t0
+    print(f"object_store_GBps: {n * big.nbytes / dt / 1e9:.2f}")
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.i = 0
+
+        def inc(self):
+            self.i += 1
+            return self.i
+
+    a = Counter.remote()
+    ray_tpu.get(a.inc.remote())
+    t0 = time.time()
+    n = 200
+    ray_tpu.get([a.inc.remote() for _ in range(n)])
+    dt = time.time() - t0
+    print(f"actor_calls_per_second: {n / dt:.1f}")
+    ray_tpu.shutdown()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    p.add_argument("--session", default=None,
+                   help="session dir (default: newest live session)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+
+    lp = sub.add_parser("list")
+    lp.add_argument("kind", choices=["tasks", "actors", "workers", "objects",
+                                     "nodes", "placement-groups"])
+    lp.set_defaults(fn=cmd_list)
+
+    sub.add_parser("summary").set_defaults(fn=cmd_summary)
+
+    tp = sub.add_parser("timeline")
+    tp.add_argument("output", nargs="?", default="timeline.json")
+    tp.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser("metrics").set_defaults(fn=cmd_metrics)
+
+    jp = sub.add_parser("job")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--job-id", default=None)
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        jx = jsub.add_parser(name)
+        jx.add_argument("job_id")
+    jsub.add_parser("list")
+    jp.set_defaults(fn=cmd_job)
+
+    mb = sub.add_parser("microbenchmark")
+    mb.add_argument("--num-cpus", type=int, default=4)
+    mb.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,18 +150,6 @@ def _execute_durable(dag: DAGNode, storage: _Storage, dag_input) -> Any:
     resolved: Dict[int, Any] = {}
     inflight: Dict[str, tuple] = {}   # ref id -> (node key, step id, ref)
 
-    def sub(v):
-        """Substitute resolved values into an argument structure."""
-        if isinstance(v, DAGNode):
-            return resolved[id(v)]
-        if isinstance(v, list):
-            return [sub(x) for x in v]
-        if isinstance(v, tuple):
-            return tuple(sub(x) for x in v)
-        if isinstance(v, dict):
-            return {k: sub(x) for k, x in v.items()}
-        return v
-
     def deps_ready(node: DAGNode) -> bool:
         return all(id(c) in resolved for c in node._children())
 
@@ -171,36 +159,28 @@ def _execute_durable(dag: DAGNode, storage: _Storage, dag_input) -> Any:
             key = id(node)
             if key in resolved or not deps_ready(node):
                 continue
+            # all children are in `resolved`, so _resolve_args/_execute_memo
+            # hit the memo and never trigger non-durable execution
             if isinstance(node, FunctionNode):
                 sid = step_ids[key]
                 if storage.has_step(sid):
                     resolved[key] = storage.load_step(sid)
                     progressed = True
                 elif not any(k == key for k, _, _ in inflight.values()):
-                    args = [sub(a) for a in node._bound_args]
-                    kwargs = {k: sub(v)
-                              for k, v in node._bound_kwargs.items()}
+                    args, kwargs = node._resolve_args(resolved, dag_input)
                     ref = node._fn.remote(*args, **kwargs)
                     inflight[ref._id] = (key, sid, ref)
                 continue
-            if isinstance(node, InputNode):
-                resolved[key] = dag_input
-            elif isinstance(node, InputAttributeNode):
-                base = resolved[id(node._bound_args[0])]
-                resolved[key] = (base[node._key] if node._kind == "item"
-                                 else getattr(base, node._key))
-            elif isinstance(node, ClassNode):
-                args = [sub(a) for a in node._bound_args]
-                kwargs = {k: sub(v) for k, v in node._bound_kwargs.items()}
-                resolved[key] = node._cls.remote(*args, **kwargs)
-            elif isinstance(node, ClassMethodNode):
-                rs = [sub(a) for a in node._bound_args]
-                kwargs = {k: sub(v) for k, v in node._bound_kwargs.items()}
+            if isinstance(node, ClassMethodNode):
+                # durable mode keeps step inputs/outputs concrete, so the
+                # method's ObjectRef is resolved here rather than passed on
+                rs, kwargs = node._resolve_args(resolved, dag_input)
                 handle, args = rs[0], rs[1:]
                 resolved[key] = ray_tpu.get(
                     getattr(handle, node._method).remote(*args, **kwargs))
-            elif isinstance(node, MultiOutputNode):
-                resolved[key] = [sub(a) for a in node._bound_args]
+            elif isinstance(node, (InputNode, InputAttributeNode, ClassNode,
+                                   MultiOutputNode)):
+                resolved[key] = node._execute_impl(resolved, dag_input)
             else:
                 raise TypeError(
                     f"unsupported DAG node {type(node).__name__}")
@@ -273,6 +253,12 @@ def run(dag: DAGNode, *, workflow_id: str | None = None,
             f"workflow {workflow_id!r} was started with a different "
             "dag_input; its checkpoints would be inconsistent with the new "
             "input. workflow.delete() it or pick a new workflow_id.")
+    if meta is not None and _effective_status(meta) == "RUNNING" \
+            and meta.get("pid") != os.getpid():
+        raise ValueError(
+            f"workflow {workflow_id!r} is currently running in process "
+            f"{meta.get('pid')}; concurrent duplicate execution would race "
+            "on checkpoints.")
     if meta is None or meta["status"] != "SUCCESSFUL":
         storage.save_dag(dag, dag_input)
         storage.save_meta({"status": "RUNNING", "created_ts": time.time(),
@@ -301,6 +287,11 @@ def resume(workflow_id: str) -> Any:
         raise ValueError(f"no workflow {workflow_id!r} in storage")
     if meta["status"] == "SUCCESSFUL":
         return storage.load_step("__output__")
+    if _effective_status(meta) == "RUNNING" \
+            and meta.get("pid") != os.getpid():
+        raise ValueError(
+            f"workflow {workflow_id!r} is currently running in process "
+            f"{meta.get('pid')}; wait for it or workflow.delete() first.")
     dag, dag_input = storage.load_dag()
     meta["status"] = "RUNNING"
     meta["pid"] = os.getpid()

@@ -1,0 +1,148 @@
+"""Autoscaler: demand bin-packing, scale-up/down, gang (slice) handling.
+
+Counterpart of the reference's `python/ray/tests/test_autoscaler.py` and
+`test_resource_demand_scheduler.py`: pure-logic tests against the fake
+provider (SURVEY.md §4.2 — no cloud needed).
+"""
+
+import time
+
+from ray_tpu.autoscaler import (
+    FakeNodeProvider,
+    LoadMetrics,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import TAG_NODE_KIND, TAG_NODE_TYPE
+
+CPU_TYPE = {"resources": {"CPU": 8}, "min_workers": 0, "max_workers": 10}
+TPU_HOST = {"resources": {"CPU": 16, "TPU": 8}, "min_workers": 0,
+            "max_workers": 4}
+NODE_TYPES = {"cpu": CPU_TYPE, "tpu_v5e_8": TPU_HOST}
+
+
+def make(config_extra=None, provider=None):
+    provider = provider or FakeNodeProvider()
+    lm = LoadMetrics()
+    config = {"available_node_types": NODE_TYPES, "max_workers": 10,
+              "idle_timeout_minutes": 0.001, **(config_extra or {})}
+    return StandardAutoscaler(provider, config, lm), provider, lm
+
+
+# -- demand scheduler (pure logic) ------------------------------------------
+
+def test_packer_fits_on_existing_capacity():
+    sched = ResourceDemandScheduler(NODE_TYPES, max_workers=10)
+    launch, infeasible = sched.get_nodes_to_launch(
+        {"cpu": 1}, [{"CPU": 8}], [{"CPU": 4}, {"CPU": 4}])
+    assert launch == {} and not infeasible
+
+
+def test_packer_launches_for_unmet_demand():
+    sched = ResourceDemandScheduler(NODE_TYPES, max_workers=10)
+    launch, _ = sched.get_nodes_to_launch(
+        {}, [], [{"CPU": 4}] * 4)          # 16 CPUs needed
+    assert launch == {"cpu": 2}
+
+
+def test_packer_prefers_type_satisfying_most():
+    sched = ResourceDemandScheduler(NODE_TYPES, max_workers=10)
+    launch, _ = sched.get_nodes_to_launch(
+        {}, [], [{"TPU": 4}, {"TPU": 4}])
+    assert launch == {"tpu_v5e_8": 1}
+
+
+def test_packer_honors_min_workers():
+    types = {"cpu": {**CPU_TYPE, "min_workers": 2}}
+    sched = ResourceDemandScheduler(types, max_workers=10)
+    launch, _ = sched.get_nodes_to_launch({}, [], [])
+    assert launch == {"cpu": 2}
+
+
+def test_packer_honors_max_workers():
+    sched = ResourceDemandScheduler(
+        {"cpu": {**CPU_TYPE, "max_workers": 1}}, max_workers=1)
+    launch, infeasible = sched.get_nodes_to_launch(
+        {}, [], [{"CPU": 8}] * 5)
+    assert launch == {"cpu": 1}
+    assert len(infeasible) == 4            # capped; remainder reported
+
+
+def test_gang_is_indivisible_across_hosts():
+    """An SPMD gang (8 x TPU:1 bundles) must land on ONE ICI domain."""
+    sched = ResourceDemandScheduler(NODE_TYPES, max_workers=10)
+    gang = [{"TPU": 1}] * 8
+    launch, infeasible = sched.get_nodes_to_launch({}, [], [], [gang])
+    assert launch == {"tpu_v5e_8": 1} and not infeasible
+
+
+def test_oversized_gang_reported_infeasible():
+    sched = ResourceDemandScheduler(NODE_TYPES, max_workers=10)
+    gang = [{"TPU": 1}] * 16               # no 16-chip type exists
+    launch, infeasible = sched.get_nodes_to_launch({}, [], [], [gang])
+    assert launch == {} and infeasible == [gang]
+
+
+# -- StandardAutoscaler loop -------------------------------------------------
+
+def test_scale_up_on_demand():
+    scaler, provider, lm = make()
+    lm.set_demands([{"CPU": 4}] * 4)
+    scaler.update()
+    assert provider.created_log == [("cpu", 2)]
+
+
+def test_idle_nodes_terminated():
+    scaler, provider, lm = make()
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "cpu"}, 2)
+    (n1, n2) = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    lm.update_node(n1, {"CPU": 8}, {"CPU": 8}, busy=False)
+    lm.update_node(n2, {"CPU": 8}, {"CPU": 8}, busy=False)
+    time.sleep(0.12)
+    scaler.update()
+    assert provider.non_terminated_nodes({TAG_NODE_KIND: "worker"}) == []
+
+
+def test_busy_nodes_not_terminated():
+    scaler, provider, lm = make(
+        {"idle_timeout_minutes": 60})       # long timeout
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "cpu"}, 1)
+    nid = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})[0]
+    lm.update_node(nid, {"CPU": 8}, {"CPU": 2}, busy=True)
+    scaler.update()
+    assert provider.non_terminated_nodes(
+        {TAG_NODE_KIND: "worker"}) == [nid]
+
+
+def test_min_workers_never_reaped():
+    types = {"cpu": {**CPU_TYPE, "min_workers": 1}}
+    scaler, provider, lm = make({"available_node_types": types})
+    scaler.update()                         # brings up min_workers
+    nodes = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    assert len(nodes) == 1
+    lm.update_node(nodes[0], {"CPU": 8}, {"CPU": 8}, busy=False)
+    time.sleep(0.12)
+    scaler.update()
+    assert provider.non_terminated_nodes(
+        {TAG_NODE_KIND: "worker"}) == nodes
+
+
+def test_launch_batch_cap():
+    scaler, provider, lm = make({"max_launch_batch": 2})
+    lm.set_demands([{"CPU": 8}] * 6)
+    scaler.update()
+    assert provider.created_log == [("cpu", 2)]   # capped per tick
+    # next tick launches the rest
+    lm.set_demands([{"CPU": 8}] * 4)
+    scaler.update()
+    assert provider.created_log[-1] == ("cpu", 2)
+
+
+def test_gang_demand_launches_slice():
+    scaler, provider, lm = make()
+    lm.set_demands([], gangs=[[{"TPU": 1}] * 8])
+    scaler.update()
+    assert provider.created_log == [("tpu_v5e_8", 1)]
+    assert scaler.infeasible_gangs == []
