@@ -21,8 +21,10 @@ way `Algorithm(Trainable)` does in the reference
 
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rllib.algorithms import (
-    Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA, IMPALAConfig,
-    PPO, PPOConfig, get_algorithm_class, register_algorithm)
+    A2C, A2CConfig, APPO, APPOConfig, Algorithm, AlgorithmConfig, BC,
+    BCConfig, CQL, CQLConfig, DQN, DQNConfig, IMPALA, IMPALAConfig, MARWIL,
+    MARWILConfig, PPO, PPOConfig, SAC, SACConfig, get_algorithm_class,
+    register_algorithm)
 from ray_tpu.rllib.env.jax_env import make_env, register_env
 
 __all__ = [
@@ -30,4 +32,6 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "get_algorithm_class",
     "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "make_env", "register_env",
+    "A2C", "A2CConfig", "APPO", "APPOConfig", "SAC", "SACConfig",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
 ]

@@ -4,7 +4,15 @@ from ray_tpu.rllib.algorithms.algorithm import (
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
-           "IMPALA", "IMPALAConfig"]
+           "IMPALA", "IMPALAConfig", "A2C", "A2CConfig",
+           "APPO", "APPOConfig", "SAC", "SACConfig",
+           "BC", "BCConfig", "MARWIL", "MARWILConfig",
+           "CQL", "CQLConfig"]

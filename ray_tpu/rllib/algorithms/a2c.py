@@ -1,0 +1,35 @@
+"""A2C — synchronous advantage actor-critic.
+
+Counterpart of the reference's `rllib/algorithms/a2c/` (a2c.py:
+`training_step` = sample → `train_one_step`; loss `a3c_torch_policy.py`:
+plain policy gradient -logp*adv + value loss + entropy bonus). A2C is the
+degenerate PPO: one pass over fresh on-policy data with no ratio clipping
+(the importance ratio is 1 on the first visit), so it rides PPO's compiled
+sample+GAE+update pipeline with clipping disabled and a single epoch —
+the same relationship the reference exploits by sharing the A3C loss.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.algorithm import register_algorithm
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+
+class A2CConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A2C)
+        self.lr = 1e-3
+        # one full-batch gradient step per iteration, no surrogate clipping
+        self.num_sgd_iter = 1
+        self.clip_param = 1e9
+        self.vf_clip_param = 1e9
+        self.sgd_minibatch_size = 10 ** 9   # clamped to batch size
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+
+
+class A2C(PPO):
+    _config_class = A2CConfig
+
+
+register_algorithm("A2C", A2C)

@@ -1,0 +1,70 @@
+"""APPO — asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Counterpart of the reference's `rllib/algorithms/appo/` (appo.py: IMPALA
+subclass; loss `appo_torch_policy.py`: PPO's clipped surrogate computed on
+V-trace advantages with the behaviour policy as the old policy). Inherits
+the async rollout pipeline from our IMPALA (one in-flight sample per
+worker, learner consumes as batches land) and replaces the plain
+policy-gradient term with the clipped surrogate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import register_algorithm
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.4            # reference appo.py default
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.lr = 5e-4
+
+
+class APPO(IMPALA):
+    _config_class = APPOConfig
+
+    def _vtrace_update(self, params, opt_state, batch, last_value):
+        cfg = self.algo_config
+
+        def loss_fn(p):
+            dist, values = self.module.forward(p, batch[sb.OBS])
+            target_logp = dist.logp(batch[sb.ACTIONS])
+            vs, pg_adv = vtrace(
+                batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
+                values, batch[sb.DONES], last_value, cfg.gamma,
+                cfg.lambda_, cfg.vtrace_clip_rho_threshold,
+                cfg.vtrace_clip_pg_rho_threshold)
+            # PPO surrogate on V-trace advantages; the behaviour policy's
+            # logp is the "old" policy (appo_torch_policy.py)
+            ratio = jnp.exp(target_logp - batch[sb.ACTION_LOGP])
+            surr = jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1 - cfg.clip_param,
+                         1 + cfg.clip_param) * pg_adv)
+            pg_loss = -jnp.mean(surr)
+            vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
+            entropy = jnp.mean(dist.entropy())
+            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            if cfg.use_kl_loss:
+                approx_kl = jnp.mean(batch[sb.ACTION_LOGP] - target_logp)
+                total = total + cfg.kl_coeff * approx_kl
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        (_, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, stats
+
+
+register_algorithm("APPO", APPO)

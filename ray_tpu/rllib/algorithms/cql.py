@@ -1,0 +1,128 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Counterpart of the reference's `rllib/algorithms/cql/` (cql.py config on
+top of SAC; loss `cql_torch_policy.py`: SAC's actor/critic/alpha losses
+plus the CQL(H) regularizer — logsumexp over random + policy actions of Q
+minus Q on dataset actions, weighted by `min_q_weight`). Trains purely
+from offline shards (no environment interaction, no rollout state): the
+replay buffer is sized to the dataset and filled once at setup. The SAC
+loss itself is reused via `_sac_update(extra_loss=...)`, so SAC fixes
+(e.g. no_done_at_end) apply here automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import register_algorithm
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, _sample_squashed
+from ray_tpu.rllib.env.jax_env import make_env
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.input_ = None              # offline shards (required)
+        self.min_q_weight = 5.0
+        self.num_cql_actions = 4        # sampled actions for the logsumexp
+        self.learning_starts = 0
+        self.n_updates_per_iter = 64
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class CQL(SAC):
+    _config_class = CQLConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if not cfg.input_:
+            raise ValueError("CQL requires config.offline_data(input_=...)")
+        # env used for spaces only — works with any registered env, no
+        # JaxEnv requirement since CQL never rolls out
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not isinstance(self.env.action_space, Box):
+            raise ValueError("CQL requires a continuous (Box) action space")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.build_learner()
+        # fill the buffer once from the offline shards; actions in the
+        # dataset are env-scaled — map back to the actor's tanh range
+        data = JsonReader(cfg.input_).read_all()
+        n = len(data[sb.REWARDS])
+        if n > cfg.buffer_size:
+            # never silently truncate the dataset to the ring size
+            self.buffer = ReplayBuffer(n, seed=cfg.seed)
+        low = np.asarray(self._act_low)
+        high = np.asarray(self._act_high)
+        acts = np.asarray(data[sb.ACTIONS], dtype=np.float32)
+        unit = np.clip(2.0 * (acts - low) / (high - low) - 1.0,
+                       -0.999, 0.999)
+        self.buffer.add_batch({
+            sb.OBS: np.asarray(data[sb.OBS], np.float32),
+            sb.ACTIONS: unit,
+            sb.REWARDS: np.asarray(data[sb.REWARDS], np.float32),
+            sb.NEXT_OBS: np.asarray(data[sb.NEXT_OBS], np.float32),
+            sb.DONES: np.asarray(data[sb.DONES]),
+        })
+
+    def build_learner(self) -> None:
+        # learner half only: no env vmap/rollout carry (offline)
+        self._build_networks()
+
+    def _cql_penalty(self, p, batch, key):
+        """CQL(H): E_s[logsumexp_a Q(s,a) - Q(s, a_data)] over random
+        uniform + current-policy actions (cql_torch_policy.py). The policy
+        actions are stop_gradient'ed: with the fused optimizer the
+        penalty must shape only the Q-nets, not push the actor toward
+        low-Q actions."""
+        cfg = self.algo_config
+        k_rand, k_pi = jax.random.split(key)
+        B = batch[sb.REWARDS].shape[0]
+        N = cfg.num_cql_actions
+        obs_rep = jnp.repeat(batch[sb.OBS], N, axis=0)
+        rand_act = jax.random.uniform(
+            k_rand, (B * N, self._act_dim), minval=-1.0, maxval=1.0)
+        mean, log_std = self.actor.apply(p["actor"], obs_rep)
+        pi_act, pi_logp = _sample_squashed(mean, log_std, k_pi)
+        pi_act = jax.lax.stop_gradient(pi_act)
+        pi_logp = jax.lax.stop_gradient(pi_logp)
+        penalty = 0.0
+        for qnet, qp in ((self.q1, p["q1"]), (self.q2, p["q2"])):
+            q_rand = qnet.apply(qp, obs_rep, rand_act).reshape(B, N)
+            # importance correction: uniform density over [-1,1]^d
+            q_rand = q_rand + self._act_dim * jnp.log(2.0)
+            q_pi = qnet.apply(qp, obs_rep, pi_act).reshape(B, N) - \
+                pi_logp.reshape(B, N)
+            cat = jnp.concatenate([q_rand, q_pi], axis=1)
+            lse = jax.scipy.special.logsumexp(cat, axis=1) - \
+                jnp.log(2.0 * N)
+            q_data = qnet.apply(qp, batch[sb.OBS], batch[sb.ACTIONS])
+            penalty = penalty + jnp.mean(lse - q_data)
+        return self.algo_config.min_q_weight * penalty
+
+    def _one_update(self, params, target_q, opt_state, batch, key):
+        return self._sac_update(params, target_q, opt_state, batch, key,
+                                extra_loss=self._cql_penalty)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        batches = self._sample_update_batches(cfg.n_updates_per_iter)
+        (self.params, self.target_q, self.opt_state, loss_v,
+         alpha_v) = self._update_many_fn(
+            self.params, self.target_q, self.opt_state, batches,
+            self.next_key())
+        return {"loss": float(np.mean(np.asarray(loss_v))),
+                "alpha": float(np.mean(np.asarray(alpha_v))),
+                "buffer_size": len(self.buffer)}
+
+
+register_algorithm("CQL", CQL)

@@ -1,0 +1,162 @@
+"""MARWIL + BC — offline policy learning from logged experience.
+
+Counterpart of the reference's `rllib/algorithms/marwil/` (marwil.py
+config with `beta`; loss `marwil_torch_policy.py`: advantage-weighted
+behavioral cloning, exp(beta * A / c) * -logp, with a moving estimate c of
+the advantage scale) and `rllib/algorithms/bc/` (BC = MARWIL with beta=0,
+bc.py). Data comes from `ray_tpu.rllib.offline.JsonReader` shards written
+by a behaviour policy; advantages are Monte-Carlo returns minus the
+learned value baseline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.jax_env import make_env
+from ray_tpu.rllib.offline import JsonReader
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.beta = 1.0                 # 0 => plain behavioral cloning
+        self.input_ = None              # path to offline shards (required)
+        self.lr = 1e-3
+        self.train_batch_size = 1024
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-7
+        self.n_updates_per_iter = 16
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class MARWIL(Algorithm):
+    _config_class = MARWILConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if not cfg.input_:
+            raise ValueError("MARWIL/BC require config.offline_data("
+                             "input_=<shard dir>)")
+        # env used only for spaces (reference MARWIL also builds the env
+        # for spaces + optional evaluation)
+        self.env = make_env(cfg.env, cfg.env_config)
+        self.module = RLModule(self.env.observation_space,
+                               self.env.action_space, cfg.model)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.params = self.module.init(self.next_key())
+        self.reader = JsonReader(cfg.input_)
+        self._data = self._postprocess(self.reader.read_all())
+        self.build_learner()
+
+    def _postprocess(self, batch) -> dict:
+        """Monte-Carlo returns per episode (reference:
+        postprocessing.compute_advantages with use_gae=False)."""
+        from ray_tpu.rllib.offline import _per_episode
+        cfg = self.algo_config
+        returns = []
+        for ep in _per_episode(batch):
+            r = np.asarray(ep[sb.REWARDS], dtype=np.float32)
+            g = np.zeros_like(r)
+            acc = 0.0
+            for i in range(len(r) - 1, -1, -1):
+                acc = r[i] + cfg.gamma * acc
+                g[i] = acc
+            returns.append(g)
+        out = {k: np.asarray(v) for k, v in batch.items()}
+        out["mc_returns"] = np.concatenate(returns)
+        return out
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # moving estimate of squared advantage norm (the reference's
+        # update_averaged_sqd_adv_norm)
+        self._adv_norm = jnp.asarray(1.0)
+        self._update_fn = jax.jit(self._marwil_update)
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def _marwil_update(self, params, opt_state, adv_norm, batch):
+        cfg = self.algo_config
+
+        def loss_fn(p):
+            dist, values = self.module.forward(p, batch[sb.OBS])
+            logp = dist.logp(batch[sb.ACTIONS])
+            adv = batch["mc_returns"] - values
+            vf_loss = jnp.mean(jnp.square(adv))
+            if cfg.beta > 0:
+                scaled = adv / jnp.sqrt(adv_norm + 1e-8)
+                weights = jnp.exp(jnp.clip(cfg.beta *
+                                           jax.lax.stop_gradient(scaled),
+                                           -20.0, 2.0))
+            else:
+                weights = jnp.ones_like(logp)
+            policy_loss = -jnp.mean(weights * logp)
+            total = policy_loss + cfg.vf_coeff * vf_loss * \
+                (1.0 if cfg.beta > 0 else 0.0)
+            return total, (policy_loss, vf_loss,
+                           jnp.mean(jnp.square(adv)))
+
+        (loss, (pl, vl, sq)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        rate = cfg.moving_average_sqd_adv_norm_update_rate
+        adv_norm = adv_norm + rate * (sq - adv_norm)
+        return params, opt_state, adv_norm, loss, pl, vl
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        n = len(self._data[sb.REWARDS])
+        losses, pls, vls = [], [], []
+        for _ in range(cfg.n_updates_per_iter):
+            idx = self._np_rng.integers(0, n,
+                                        min(cfg.train_batch_size, n))
+            batch = {k: jnp.asarray(v[idx]) for k, v in self._data.items()
+                     if k in (sb.OBS, sb.ACTIONS, "mc_returns")}
+            (self.params, self.opt_state, self._adv_norm, loss, pl,
+             vl) = self._update_fn(self.params, self.opt_state,
+                                   self._adv_norm, batch)
+            losses.append(float(loss))
+            pls.append(float(pl))
+            vls.append(float(vl))
+        return {"loss": float(np.mean(losses)),
+                "policy_loss": float(np.mean(pls)),
+                "vf_loss": float(np.mean(vls)),
+                "num_samples": n}
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state,
+                "adv_norm": self._adv_norm}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self._adv_norm = state["adv_norm"]
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.beta = 0.0
+
+
+class BC(MARWIL):
+    """Behavioral cloning = MARWIL at beta 0 (reference: bc.py)."""
+    _config_class = BCConfig
+
+
+register_algorithm("MARWIL", MARWIL)
+register_algorithm("BC", BC)

@@ -1,0 +1,177 @@
+"""Offline RL IO + off-policy estimation.
+
+Counterpart of the reference's `rllib/offline/`: `json_writer.py` /
+`json_reader.py` (SampleBatches as JSONL shards), `InputReader` iteration,
+and the off-policy estimators `offline/estimators/` (ImportanceSampling,
+WeightedImportanceSampling — IS/WIS per Precup 2000). Batches are stored
+row-compressed as JSON with base64 numpy columns, one batch per line, so
+shards stream without loading everything.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import io
+import json
+import os
+from typing import Iterator, List
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+def _encode_array(a: np.ndarray) -> dict:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+    return {"__npy__": base64.b64encode(buf.getvalue()).decode()}
+
+
+def _decode(obj):
+    if isinstance(obj, dict) and "__npy__" in obj:
+        return np.load(io.BytesIO(base64.b64decode(obj["__npy__"])),
+                       allow_pickle=False)
+    return obj
+
+
+class JsonWriter:
+    """Append SampleBatches to JSONL shards (reference: json_writer.py)."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_file_size = max_file_size
+        self._shard = 0
+        self._f = None
+
+    def _file(self):
+        if self._f is None or self._f.tell() > self.max_file_size:
+            if self._f:
+                self._f.close()
+            self._f = open(os.path.join(
+                self.path, f"output-{self._shard:05d}.jsonl"), "a")
+            self._shard += 1
+        return self._f
+
+    def write(self, batch: SampleBatch) -> None:
+        row = {k: _encode_array(np.asarray(v)) for k, v in batch.items()}
+        f = self._file()
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+class JsonReader:
+    """Stream SampleBatches back from JSONL shards
+    (reference: json_reader.py). `next()` cycles forever, like the
+    reference's bandit-style input readers."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self.files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+        else:
+            self.files = sorted(glob.glob(path))
+        if not self.files:
+            raise FileNotFoundError(f"no offline shards at {path!r}")
+        self._iter = self._rows()
+
+    def _rows(self) -> Iterator[SampleBatch]:
+        while True:
+            for fn in self.files:
+                with open(fn) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        row = json.loads(line)
+                        yield SampleBatch(
+                            {k: _decode(v) for k, v in row.items()})
+
+    def next(self) -> SampleBatch:
+        return next(self._iter)
+
+    def read_all(self) -> SampleBatch:
+        out: List[SampleBatch] = []
+        for fn in self.files:
+            with open(fn) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    out.append(SampleBatch(
+                        {k: _decode(v) for k, v in row.items()}))
+        return concat_samples(out)
+
+
+# ---------------------------------------------------------------------------
+# off-policy estimators (reference: rllib/offline/estimators/)
+# ---------------------------------------------------------------------------
+
+def _per_episode(batch: SampleBatch):
+    """Episodes of a batch (SampleBatch.split_by_episode, which handles
+    both EPS_ID boundaries and the DONES fallback)."""
+    if not isinstance(batch, SampleBatch):
+        batch = SampleBatch(batch)
+    return batch.split_by_episode()
+
+
+def importance_sampling(batch: SampleBatch, target_logp: np.ndarray,
+                        gamma: float = 1.0) -> dict:
+    """Ordinary IS estimate of the target policy's value from behaviour
+    data (reference: estimators/importance_sampling.py). `target_logp` is
+    the target policy's log-prob of the logged actions, aligned to batch
+    rows; the behaviour log-prob comes from the logged ACTION_LOGP."""
+    behaviour_logp = np.asarray(batch[sb.ACTION_LOGP])
+    vals, raw = [], []
+    offset = 0
+    for ep in _per_episode(batch):
+        t = len(ep[sb.REWARDS])
+        lp_t = target_logp[offset:offset + t]
+        lp_b = behaviour_logp[offset:offset + t]
+        offset += t
+        w = np.exp(np.cumsum(lp_t - lp_b))       # per-step products
+        disc = gamma ** np.arange(t)
+        vals.append(float(np.sum(w * disc * ep[sb.REWARDS])))
+        raw.append(float(np.sum(disc * ep[sb.REWARDS])))
+    return {"v_target": float(np.mean(vals)),
+            "v_behavior": float(np.mean(raw)),
+            "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}
+
+
+def weighted_importance_sampling(batch: SampleBatch,
+                                 target_logp: np.ndarray,
+                                 gamma: float = 1.0) -> dict:
+    """WIS: weights normalized by the per-timestep mean weight across
+    episodes (reference: estimators/weighted_importance_sampling.py) —
+    biased but far lower variance than IS."""
+    behaviour_logp = np.asarray(batch[sb.ACTION_LOGP])
+    eps = []
+    offset = 0
+    for ep in _per_episode(batch):
+        t = len(ep[sb.REWARDS])
+        lp_t = target_logp[offset:offset + t]
+        lp_b = behaviour_logp[offset:offset + t]
+        offset += t
+        eps.append((np.exp(np.cumsum(lp_t - lp_b)), ep[sb.REWARDS]))
+    max_t = max(len(w) for w, _ in eps)
+    # per-timestep normalizer over episodes still alive at t
+    norm = np.zeros(max_t)
+    cnt = np.zeros(max_t)
+    for w, _ in eps:
+        norm[:len(w)] += w
+        cnt[:len(w)] += 1
+    norm = norm / np.maximum(cnt, 1)
+    vals, raw = [], []
+    for w, r in eps:
+        t = len(w)
+        disc = gamma ** np.arange(t)
+        vals.append(float(np.sum(w / (norm[:t] + 1e-8) * disc * r)))
+        raw.append(float(np.sum(disc * r)))
+    return {"v_target": float(np.mean(vals)),
+            "v_behavior": float(np.mean(raw)),
+            "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}

@@ -59,15 +59,21 @@ class SampleBatch(dict):
             yield batch.slice(i, i + size)
 
     def split_by_episode(self) -> List["SampleBatch"]:
-        if EPS_ID not in self:
+        """Split on EPS_ID boundaries; without EPS_ID, fall back to DONES
+        (each done row ends an episode); with neither, the whole batch is
+        one episode."""
+        if EPS_ID in self:
+            ids = self[EPS_ID]
+            boundaries = [0] + list(np.where(ids[1:] != ids[:-1])[0] + 1) \
+                + [len(ids)]
+        elif DONES in self:
+            dones = np.asarray(self[DONES]).astype(bool)
+            boundaries = [0] + list(np.flatnonzero(dones[:-1]) + 1) \
+                + [len(dones)]
+        else:
             return [self]
-        out = []
-        ids = self[EPS_ID]
-        boundaries = [0] + list(np.where(ids[1:] != ids[:-1])[0] + 1) + \
-            [len(ids)]
-        for a, b in zip(boundaries[:-1], boundaries[1:]):
-            out.append(self.slice(a, b))
-        return out
+        return [self.slice(a, b)
+                for a, b in zip(boundaries[:-1], boundaries[1:])]
 
     def __repr__(self):
         cols = {k: tuple(v.shape) for k, v in self.items()}
