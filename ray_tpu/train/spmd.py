@@ -130,13 +130,37 @@ def make_gpt_trainer(cfg, mesh: Mesh, rng=None,
     """
     from ray_tpu.models import gpt
 
+    return _make_lm_trainer(
+        lambda key: gpt.init_params(key, cfg), gpt.param_logical_axes(cfg),
+        partial(gpt_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
+        rules)
+
+
+def moe_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
+    """MoE counterpart of gpt_loss_fn (pre-shifted inputs/targets, same
+    optional padding mask) adding the router load-balance auxiliary loss."""
+    from ray_tpu.models import moe
+
+    logits, aux = moe.forward(params, batch["inputs"], cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logp, batch["targets"][..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        ce = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        ce = -jnp.mean(ll)
+    return ce + cfg.aux_loss_coeff * aux
+
+
+def _make_lm_trainer(init_fn, logical_axes, loss_fn, mesh: Mesh, rng,
+                     optimizer, rules):
+    """Shared assembly behind make_gpt_trainer / make_moe_trainer."""
     rng = jax.random.key(0) if rng is None else rng
     optimizer = optimizer or default_optimizer()
     state, _ = create_sharded_state(
-        lambda key: gpt.init_params(key, cfg),
-        gpt.param_logical_axes(cfg), mesh, rng, optimizer, rules)
-    step_fn = make_train_step(
-        partial(gpt_loss_fn, cfg=cfg, mesh=mesh), optimizer, mesh)
+        init_fn, logical_axes, mesh, rng, optimizer, rules)
+    step_fn = make_train_step(loss_fn, optimizer, mesh)
 
     tok_spec = logical_to_spec(("batch", "length"), rules, mesh)
     tok_sharding = NamedSharding(mesh, tok_spec)
@@ -146,6 +170,19 @@ def make_gpt_trainer(cfg, mesh: Mesh, rng=None,
             lambda a: jax.device_put(a, tok_sharding), batch)
 
     return state, step_fn, shard_tokens
+
+
+def make_moe_trainer(cfg, mesh: Mesh, rng=None,
+                     optimizer: optax.GradientTransformation | None = None,
+                     rules: dict | None = None):
+    """MoE assembly: expert weights shard over the mesh's `expert` axis,
+    so the dispatch/combine einsums lower to all-to-alls over ICI."""
+    from ray_tpu.models import moe
+
+    return _make_lm_trainer(
+        lambda key: moe.init_params(key, cfg), moe.param_logical_axes(cfg),
+        partial(moe_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
+        rules)
 
 
 def train_flops_per_token(cfg, seq_len: int) -> float:

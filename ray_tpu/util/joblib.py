@@ -1,0 +1,46 @@
+"""joblib backend running Parallel() jobs as cluster tasks.
+
+Counterpart of the reference's `ray.util.joblib`
+(`util/joblib/__init__.py` register_ray + `ray_backend.py` RayBackend on
+top of the multiprocessing-pool shim): after `register_ray_tpu()`,
+`with joblib.parallel_backend("ray_tpu"):` routes scikit-learn-style
+workloads through the scheduler.
+"""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import MultiprocessingBackend
+from joblib.parallel import register_parallel_backend
+
+
+class RayTpuBackend(MultiprocessingBackend):
+    """joblib backend whose pool is the cluster-task Pool."""
+
+    supports_timeout = True
+
+    def effective_n_jobs(self, n_jobs):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        ncpu = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs is None or n_jobs == -1:
+            return ncpu
+        return min(abs(n_jobs), ncpu) or 1
+
+    def configure(self, n_jobs=1, parallel=None, prefer=None, require=None,
+                  **memmapping_kwargs):
+        n_jobs = self.effective_n_jobs(n_jobs)
+        from ray_tpu.util.multiprocessing import Pool
+        self._pool = Pool(processes=n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def terminate(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.terminate()
+            self._pool = None
+
+
+def register_ray_tpu() -> None:
+    """Make `joblib.parallel_backend("ray_tpu")` available."""
+    register_parallel_backend("ray_tpu", RayTpuBackend)

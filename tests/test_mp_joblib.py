@@ -1,0 +1,98 @@
+"""multiprocessing.Pool drop-in and joblib backend.
+
+Counterpart of the reference's `python/ray/tests/test_multiprocessing.py`
+and `test_joblib.py`.
+"""
+
+import math
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import AsyncResult, Pool, TimeoutError
+
+
+@pytest.fixture
+def pool(ray_session):
+    p = Pool(processes=3)
+    yield p
+    p.terminate()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_map(pool):
+    assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+
+
+def test_map_chunked(pool):
+    assert pool.map(_sq, range(23), chunksize=5) == \
+        [x * x for x in range(23)]
+
+
+def test_apply_and_async(pool):
+    assert pool.apply(_add, (2, 3)) == 5
+    res = pool.apply_async(_add, (10, 20))
+    assert isinstance(res, AsyncResult)
+    assert res.get(timeout=60) == 30
+    assert res.ready() and res.successful()
+
+
+def test_starmap(pool):
+    assert pool.starmap(_add, [(1, 2), (3, 4), (5, 6)]) == [3, 7, 11]
+
+
+def test_imap_ordered(pool):
+    out = list(pool.imap(_sq, range(8), chunksize=3))
+    assert out == [x * x for x in range(8)]
+
+
+def test_imap_unordered(pool):
+    out = sorted(pool.imap_unordered(_sq, range(8), chunksize=2))
+    assert out == sorted(x * x for x in range(8))
+
+
+def test_error_propagates(pool):
+    def boom(x):
+        raise RuntimeError("pool boom")
+    with pytest.raises(RuntimeError, match="pool boom"):
+        pool.map(boom, range(3))
+
+
+def test_async_callbacks(pool):
+    import threading
+    done = threading.Event()
+    got = []
+    pool.map_async(_sq, range(4), callback=lambda r: (got.append(r),
+                                                      done.set()))
+    assert done.wait(60)
+    assert got[0] == [0, 1, 4, 9]
+
+
+def test_closed_pool_rejects(pool):
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(_sq, [1])
+
+
+def test_context_manager(ray_session):
+    with Pool(2) as p:
+        assert p.map(_sq, [2, 4]) == [4, 16]
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_joblib_backend(ray_session):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=3):
+        out = joblib.Parallel()(
+            joblib.delayed(math.factorial)(i) for i in range(8))
+    assert out == [math.factorial(i) for i in range(8)]
