@@ -29,6 +29,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import connection
 
@@ -147,6 +148,23 @@ class NodeServer:
         self.directory: dict[str, Descriptor] = {}
         self.obj_waiting_tasks: dict[str, list[_TaskState]] = {}
 
+        # Distributed refcount state (reference: ReferenceCounter,
+        # reference_count.h:61). An object is freed when: no process holds
+        # a live ObjectRef (ref_holders empty), no queued/running task will
+        # consume it (task_arg_refs 0), and it never escaped via pickle.
+        self.ref_holders: dict[str, set] = {}     # oid -> holder ids
+        self.escaped_refs: set = set()
+        self.task_arg_refs: dict[str, int] = {}   # oid -> pending consumers
+        self.obj_origin: dict[str, str] = {}      # oid -> worker_id|driver
+        self.dead_pending: set = set()            # released pre-registration
+        # ids freed by refcounting: tombstones so a racing get/wait/submit
+        # fails fast instead of waiting forever for a re-registration that
+        # can never come (bounded FIFO)
+        self.freed_refs: "OrderedDict[str, bool]" = OrderedDict()
+        # task_ids whose args were already released (exactly-once guard);
+        # bounded FIFO so a long session doesn't grow it forever
+        self._args_released: "OrderedDict[str, bool]" = OrderedDict()
+
         self.pending: list[_TaskState] = []
         self.workers: dict[str, _WorkerConn] = {}
         self.actors: dict[str, _ActorState] = {}
@@ -246,7 +264,14 @@ class NodeServer:
         if isinstance(msg, protocol.TaskDone):
             self._on_task_done(w, msg)
         elif isinstance(msg, protocol.PutRequest):
-            self.register_object(msg.object_id, msg.desc)
+            # the putting worker certainly holds its new ObjectRef right
+            # now, but its batched "hold" report may lag by up to the
+            # flush period: record an implicit hold so a fast consumer
+            # can't free the object in that window (idempotent with the
+            # explicit hold; cleared by the worker's eventual release)
+            self.ref_hold(msg.object_id, w.worker_id)
+            self.register_object(msg.object_id, msg.desc,
+                                 origin=w.worker_id)
         elif isinstance(msg, protocol.GetRequest):
             threading.Thread(
                 target=self._serve_get, args=(w, msg), daemon=True).start()
@@ -385,6 +410,19 @@ class NodeServer:
                 return jm.logs(payload)
             if method == "job_stop":
                 return jm.stop(payload)
+        if method == "ref_update":
+            holder = payload["holder"]
+            with self.lock:
+                for oid in payload.get("escape", ()):
+                    self.escaped_refs.add(oid)
+                for oid in payload.get("hold", ()):
+                    self.ref_holders.setdefault(oid, set()).add(holder)
+                for oid in payload.get("release", ()):
+                    holders = self.ref_holders.get(oid)
+                    if holders is not None:
+                        holders.discard(holder)
+                    self._maybe_free_locked(oid)
+            return True
         if method == "push_metrics":
             wid, snap = payload
             with self.lock:
@@ -419,13 +457,97 @@ class NodeServer:
                         os.path.join(self.session_dir, "jobs"))
         return self._jobs
 
-    def register_object(self, object_id: str, desc: Descriptor):
+    # ------------------------------------------------------------------
+    # reference counting
+    # ------------------------------------------------------------------
+
+    def ref_hold(self, oid: str, holder: str) -> None:
         with self.lock:
-            self.directory[object_id] = desc
-            waiting = self.obj_waiting_tasks.pop(object_id, ())
-            for t in waiting:
-                t.deps.discard(object_id)
-            self.cv.notify_all()
+            self.ref_holders.setdefault(oid, set()).add(holder)
+
+    def ref_release(self, oid: str, holder: str) -> None:
+        with self.lock:
+            holders = self.ref_holders.get(oid)
+            if holders is not None:
+                holders.discard(holder)
+            self._maybe_free_locked(oid)
+
+    def ref_escape(self, oid: str) -> None:
+        with self.lock:
+            self.escaped_refs.add(oid)
+
+    def _pin_task_args_locked(self, spec) -> None:
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "ref":
+                self.task_arg_refs[v] = self.task_arg_refs.get(v, 0) + 1
+
+    def _release_task_args(self, spec) -> None:
+        """Exactly-once per task: its ref args are no longer needed by
+        this consumer. Called from every terminal path."""
+        with self.lock:
+            if spec.task_id in self._args_released:
+                return
+            self._args_released[spec.task_id] = True
+            while len(self._args_released) > 200_000:
+                self._args_released.popitem(last=False)
+            for kind, v in list(spec.args) + list(spec.kwargs.values()):
+                if kind == "ref":
+                    n = self.task_arg_refs.get(v, 0) - 1
+                    if n <= 0:
+                        self.task_arg_refs.pop(v, None)
+                        self._maybe_free_locked(v)
+                    else:
+                        self.task_arg_refs[v] = n
+
+    def _maybe_free_locked(self, oid: str) -> None:
+        """Free the object if nothing can reach it anymore (caller holds
+        the lock)."""
+        if oid in self.escaped_refs:
+            return
+        if self.ref_holders.get(oid):
+            return
+        if self.task_arg_refs.get(oid, 0) > 0:
+            return
+        desc = self.directory.get(oid)
+        if desc is None:
+            # released before the producing task finished: free on arrival
+            self.dead_pending.add(oid)
+            return
+        del self.directory[oid]
+        self.ref_holders.pop(oid, None)
+        self.dead_pending.discard(oid)
+        self.freed_refs[oid] = True
+        while len(self.freed_refs) > 100_000:
+            self.freed_refs.popitem(last=False)
+        origin = self.obj_origin.pop(oid, "driver")
+        self.store.delete(desc)
+        if origin != "driver":
+            w = self.workers.get(origin)
+            if w is not None and w.alive:
+                # origin worker still holds the put-time owner pin
+                w.send(protocol.FreeObject(oid, desc))
+        self.cv.notify_all()   # wake racing gets so they fail fast
+
+    def _register_locked(self, object_id: str, desc: Descriptor,
+                         origin: str):
+        """Directory insert + origin + dead_pending + dependent-task wakeup
+        (single implementation for put, task returns, and error stores).
+        Caller holds the lock; returns True if tasks were unblocked."""
+        self.directory[object_id] = desc
+        self.obj_origin[object_id] = origin
+        if object_id in self.dead_pending:
+            self.dead_pending.discard(object_id)
+            self._maybe_free_locked(object_id)
+        waiting = self.obj_waiting_tasks.pop(object_id, ())
+        for t in waiting:
+            t.deps.discard(object_id)
+        self.cv.notify_all()
+        return bool(waiting)
+
+    def register_object(self, object_id: str, desc: Descriptor,
+                        origin: str = "driver"):
+        with self.lock:
+            waiting = self._register_locked(object_id, desc, origin)
         if waiting:
             self._schedule()
 
@@ -441,6 +563,12 @@ class NodeServer:
         with self.cv:
             while True:
                 missing = [o for o in object_ids if o not in self.directory]
+                freed = [o for o in missing if o in self.freed_refs]
+                if freed:
+                    from ray_tpu.exceptions import ObjectFreedError
+                    raise ObjectFreedError(
+                        f"object {freed[0]} was freed by reference "
+                        "counting before this get()")
                 if not missing:
                     return {o: self.directory[o] for o in object_ids}
                 if deadline is not None:
@@ -457,6 +585,13 @@ class NodeServer:
         with self.cv:
             while True:
                 ready = [o for o in object_ids if o in self.directory]
+                freed = [o for o in object_ids
+                         if o not in self.directory and o in self.freed_refs]
+                if freed:
+                    from ray_tpu.exceptions import ObjectFreedError
+                    raise ObjectFreedError(
+                        f"object {freed[0]} was freed by reference "
+                        "counting before this wait()")
                 if len(ready) >= num_returns:
                     break
                 if deadline is not None:
@@ -508,10 +643,29 @@ class NodeServer:
                        retry_exceptions=spec.retry_exceptions)
         with self.lock:
             for kind, v in list(spec.args) + list(spec.kwargs.values()):
+                if kind == "ref" and v not in self.directory \
+                        and v in self.freed_refs:
+                    from ray_tpu.exceptions import ObjectFreedError
+                    self._store_error(
+                        spec.return_ids,
+                        ObjectFreedError(
+                            f"task argument {v} was already freed by "
+                            "reference counting"),
+                        spec=spec)
+                    return
+            for kind, v in list(spec.args) + list(spec.kwargs.values()):
                 if kind == "ref" and v not in self.directory:
                     t.deps.add(v)
                     self.obj_waiting_tasks.setdefault(v, []).append(t)
             self.task_events.submitted(spec, bool(t.deps))
+            self._pin_task_args_locked(spec)
+            if submitter is not None:
+                # worker-submitted task: the submitter holds the return
+                # refs it just minted, but its batched hold report may
+                # lag — record implicit holds (see PutRequest handler)
+                for oid in spec.return_ids:
+                    self.ref_holders.setdefault(oid, set()).add(
+                        submitter.worker_id)
             if spec.actor_creation:
                 _name = (spec.runtime_env or {}).get("_name")
                 if _name and _name in self.named_actors:
@@ -541,7 +695,7 @@ class NodeServer:
                         spec.return_ids,
                         ActorDiedError(f"actor {spec.actor_id} is dead: "
                                        f"{cause}"),
-                        task_id=spec.task_id)
+                        spec=spec)
                     return
                 a.queue.append(t)
             else:
@@ -661,7 +815,7 @@ class NodeServer:
             self._store_error(
                 t.spec.return_ids,
                 WorkerCrashedError("TPU worker failed to start"),
-                task_id=t.spec.task_id)
+                spec=t.spec)
             return
         with self.lock:
             w.current = t
@@ -806,7 +960,8 @@ class NodeServer:
                                 t.spec.return_ids,
                                 WorkerCrashedError(
                                     "worker processes repeatedly failed to "
-                                    "start; check worker logs"))
+                                    "start; check worker logs"),
+                                spec=t.spec)
         self._schedule()
 
     def _spawn_actor_worker(self, a: _ActorState, creation_task: _TaskState):
@@ -877,10 +1032,9 @@ class NodeServer:
                 return
             self.task_events.finished(
                 msg.task_id, error="application_error" if msg.error else None)
+            self._release_task_args(spec)
             for oid, desc in zip(spec.return_ids, msg.return_descs):
-                self.directory[oid] = desc
-                for dep_t in self.obj_waiting_tasks.pop(oid, ()):
-                    dep_t.deps.discard(oid)
+                self._register_locked(oid, desc, origin=w.worker_id)
             self.cv.notify_all()
             if a is not None:
                 if t in a.inflight:
@@ -896,7 +1050,7 @@ class NodeServer:
                                 qt.spec.return_ids,
                                 ActorDiedError(
                                     f"actor {a.actor_id} constructor raised"),
-                                task_id=qt.spec.task_id)
+                                spec=qt.spec)
                     else:
                         a.ready = True
                 if a.worker is w:
@@ -965,20 +1119,19 @@ class NodeServer:
             self.free_tpu_chips.extend(a.tpu_chips)
             a.tpu_chips = []
 
-    def _store_error(self, return_ids, exc, task_id=None):
+    def _store_error(self, return_ids, exc, spec=None):
         """Store `exc` as the value of every return id (under or out of lock).
-        `task_id` records the terminal FAILED transition in the state API —
-        this is the chokepoint every failure path goes through."""
-        if task_id is not None:
-            self.task_events.finished(task_id, error=type(exc).__name__)
+        `spec` records the terminal FAILED transition in the state API and
+        releases the task's pinned args — this is the chokepoint every
+        failure path goes through."""
+        if spec is not None:
+            self.task_events.finished(spec.task_id,
+                                      error=type(exc).__name__)
+            self._release_task_args(spec)
         for oid in return_ids:
             desc = self.store.put(oid, exc)
-            self.directory[oid] = desc
-        with self.lock:
-            for oid in return_ids:
-                for dep_t in self.obj_waiting_tasks.pop(oid, ()):
-                    dep_t.deps.discard(oid)
-            self.cv.notify_all()
+            with self.lock:
+                self._register_locked(oid, desc, origin="driver")
 
     def _on_worker_death(self, w: _WorkerConn):
         with self.lock:
@@ -995,6 +1148,13 @@ class NodeServer:
             w.current = None
             actor = next((a for a in self.actors.values()
                           if a.worker is w), None)
+            # drop the dead process's ref holds (its ObjectRefs died with
+            # it); objects it alone held become freeable
+            affected = [oid for oid, holders in self.ref_holders.items()
+                        if w.worker_id in holders]
+            for oid in affected:
+                self.ref_holders[oid].discard(w.worker_id)
+                self._maybe_free_locked(oid)
         if actor is not None:
             self._on_actor_worker_death(actor)
         elif t is not None:
@@ -1014,7 +1174,7 @@ class NodeServer:
                     t.spec.return_ids,
                     WorkerCrashedError(
                         f"worker died while running {t.spec.function_desc}"),
-                    task_id=t.spec.task_id)
+                    spec=t.spec)
         self._schedule()
 
     def _on_actor_worker_death(self, a: _ActorState):
@@ -1055,7 +1215,7 @@ class NodeServer:
                 t.spec.return_ids,
                 ActorDiedError(f"actor {a.actor_id} died"
                                f" ({a.death_cause or 'restarting'})"),
-                task_id=t.spec.task_id)
+                spec=t.spec)
         self._schedule()
 
     def _fail_actor(self, a: _ActorState, cause: str):
@@ -1067,10 +1227,10 @@ class NodeServer:
             self._release_actor_resources(a)
         for t in tasks:
             self._store_error(t.spec.return_ids, ActorDiedError(cause),
-                              task_id=t.spec.task_id)
+                              spec=t.spec)
         # creation return id too
         self._store_error(a.creation_spec.return_ids, ActorDiedError(cause),
-                          task_id=a.creation_spec.task_id)
+                          spec=a.creation_spec)
 
     # ------------------------------------------------------------------
     # actor control
@@ -1113,7 +1273,7 @@ class NodeServer:
                     self.pending.remove(t)
                     self._store_error(t.spec.return_ids,
                                       TaskCancelledError("task cancelled"),
-                                      task_id=t.spec.task_id)
+                                      spec=t.spec)
                     return True
             for a in self.actors.values():
                 for t in a.queue:
@@ -1122,7 +1282,7 @@ class NodeServer:
                         a.queue.remove(t)
                         self._store_error(t.spec.return_ids,
                                           TaskCancelledError("task cancelled"),
-                                          task_id=t.spec.task_id)
+                                          spec=t.spec)
                         return True
         return False
 

@@ -60,6 +60,15 @@ class KillWorker:
     graceful: bool = True
 
 
+@dataclass
+class FreeObject:
+    """Driver -> origin worker: all references to this object are gone;
+    drop your put-time owner pin and delete it from the shared store
+    (the reference's FreeObjects / out-of-scope deletion path)."""
+    object_id: str
+    desc: Descriptor
+
+
 # ---- worker -> driver -----------------------------------------------------
 
 @dataclass

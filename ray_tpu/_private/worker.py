@@ -18,20 +18,121 @@ from typing import Any, Sequence
 from ray_tpu._private import ids
 from ray_tpu.exceptions import RayTpuError, TaskError
 
+# ---------------------------------------------------------------------------
+# Reference counting (the distributed-refcount seam, reference:
+# `src/ray/core_worker/reference_count.h:61` ReferenceCounter). Each process
+# counts its live ObjectRef pythons per object id; the 0→1 and →0
+# transitions are reported to the driver ("hold"/"release"), which frees an
+# object once no process holds it, no queued/running task consumes it, and
+# it never escaped. Escape = the ObjectRef was pickled into an arbitrary
+# payload (nested in a value, stored in actor state, written to disk) — the
+# pessimistic stand-in for the reference's borrower protocol: escaped
+# objects live for the session. RAY_TPU_DISABLE_REFCOUNT=1 restores
+# session-lifetime objects everywhere.
+# ---------------------------------------------------------------------------
+
+import os as _os
+
+_REFCOUNT_DISABLED = _os.environ.get("RAY_TPU_DISABLE_REFCOUNT") == "1"
+_track_lock = threading.Lock()
+_local_counts: dict = {}
+# __del__ may run re-entrantly mid-GC while _track_lock is held by the
+# same thread, so decrements are only ever an atomic deque append; they
+# are folded into the counts later from regular threads (_drain_decs).
+import collections as _collections
+
+_pending_decs: "_collections.deque[str]" = _collections.deque()
+
+
+def _notify(kind: str, oid: str) -> None:
+    client = _global_client
+    if client is None:
+        return
+    try:
+        if client.mode == "driver":
+            if kind == "hold":
+                client.node.ref_hold(oid, "driver")
+            elif kind == "release":
+                client.node.ref_release(oid, "driver")
+            else:
+                client.node.ref_escape(oid)
+        elif client.mode == "worker":
+            client.rt.enqueue_ref_event(kind, oid)
+    except Exception:
+        pass  # teardown races: losing a release only delays a free
+
+
+def _drain_decs() -> None:
+    """Fold queued __del__ decrements into the counts; emit releases."""
+    if not _pending_decs:
+        return
+    released = []
+    with _track_lock:
+        while True:
+            try:
+                oid = _pending_decs.popleft()
+            except IndexError:
+                break
+            n = _local_counts.get(oid, 0) - 1
+            if n <= 0:
+                _local_counts.pop(oid, None)
+                if n == 0:
+                    released.append(oid)
+            else:
+                _local_counts[oid] = n
+    for oid in released:
+        _notify("release", oid)
+
+
+def _track_inc(oid: str) -> None:
+    if _REFCOUNT_DISABLED:
+        return
+    _drain_decs()
+    with _track_lock:
+        n = _local_counts.get(oid, 0)
+        _local_counts[oid] = n + 1
+    if n == 0:
+        _notify("hold", oid)
+
+
+def _track_dec(oid: str) -> None:
+    if _REFCOUNT_DISABLED:
+        return
+    try:
+        _pending_decs.append(oid)   # GIL-atomic; folded in _drain_decs
+    except Exception:
+        pass  # interpreter shutdown
+
+
+def _mark_escaped(oid: str) -> None:
+    if _REFCOUNT_DISABLED:
+        return
+    _notify("escape", oid)
+
 
 class ObjectRef:
     """A future for a task return or `put` value (reference: ObjectRef in
-    `python/ray/includes/object_ref.pxi`). Identity is the object id string."""
+    `python/ray/includes/object_ref.pxi`). Identity is the object id string.
+    Instances participate in distributed refcounting (above)."""
 
     __slots__ = ("_id",)
 
     def __init__(self, object_id: str):
         self._id = object_id
+        _track_inc(object_id)
 
     def hex(self) -> str:
         return self._id
 
+    def __del__(self):
+        _track_dec(self._id)
+
     def __reduce__(self):
+        # Pickling a ref means it may re-materialize anywhere (inside a
+        # stored value, actor state, a file): mark it escaped so the
+        # driver never frees it. Top-level task args bypass this — they
+        # are encoded as ("ref", id) without pickling the ObjectRef.
+        _mark_escaped(self._id)
         return (ObjectRef, (self._id,))
 
     def __hash__(self):
@@ -144,6 +245,8 @@ def get_client() -> BaseClient:
     if _global_client is None:
         raise RayTpuError(
             "ray_tpu.init() has not been called in this process")
+    if _pending_decs:
+        _drain_decs()   # piggyback refcount housekeeping on API activity
     return _global_client
 
 

@@ -16,6 +16,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing import connection
@@ -46,6 +47,13 @@ class WorkerRuntime:
         self._executor: ThreadPoolExecutor | None = None
         self._current_task_ids = threading.local()
         self.shutdown = False
+        # batched refcount events -> driver (hold/release/escape), flushed
+        # by a timer so __del__ storms don't become a message storm
+        self._ref_lock = threading.Lock()
+        self._ref_pending: dict[str, list] = {
+            "hold": [], "release": [], "escape": []}
+        threading.Thread(target=self._ref_flush_loop,
+                         name="ref-flush", daemon=True).start()
 
     # ---- channel ----------------------------------------------------------
 
@@ -77,6 +85,13 @@ class WorkerRuntime:
                 os._exit(0)
             if isinstance(msg, protocol.PushTask):
                 self.task_queue.put(msg)
+            elif isinstance(msg, protocol.FreeObject):
+                # all refs gone cluster-wide: drop this process's owner pin
+                # so the arena block can actually be reclaimed
+                try:
+                    self.store.delete(msg.desc)
+                except Exception:
+                    pass
             elif isinstance(msg, protocol.KillWorker):
                 self.shutdown = True
                 self.task_queue.put(None)
@@ -126,6 +141,30 @@ class WorkerRuntime:
         if reply.error is not None:
             raise RayTpuError(reply.error)
         return reply.result
+
+    # ---- refcount event batching -----------------------------------------
+
+    def enqueue_ref_event(self, kind: str, oid: str) -> None:
+        with self._ref_lock:
+            self._ref_pending[kind].append(oid)
+
+    def _flush_ref_events(self) -> None:
+        with self._ref_lock:
+            if not any(self._ref_pending.values()):
+                return
+            batch, self._ref_pending = self._ref_pending, {
+                "hold": [], "release": [], "escape": []}
+        try:
+            self.control("ref_update", {"holder": self.worker_id, **batch})
+        except Exception:
+            pass  # driver gone; session over
+
+    def _ref_flush_loop(self) -> None:
+        from ray_tpu._private import worker as _worker_mod
+        while not self.shutdown:
+            time.sleep(0.5)
+            _worker_mod._drain_decs()
+            self._flush_ref_events()
 
     # ---- execution --------------------------------------------------------
 

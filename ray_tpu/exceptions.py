@@ -68,6 +68,12 @@ class ObjectLostError(RayTpuError):
     """An object's value could not be found in the store."""
 
 
+class ObjectFreedError(ObjectLostError):
+    """The object was freed by reference counting before this access —
+    usually a ref that reached the node only after its last holder was
+    accounted released (reference: ObjectFreedError in exceptions.py)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get(..., timeout=)` expired before the object was ready."""
 

@@ -1,0 +1,172 @@
+"""Distributed reference counting and object freeing.
+
+Counterpart of the reference's `python/ray/tests/test_reference_counting.py`
+(driver refs, task-arg pinning, out-of-scope deletion) against the N5
+ReferenceCounter design: objects are freed when no process holds a live
+ObjectRef, no queued/running task will consume them, and they never
+escaped via pickling.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster(ray_session):
+    return ray_session
+
+
+def _node():
+    return ray_tpu._worker.get_client().node
+
+
+def _wait_freed(oid, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        gc.collect()
+        ray_tpu._worker._drain_decs()
+        with _node().lock:
+            if oid not in _node().directory:
+                return True
+        time.sleep(0.1)
+    return False
+
+
+def _wait_present(oid, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with _node().lock:
+            if oid in _node().directory:
+                return True
+        time.sleep(0.05)
+    return False
+
+
+def test_put_freed_on_ref_drop(cluster):
+    arr = np.arange(100_000, dtype=np.float32)   # large -> store-backed
+    ref = ray_tpu.put(arr)
+    oid = ref._id
+    assert _wait_present(oid)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+    del ref
+    assert _wait_freed(oid), "object not freed after last ref dropped"
+
+
+def test_object_survives_while_held(cluster):
+    ref = ray_tpu.put(np.ones(50_000, np.float32))
+    oid = ref._id
+    gc.collect()
+    ray_tpu._worker._drain_decs()
+    time.sleep(1.0)
+    with _node().lock:
+        assert oid in _node().directory
+    assert float(ray_tpu.get(ref).sum()) == 50_000.0
+
+
+def test_task_return_freed_after_drop(cluster):
+    @ray_tpu.remote
+    def make():
+        return np.zeros(200_000, np.uint8)
+
+    ref = make.remote()
+    assert ray_tpu.get(ref).nbytes == 200_000
+    oid = ref._id
+    del ref
+    assert _wait_freed(oid), "worker-origin object not freed"
+
+
+def test_arg_pinned_until_consumer_done(cluster):
+    """Dropping the producer ref right after submitting the consumer must
+    not lose the data: the pending task pins it."""
+    @ray_tpu.remote
+    def slow_consume(arr):
+        import time as _t
+        _t.sleep(1.0)
+        return float(arr.sum())
+
+    data = ray_tpu.put(np.ones(150_000, np.float32))
+    oid = data._id
+    out = slow_consume.remote(data)
+    del data                       # only the queued task references it now
+    gc.collect()
+    ray_tpu._worker._drain_decs()
+    assert ray_tpu.get(out, timeout=60) == 150_000.0
+    del out
+    assert _wait_freed(oid), "consumed arg not freed after task finished"
+
+
+def test_chain_intermediates_freed(cluster):
+    @ray_tpu.remote
+    def stage(x):
+        return x + np.ones(120_000, np.float32)
+
+    a = stage.remote(np.zeros(120_000, np.float32))
+    b = stage.remote(a)
+    a_id = a._id
+    del a
+    result = ray_tpu.get(b)
+    assert float(result[0]) == 2.0
+    assert _wait_freed(a_id), "intermediate not freed after chain consumed"
+
+
+def test_escaped_ref_never_freed(cluster):
+    """A ref pickled inside another object may rematerialize anywhere:
+    pessimistically pinned for the session."""
+    inner = ray_tpu.put(np.arange(60_000, dtype=np.int32))
+    oid = inner._id
+    holder = ray_tpu.put({"nested": inner})   # pickles the ObjectRef
+    del inner
+    gc.collect()
+    ray_tpu._worker._drain_decs()
+    time.sleep(1.5)
+    with _node().lock:
+        assert oid in _node().directory, "escaped object must not be freed"
+    out = ray_tpu.get(holder)
+    np.testing.assert_array_equal(ray_tpu.get(out["nested"]),
+                                  np.arange(60_000, dtype=np.int32))
+
+
+def test_worker_held_ref_blocks_free(cluster):
+    """An actor that keeps a (nested, escaped) ref alive can still read
+    it after the driver drops its copy."""
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.ref = None
+
+        def keep(self, boxed):
+            self.ref = boxed["r"]
+            return True
+
+        def read(self):
+            return float(ray_tpu.get(self.ref).sum())
+
+    k = Keeper.remote()
+    ref = ray_tpu.put(np.ones(80_000, np.float32))
+    assert ray_tpu.get(k.keep.remote({"r": ref}))   # nested -> escapes
+    del ref
+    gc.collect()
+    ray_tpu._worker._drain_decs()
+    time.sleep(1.0)
+    assert ray_tpu.get(k.read.remote(), timeout=60) == 80_000.0
+    ray_tpu.kill(k)
+
+
+def test_refcount_bookkeeping_bounded(cluster):
+    """Freed objects leave no residue in the node's ref tables."""
+    node = _node()
+    refs = [ray_tpu.put(np.zeros(110_000, np.uint8)) for _ in range(8)]
+    oids = [r._id for r in refs]
+    ray_tpu.get(refs)
+    del refs
+    for oid in oids:
+        assert _wait_freed(oid)
+    with node.lock:
+        for oid in oids:
+            assert oid not in node.obj_origin
+            assert not node.ref_holders.get(oid)
