@@ -14,6 +14,7 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 
 # Session facade re-exports (reference: ray.air.session / ray.train.*)
@@ -26,6 +27,7 @@ get_mesh_spec = session.get_mesh_spec
 
 __all__ = [
     "JaxTrainer", "Result", "TrainingFailedError", "Checkpoint",
+    "Predictor", "JaxPredictor", "BatchPredictor",
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
     "session", "report", "get_checkpoint", "get_dataset_shard",
     "get_world_size", "get_world_rank", "get_mesh_spec",

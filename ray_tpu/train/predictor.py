@@ -1,0 +1,123 @@
+"""Predictors + batch inference over datasets.
+
+Counterpart of the reference's predictor stack: `Predictor`
+(`train/predictor.py`), the torch/tf predictors
+(`train/torch/torch_predictor.py`, `_internal/dl_predictor.py`), and
+`BatchPredictor` (`train/batch_predictor.py`) which maps a
+checkpoint-loaded model over a Dataset with an autoscaling actor pool —
+the GPU/TPU batch-inference path (`ActorPoolMapOperator`,
+`data/_internal/execution/operators/actor_pool_map_operator.py:34`).
+
+TPU-first shape: a JaxPredictor owns one jitted apply function; batches
+arrive as numpy, ride device_put once, and results come back as numpy.
+Model state loads once per actor (the whole point of the actor-pool
+path), so weights transfer per-actor, not per-batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base: subclass with `_predict_numpy` (reference: Predictor)."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, data, **kwargs):
+        if isinstance(data, dict):
+            return self._predict_numpy(data, **kwargs)
+        arr = np.asarray(data)
+        return self._predict_numpy({"__value__": arr}, **kwargs)
+
+    def _predict_numpy(self, batch: Dict[str, np.ndarray], **kwargs):
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Wraps (apply_fn, params): apply_fn(params, batch_array) -> output.
+
+    `input_column` picks the feature column of dict batches ("__value__"
+    for plain-array datasets); output lands in `output_column`.
+    """
+
+    def __init__(self, apply_fn: Callable, params: Any,
+                 input_column: str = "__value__",
+                 output_column: str = "predictions",
+                 jit: bool = True):
+        import jax
+        self._apply = jax.jit(apply_fn) if jit else apply_fn
+        self._params = params
+        self.input_column = input_column
+        self.output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *,
+                        apply_fn: Callable, **kwargs) -> "JaxPredictor":
+        state = checkpoint.to_dict()
+        params = state.get("params", state)
+        return cls(apply_fn, params, **kwargs)
+
+    def _predict_numpy(self, batch: Dict[str, np.ndarray], **kwargs):
+        import jax.numpy as jnp
+        col = self.input_column if self.input_column in batch \
+            else next(iter(batch))
+        out = self._apply(self._params, jnp.asarray(batch[col]))
+        result = dict(batch)
+        result[self.output_column] = np.asarray(out)
+        return result
+
+
+class BatchPredictor:
+    """Map a checkpoint-loaded predictor over a Dataset
+    (reference: BatchPredictor.predict)."""
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls,
+                 **predictor_kwargs):
+        self._checkpoint = checkpoint
+        self._predictor_cls = predictor_cls
+        self._predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, predictor_cls,
+                        **kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **kwargs)
+
+    def predict(self, dataset, *, batch_size: int = 1024,
+                num_tpus_per_actor: float = 0,
+                min_actors: int = 1, max_actors: Optional[int] = None,
+                keep_columns: Optional[list] = None):
+        """-> Dataset with the prediction column appended. The predictor
+        loads once per pool actor; batches stream through the actor pool
+        (the reference's ActorPoolMapOperator path)."""
+        from ray_tpu.data.dataset import ActorPoolStrategy
+
+        checkpoint = self._checkpoint
+        predictor_cls = self._predictor_cls
+        predictor_kwargs = self._predictor_kwargs
+        keep = keep_columns
+
+        class _PredictUDF:
+            def __init__(self):
+                self.predictor = predictor_cls.from_checkpoint(
+                    checkpoint, **predictor_kwargs)
+
+            def __call__(self, batch):
+                out = self.predictor._predict_numpy(batch)
+                if keep is not None:
+                    out = {k: v for k, v in out.items()
+                           if k in keep or
+                           k == self.predictor.output_column}
+                return out
+
+        pool = ActorPoolStrategy(
+            min_size=min_actors, max_size=max_actors or max(min_actors, 2))
+        return dataset.map_batches(
+            _PredictUDF, batch_size=batch_size, compute=pool,
+            num_tpus=num_tpus_per_actor or None)

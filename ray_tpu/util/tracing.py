@@ -1,0 +1,109 @@
+"""Opt-in tracing spans (OpenTelemetry-style, dependency-free).
+
+Counterpart of the reference's `ray.util.tracing`
+(`util/tracing/tracing_helper.py`: lazy OpenTelemetry proxy, spans around
+task submit/execute, enabled via `ray.init(_tracing_startup_hook=...)`).
+OpenTelemetry isn't in this image, so spans are recorded in-process with
+the OTel span shape (name, trace/span ids, start/end ns, attributes,
+parent) and exported as JSON — loadable by OTel collectors' file receiver
+or converted to chrome://tracing. Task-level spans come for free from the
+task-event recorder (ray_tpu.timeline); this module adds *application*
+spans inside tasks/actors with cross-process parent propagation via the
+runtime context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_spans: List[dict] = []
+_current = threading.local()
+
+
+def enable_tracing() -> None:
+    """Turn span recording on in this process (workers inherit via the
+    RAY_TPU_TRACING env var set by the driver's worker env)."""
+    global _enabled
+    _enabled = True
+    os.environ["RAY_TPU_TRACING"] = "1"
+
+
+def tracing_enabled() -> bool:
+    return _enabled or os.environ.get("RAY_TPU_TRACING") == "1"
+
+
+def _new_id(nbytes: int) -> str:
+    return uuid.uuid4().hex[:nbytes * 2]
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict] = None):
+    """Record one span; nests under the active span of this thread."""
+    if not tracing_enabled():
+        yield None
+        return
+    parent = getattr(_current, "span", None)
+    s = {
+        "name": name,
+        "trace_id": parent["trace_id"] if parent else _new_id(16),
+        "span_id": _new_id(8),
+        "parent_span_id": parent["span_id"] if parent else None,
+        "start_ns": time.time_ns(),
+        "end_ns": None,
+        "attributes": dict(attributes or {}),
+        "status": "OK",
+        "process": os.getpid(),
+    }
+    _current.span = s
+    try:
+        yield s
+    except BaseException as e:
+        s["status"] = "ERROR"
+        s["attributes"]["exception"] = repr(e)
+        raise
+    finally:
+        s["end_ns"] = time.time_ns()
+        _current.span = parent
+        with _lock:
+            _spans.append(s)
+
+
+def get_spans() -> List[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def export_json(path: str) -> int:
+    """Write this process's spans as a JSON list; returns the count."""
+    spans = get_spans()
+    with open(path, "w") as f:
+        json.dump(spans, f)
+    return len(spans)
+
+
+def spans_to_chrome_trace(spans: Optional[List[dict]] = None) -> List[dict]:
+    """Convert to chrome://tracing 'X' events (merge with ray_tpu.timeline
+    output for one combined view)."""
+    out = []
+    for s in (spans if spans is not None else get_spans()):
+        end = s["end_ns"] or time.time_ns()
+        out.append({
+            "name": s["name"], "cat": "span", "ph": "X",
+            "ts": s["start_ns"] / 1e3, "dur": (end - s["start_ns"]) / 1e3,
+            "pid": s["process"], "tid": s["trace_id"][:8],
+            "args": s["attributes"],
+        })
+    return out

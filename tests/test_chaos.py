@@ -1,0 +1,195 @@
+"""Chaos suite: kill real worker processes mid-workload, assert recovery.
+
+Counterpart of the reference's chaos strategy (SURVEY.md §4: 'chaos =
+kill the real process, not a mock' — `NodeKillerActor`
+`_private/test_utils.py:1400`, `test_failure*.py`, release chaos tests):
+a killer thread SIGKILLs random busy workers while a workload runs and
+the assertions are about end-to-end results, not internal state.
+"""
+
+import os
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster(ray_session):
+    return ray_session
+
+
+def _node():
+    return ray_tpu._worker.get_client().node
+
+
+class WorkerKiller(threading.Thread):
+    """Kills up to `max_kills` busy (non-actor) workers at `period`."""
+
+    def __init__(self, period=0.4, max_kills=3, kind="generic"):
+        super().__init__(daemon=True)
+        self.period = period
+        self.max_kills = max_kills
+        self.kind = kind
+        self.kills = 0
+        self._halt = threading.Event()
+
+    def run(self):
+        node = _node()
+        while not self._halt.is_set() and self.kills < self.max_kills:
+            time.sleep(self.period)
+            with node.lock:
+                victims = [w for w in node.workers.values()
+                           if w.alive and w.kind == self.kind
+                           and w.current is not None
+                           and getattr(w.proc, "pid", None)]
+            if not victims:
+                continue
+            w = random.choice(victims)
+            try:
+                os.kill(w.proc.pid, signal.SIGKILL)
+                self.kills += 1
+            except OSError:
+                pass
+
+    def stop(self):
+        self._halt.set()
+
+
+def test_tasks_survive_worker_kills(cluster):
+    """Retryable tasks complete correctly despite SIGKILLed workers."""
+    @ray_tpu.remote(max_retries=4)
+    def chunk_sum(i):
+        time.sleep(0.3)
+        return float(np.full(50_000, i, np.float64).sum())
+
+    killer = WorkerKiller(period=0.35, max_kills=3)
+    killer.start()
+    try:
+        refs = [chunk_sum.remote(i) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=300)
+    finally:
+        killer.stop()
+        killer.join(5)
+    assert out == [float(i * 50_000) for i in range(24)]
+    assert killer.kills > 0, "chaos never fired; test proved nothing"
+
+
+def test_no_retry_task_fails_cleanly_on_kill(cluster):
+    """max_retries=0: a killed worker surfaces WorkerCrashedError, and the
+    cluster stays usable afterwards."""
+    @ray_tpu.remote(max_retries=0)
+    def sitting_duck():
+        time.sleep(30)
+        return 1
+
+    ref = sitting_duck.remote()
+    node = _node()
+    deadline = time.time() + 60
+    pid = None
+    while time.time() < deadline and pid is None:
+        with node.lock:
+            for w in node.workers.values():
+                if (w.alive and w.current is not None
+                        and w.current.spec.task_id is not None
+                        and "sitting_duck" in w.current.spec.function_desc
+                        and getattr(w.proc, "pid", None)):
+                    pid = w.proc.pid
+        time.sleep(0.05)
+    assert pid is not None
+    os.kill(pid, signal.SIGKILL)
+    with pytest.raises(ray_tpu.exceptions.WorkerCrashedError):
+        ray_tpu.get(ref, timeout=60)
+
+    @ray_tpu.remote
+    def ok():
+        return 42
+    assert ray_tpu.get(ok.remote(), timeout=60) == 42
+
+
+def test_actor_restart_under_kill(cluster):
+    """max_restarts actors come back; max_task_retries replays the call."""
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Survivor:
+        def __init__(self):
+            self.calls = 0
+
+        def work(self, x):
+            self.calls += 1
+            time.sleep(0.2)
+            return x * 2
+
+        def pid(self):
+            return os.getpid()
+
+    a = Survivor.remote()
+    assert ray_tpu.get(a.work.remote(1), timeout=60) == 2
+    pid1 = ray_tpu.get(a.pid.remote(), timeout=60)
+    os.kill(pid1, signal.SIGKILL)
+    # next call may replay through the restart
+    assert ray_tpu.get(a.work.remote(21), timeout=120) == 42
+    pid2 = ray_tpu.get(a.pid.remote(), timeout=60)
+    assert pid2 != pid1
+    ray_tpu.kill(a)
+
+
+def test_pipeline_with_dependencies_survives_kills(cluster):
+    """A dependency chain (each stage consumes the previous stage's object)
+    completes under chaos — exercises retry + object re-registration."""
+    @ray_tpu.remote(max_retries=4)
+    def start():
+        time.sleep(0.2)
+        return np.ones(80_000, np.float32)
+
+    @ray_tpu.remote(max_retries=4)
+    def bump(arr):
+        time.sleep(0.2)
+        return arr + 1.0
+
+    killer = WorkerKiller(period=0.3, max_kills=3)
+    killer.start()
+    try:
+        ref = start.remote()
+        for _ in range(6):
+            ref = bump.remote(ref)
+        out = ray_tpu.get(ref, timeout=300)
+    finally:
+        killer.stop()
+        killer.join(5)
+    assert float(out[0]) == 7.0
+
+
+def test_serve_replicas_recover_from_kill(cluster):
+    """Killing a serve replica's process: the controller restarts it and
+    the handle keeps serving."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return ("pid", os.getpid(), x)
+
+    h = serve.run(Echo.bind(), name="chaos_app")
+    try:
+        _, pid, _ = h.call(0)
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 120
+        served_new_pid = False
+        while time.time() < deadline:
+            try:
+                _, p, v = h.call(7, timeout=30)
+            except Exception:
+                time.sleep(0.2)
+                continue
+            if v == 7 and p != pid:
+                served_new_pid = True
+                break
+            time.sleep(0.1)
+        assert served_new_pid, "no healthy replica took over"
+    finally:
+        serve.shutdown()
