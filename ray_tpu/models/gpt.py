@@ -27,6 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh
 
 from ray_tpu.parallel.ring_attention import reference_attention, ring_attention
@@ -129,11 +130,15 @@ def _attention(q, k, v, cfg: GPTConfig, mesh: Mesh | None):
         else:
             impl = "flash"
     if impl == "ring":
-        return ring_attention(q, k, v, mesh, causal=True)
-    if impl == "flash":
+        out = ring_attention(q, k, v, mesh, causal=True)
+    elif impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
-    return reference_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = reference_attention(q, k, v, causal=True)
+    # Named for the remat policy: saving attention outputs means the bwd
+    # pass re-runs only cheap matmuls/norms, never the attention kernel.
+    return checkpoint_name(out, "attn_out")
 
 
 def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
@@ -178,6 +183,11 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
 
     block = partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
+        # Measured on v5e: the default save-nothing policy beats both
+        # save_only_these_names("attn_out") and no remat — the recomputed
+        # forward overlaps with backward HBM traffic, so saving activations
+        # only adds bandwidth. The checkpoint_name tag stays available for
+        # bigger-than-HBM configs to flip the policy.
         block = jax.checkpoint(block)
 
     def scan_body(x, lp):

@@ -102,6 +102,44 @@ def test_flash_attention_grad():
                                rtol=1e-4)
 
 
+def test_flash_attention_all_grads():
+    """dq, dk, dv all flow through the Pallas backward kernels."""
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 128, 2, 16)),
+                           jnp.float32) for _ in range(3))
+
+    def tot(attn):
+        return lambda q, k, v: jnp.sum(attn(q, k, v) ** 2)
+
+    gf = jax.grad(tot(lambda q, k, v: flash_attention(q, k, v, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(tot(lambda q, k, v: reference_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_ragged_seq_stays_on_kernel():
+    """T=384 is not a multiple of the 1024 default block; the planner
+    shrinks blocks to a divisor instead of falling back to XLA."""
+    from ray_tpu.ops.flash_attention import _plan_blocks
+
+    assert _plan_blocks(384, 1024, 1024) == (384, 384)
+    assert _plan_blocks(1536, 1024, 1024) == (768, 768)
+    assert _plan_blocks(1280, 1024, 1024) == (640, 640)
+    assert _plan_blocks(1152, 1024, 1024) == (384, 384)
+    assert _plan_blocks(8191, 1024, 1024) is None   # prime: XLA fallback
+
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 384, 1, 16)),
+                           jnp.float32) for _ in range(3))
+    out = flash_attention(q, k, v, True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_resnet18_forward_and_grad():
     from ray_tpu.models.resnet import resnet18
     model = resnet18(num_classes=10, dtype="float32")
