@@ -51,6 +51,8 @@ class _Lib:
         lib.rts_poisoned.argtypes = [ctypes.c_void_p]
         lib.rts_evict.restype = ctypes.c_uint64
         lib.rts_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rts_release_all.restype = ctypes.c_uint64
+        lib.rts_release_all.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.rts_stats.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_uint64)]
         self.lib = lib
@@ -153,6 +155,12 @@ class Arena:
 
     def evict(self, nbytes: int) -> int:
         return self._lib.rts_evict(self._h, nbytes)
+
+    def release_all(self, pid: int) -> int:
+        """Force-release every pin a (dead) process holds and reclaim its
+        unsealed creations; returns slots touched. The plasma
+        disconnected-client-release analog."""
+        return self._lib.rts_release_all(self._h, pid)
 
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 6)()

@@ -34,14 +34,19 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52545053544f5245ull;  // "RTPSTORE"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint32_t kIdLen = 48;        // "obj_" + 32 hex + NUL fits
 constexpr uint32_t kNumSlots = 1 << 16;
-constexpr uint64_t kAlign = 64;        // cacheline-align payloads
+constexpr uint64_t kAlign = 64;        // block + payload alignment
+constexpr uint32_t kMaxPinners = 8;    // per-object pin-attribution slots
 
 // Block tags. size includes header+footer. Low bit = allocated.
+// Block layout: [head tag (8B) | pad to kAlign | payload | foot tag (8B)];
+// blocks start kAlign-aligned and payloads begin at block+kAlign, so
+// zero-copy numpy views really are cacheline-aligned.
 constexpr uint64_t kAllocBit = 1ull;
 constexpr uint64_t kTagSize = 8;       // one u64 tag at each end
+constexpr uint64_t kPayloadOff = kAlign;  // payload offset within a block
 
 enum SlotState : uint32_t {
   kEmpty = 0,
@@ -54,12 +59,25 @@ enum SlotState : uint32_t {
   kCondemned = 4,
 };
 
+struct PinEntry {
+  uint32_t pid;             // owning process
+  uint32_t count;           // pins held by that process (0 = slot free)
+};
+
 struct IndexSlot {
   uint32_t state;
-  uint32_t refcnt;          // pin count; eviction skips pinned objects
+  uint32_t refcnt;          // total pin count; eviction skips pinned objects
   uint64_t offset;          // payload offset from arena base
   uint64_t size;            // payload size in bytes
   uint64_t tick;            // LRU clock value of last lookup/seal
+  uint32_t creator_pid;     // reclaims unsealed blocks when creator dies
+  uint32_t pad_;
+  // Pins attributed per process so a dead client's pins can be force-
+  // released (rts_release_all) — the counterpart of plasma dropping a
+  // disconnected client's references. Overflow pins (more than kMaxPinners
+  // concurrent pinning processes) stay unattributed in refcnt and are not
+  // reclaimable, matching the old behavior.
+  PinEntry pinners[kMaxPinners];
   char id[kIdLen];
 };
 
@@ -88,6 +106,7 @@ struct Handle {
   uint8_t* base;
   uint64_t map_len;
   ArenaHeader* hdr;
+  uint32_t pid;             // pin attribution identity of this client
 };
 
 inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
@@ -143,6 +162,30 @@ IndexSlot* find_slot(Handle* h, const char* id, bool for_insert) {
   return for_insert ? insert : nullptr;
 }
 
+// -- pin attribution ----------------------------------------------------------
+
+void pin_add(IndexSlot* s, uint32_t pid, uint32_t n) {
+  s->refcnt += n;
+  PinEntry* empty = nullptr;
+  for (uint32_t i = 0; i < kMaxPinners; ++i) {
+    PinEntry* e = &s->pinners[i];
+    if (e->count != 0 && e->pid == pid) { e->count += n; return; }
+    if (e->count == 0 && !empty) empty = e;
+  }
+  if (empty) { empty->pid = pid; empty->count = n; }
+}
+
+void pin_sub(IndexSlot* s, uint32_t pid, uint32_t n) {
+  for (uint32_t i = 0; i < kMaxPinners; ++i) {
+    PinEntry* e = &s->pinners[i];
+    if (e->count != 0 && e->pid == pid) {
+      e->count -= (n < e->count) ? n : e->count;
+      break;
+    }
+  }
+  if (s->refcnt >= n) s->refcnt -= n; else s->refcnt = 0;
+}
+
 void lock(Handle* h) {
   int rc = pthread_mutex_lock(&h->hdr->mutex);
   if (rc == EOWNERDEAD) {
@@ -178,7 +221,7 @@ void freelist_remove(Handle* h, uint64_t off) {
 // offset (arena-relative) or 0 on failure.
 uint64_t alloc_block(Handle* h, uint64_t payload_size) {
   ArenaHeader* hdr = h->hdr;
-  uint64_t need = align_up(payload_size + 2 * kTagSize, kAlign);
+  uint64_t need = align_up(payload_size + kPayloadOff + kTagSize, kAlign);
   // min block must hold tags + next pointer when freed
   if (need < kAlign) need = kAlign;
   uint64_t* cur = &hdr->free_head;
@@ -195,7 +238,7 @@ uint64_t alloc_block(Handle* h, uint64_t payload_size) {
       }
       set_tags(h, off, bsz, true);
       hdr->used += bsz;
-      return off + kTagSize;
+      return off + kPayloadOff;
     }
     cur = next_ptr(h, off);
   }
@@ -204,7 +247,7 @@ uint64_t alloc_block(Handle* h, uint64_t payload_size) {
 
 void free_block(Handle* h, uint64_t payload_off) {
   ArenaHeader* hdr = h->hdr;
-  uint64_t off = payload_off - kTagSize;
+  uint64_t off = payload_off - kPayloadOff;
   uint64_t size = block_size(*tag_at(h, off));
   hdr->used -= size;
   uint64_t data_end = hdr->data_off + hdr->capacity;
@@ -226,6 +269,15 @@ void free_block(Handle* h, uint64_t payload_off) {
   }
   set_tags(h, off, size, false);
   freelist_push(h, off);
+}
+
+// Free a condemned slot once its last pin is gone. Caller holds the lock.
+void maybe_reap_locked(Handle* h, IndexSlot* s) {
+  if (s->state == kCondemned && s->refcnt == 0 && !h->hdr->poisoned) {
+    free_block(h, s->offset);
+    s->state = kTombstone;
+    h->hdr->num_objects--;
+  }
 }
 
 // Evict sealed, unpinned objects in LRU order until at least `goal` bytes
@@ -284,7 +336,8 @@ void* rts_open(const char* path, uint64_t capacity, int create) {
   void* base = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (base == MAP_FAILED) { close(fd); return nullptr; }
   Handle* h = new Handle{fd, static_cast<uint8_t*>(base), map_len,
-                         reinterpret_cast<ArenaHeader*>(base)};
+                         reinterpret_cast<ArenaHeader*>(base),
+                         (uint32_t)getpid()};
   if (init) {
     ArenaHeader* hdr = h->hdr;
     memset(hdr, 0, sizeof(*hdr));
@@ -304,7 +357,7 @@ void* rts_open(const char* path, uint64_t capacity, int create) {
     freelist_push(h, hdr->data_off);
     __sync_synchronize();
     hdr->magic = kMagic;  // published last: openers check magic
-  } else if (h->hdr->magic != kMagic) {
+  } else if (h->hdr->magic != kMagic || h->hdr->version != kVersion) {
     munmap(base, map_len);
     close(fd);
     delete h;
@@ -334,7 +387,8 @@ uint64_t rts_create(void* vh, const char* id, uint64_t size) {
   }
   uint64_t off = alloc_block(h, size);
   if (!off) {
-    uint64_t need = align_up(size + 2 * kTagSize, kAlign);
+    // mirror alloc_block's block-size formula or eviction frees too little
+    uint64_t need = align_up(size + kPayloadOff + kTagSize, kAlign);
     if (evict_locked(h, need) >= need) off = alloc_block(h, size);
     if (!off) { unlock(h); return 0; }
   }
@@ -343,6 +397,8 @@ uint64_t rts_create(void* vh, const char* id, uint64_t size) {
   s->offset = off;
   s->size = size;
   s->tick = ++h->hdr->tick;
+  s->creator_pid = h->pid;
+  memset(s->pinners, 0, sizeof(s->pinners));
   strncpy(s->id, id, kIdLen - 1);
   s->id[kIdLen - 1] = '\0';
   h->hdr->num_objects++;
@@ -427,14 +483,9 @@ int rts_pin(void* vh, const char* id, int delta) {
   int rc = -1;
   if (s && (s->state == kSealed || s->state == kCreated ||
             s->state == kCondemned)) {
-    if (delta > 0) s->refcnt += (uint32_t)delta;
-    else if (s->refcnt >= (uint32_t)(-delta)) s->refcnt -= (uint32_t)(-delta);
-    else s->refcnt = 0;
-    if (s->state == kCondemned && s->refcnt == 0 && !h->hdr->poisoned) {
-      free_block(h, s->offset);
-      s->state = kTombstone;
-      h->hdr->num_objects--;
-    }
+    if (delta > 0) pin_add(s, h->pid, (uint32_t)delta);
+    else pin_sub(s, h->pid, (uint32_t)(-delta));
+    maybe_reap_locked(h, s);
     rc = (int)s->refcnt;
   }
   unlock(h);
@@ -449,13 +500,54 @@ uint64_t rts_acquire(void* vh, const char* id, uint64_t* size) {
   IndexSlot* s = find_slot(h, id, false);
   uint64_t off = 0;
   if (s && s->state == kSealed) {
-    s->refcnt++;
+    pin_add(s, h->pid, 1);
     s->tick = ++h->hdr->tick;
     off = s->offset;
     *size = s->size;
   }
   unlock(h);
   return off;
+}
+
+// Force-release every pin a (dead) process holds and reclaim its unsealed
+// creations. The counterpart of plasma releasing a disconnected client's
+// references: without it, a crashed worker's put-time owner pins and
+// reader pins condemn blocks forever. Returns the number of slots touched.
+uint64_t rts_release_all(void* vh, uint32_t pid) {
+  Handle* h = static_cast<Handle*>(vh);
+  lock(h);
+  IndexSlot* tab = slots(h);
+  uint64_t touched = 0;
+  for (uint32_t i = 0; i < h->hdr->num_slots; ++i) {
+    IndexSlot* s = &tab[i];
+    if (s->state != kSealed && s->state != kCreated &&
+        s->state != kCondemned)
+      continue;
+    for (uint32_t j = 0; j < kMaxPinners; ++j) {
+      PinEntry* e = &s->pinners[j];
+      if (e->count != 0 && e->pid == pid) {
+        uint32_t c = e->count;
+        e->count = 0;
+        s->refcnt = (s->refcnt >= c) ? s->refcnt - c : 0;
+        maybe_reap_locked(h, s);
+        touched++;
+        break;
+      }
+    }
+    if (s->state == kCreated && s->creator_pid == pid && s->refcnt == 0) {
+      // crashed mid-put: the reservation would never be sealed or deleted
+      if (!h->hdr->poisoned) {
+        free_block(h, s->offset);
+        s->state = kTombstone;
+      } else {
+        s->state = kCondemned;
+      }
+      h->hdr->num_objects--;
+      touched++;
+    }
+  }
+  unlock(h);
+  return touched;
 }
 
 uint64_t rts_evict(void* vh, uint64_t nbytes) {

@@ -411,17 +411,22 @@ class NodeServer:
             if method == "job_stop":
                 return jm.stop(payload)
         if method == "ref_update":
+            # Events are applied in their original order: a worker that
+            # releases and then re-holds an oid inside one flush window
+            # must not have the hold applied first (which would net to
+            # holder-removed and free an object with a live ref).
             holder = payload["holder"]
             with self.lock:
-                for oid in payload.get("escape", ()):
-                    self.escaped_refs.add(oid)
-                for oid in payload.get("hold", ()):
-                    self.ref_holders.setdefault(oid, set()).add(holder)
-                for oid in payload.get("release", ()):
-                    holders = self.ref_holders.get(oid)
-                    if holders is not None:
-                        holders.discard(holder)
-                    self._maybe_free_locked(oid)
+                for kind, oid in payload.get("events", ()):
+                    if kind == "escape":
+                        self.escaped_refs.add(oid)
+                    elif kind == "hold":
+                        self.ref_holders.setdefault(oid, set()).add(holder)
+                    else:  # release
+                        holders = self.ref_holders.get(oid)
+                        if holders is not None:
+                            holders.discard(holder)
+                        self._maybe_free_locked(oid)
             return True
         if method == "push_metrics":
             wid, snap = payload
@@ -1155,6 +1160,21 @@ class NodeServer:
             for oid in affected:
                 self.ref_holders[oid].discard(w.worker_id)
                 self._maybe_free_locked(oid)
+            # Reclaim the dead process's shared-arena pins (plasma releases
+            # a disconnected client's references the same way): first adopt
+            # the owner pin of every live object it put — so force-release
+            # can't leave them evictable — then drop everything the pid
+            # still holds (reader pins, condemned pins, unsealed creations).
+            pid = getattr(w.proc, "pid", None)
+            if pid is not None:
+                for oid, origin in list(self.obj_origin.items()):
+                    if origin != w.worker_id:
+                        continue
+                    desc = self.directory.get(oid)
+                    if desc is not None and desc.arena:
+                        self.store.adopt(oid)
+                    self.obj_origin[oid] = "driver"
+                self.store.release_all_pins(pid)
         if actor is not None:
             self._on_actor_worker_death(actor)
         elif t is not None:

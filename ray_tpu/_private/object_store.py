@@ -175,6 +175,28 @@ class ObjectStore:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def adopt(self, object_id: str) -> bool:
+        """Take over the owner pin of an arena object whose origin process
+        died: pin it under THIS process (before the dead process's pins are
+        force-released) and treat it as owned, so the free path releases
+        the adopted pin like any put-time pin."""
+        if self._arena is None:
+            return False
+        with self._lock:
+            if object_id in self._owned:
+                return True
+            if self._arena.pin(object_id, 1) < 0:
+                return False
+            self._owned.add(object_id)
+            return True
+
+    def release_all_pins(self, pid: int) -> int:
+        """Reclaim every arena pin a dead process held (owner pins from
+        put, reader pins from get) plus its unsealed creations."""
+        if self._arena is None:
+            return 0
+        return self._arena.release_all(pid)
+
     def delete(self, desc: Descriptor) -> None:
         if desc.arena:
             if self._arena is not None:

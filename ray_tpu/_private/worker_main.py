@@ -48,10 +48,12 @@ class WorkerRuntime:
         self._current_task_ids = threading.local()
         self.shutdown = False
         # batched refcount events -> driver (hold/release/escape), flushed
-        # by a timer so __del__ storms don't become a message storm
+        # by a timer so __del__ storms don't become a message storm. An
+        # ORDERED (kind, oid) list: bucketing by kind would replay a
+        # release-then-re-hold pair inside one flush window in the wrong
+        # order and free an object with a live ref.
         self._ref_lock = threading.Lock()
-        self._ref_pending: dict[str, list] = {
-            "hold": [], "release": [], "escape": []}
+        self._ref_pending: list[tuple[str, str]] = []
         threading.Thread(target=self._ref_flush_loop,
                          name="ref-flush", daemon=True).start()
 
@@ -146,16 +148,16 @@ class WorkerRuntime:
 
     def enqueue_ref_event(self, kind: str, oid: str) -> None:
         with self._ref_lock:
-            self._ref_pending[kind].append(oid)
+            self._ref_pending.append((kind, oid))
 
     def _flush_ref_events(self) -> None:
         with self._ref_lock:
-            if not any(self._ref_pending.values()):
+            if not self._ref_pending:
                 return
-            batch, self._ref_pending = self._ref_pending, {
-                "hold": [], "release": [], "escape": []}
+            batch, self._ref_pending = self._ref_pending, []
         try:
-            self.control("ref_update", {"holder": self.worker_id, **batch})
+            self.control("ref_update",
+                         {"holder": self.worker_id, "events": batch})
         except Exception:
             pass  # driver gone; session over
 

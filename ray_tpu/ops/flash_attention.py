@@ -296,7 +296,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, sm_scale: float,
 def _pick_block(t: int, pref: int) -> int | None:
     """Largest lane-aligned block <= pref that divides t, so raising the
     preferred block size never silently drops a shape the kernel handled
-    at a smaller block (e.g. T=1536 runs at 512, not the XLA fallback)."""
+    at a smaller block (e.g. T=1536 runs at 768, not the XLA fallback)."""
     if t <= 128:
         return t
     b = min(pref, t) // 128 * 128
@@ -371,6 +371,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv):
                                    with_lse=True)
     if lse is None:
         return out, (q, k, v, None, None)
+    # The residual keeps the kernel's broadcast [BH, T, 128] lse layout.
+    # Slicing to [BH, T] and re-broadcasting in bwd costs ~3% step time
+    # (two extra 64 MB passes per layer at bench shape, measured 55.1k ->
+    # 53.5k tok/s); under the default per-layer remat the residual only
+    # lives within one layer's backward, so the 128x is transient. A
+    # no-remat long-T config that can't afford it should slice here.
     return out, (q, k, v, out, lse)
 
 

@@ -67,17 +67,35 @@ class DeploymentHandle:
 
     def _load(self, actor_id) -> int:
         """In-flight count for one replica: prune completed refs
-        (non-blocking wait) and return how many are still outstanding."""
-        refs = self._outstanding.get(actor_id)
+        (non-blocking wait) and return how many are still outstanding.
+        Pruning filters the live list in place rather than overwriting it,
+        so refs appended by a concurrent _record are never dropped."""
+        with self._lock:
+            refs = list(self._outstanding.get(actor_id, ()))
         if not refs:
             return 0
         try:
-            _, not_ready = ray_tpu.wait(
+            ready, _ = ray_tpu.wait(
                 refs, num_returns=len(refs), timeout=0, fetch_local=False)
         except Exception:
-            not_ready = []
-        self._outstanding[actor_id] = not_ready
-        return len(not_ready)
+            ready = []
+        done = {r._id for r in ready}
+        with self._lock:
+            cur = self._outstanding.get(actor_id)
+            if cur is None:
+                return 0
+            cur[:] = [r for r in cur if r._id not in done]
+            return len(cur)
+
+    def _record(self, actor_id, ref) -> None:
+        """Track an in-flight call so routing sees its load (shared by
+        __call__-style and method calls; mutations hold the lock so a
+        concurrent _refresh prune can't drop updates)."""
+        with self._lock:
+            refs = self._outstanding.setdefault(actor_id, [])
+            refs.append(ref)
+            if len(refs) > self._MAX_TRACKED:
+                del refs[:-self._MAX_TRACKED]
 
     def _pick_replica(self):
         """Power-of-two-choices on client-side in-flight counts
@@ -105,10 +123,7 @@ class DeploymentHandle:
         """-> ObjectRef of the user callable's result."""
         replica = self._pick_replica()
         ref = replica.handle_request.remote(args, kwargs)
-        refs = self._outstanding.setdefault(replica._actor_id, [])
-        refs.append(ref)
-        if len(refs) > self._MAX_TRACKED:
-            del refs[:-self._MAX_TRACKED]
+        self._record(replica._actor_id, ref)
         return ref
 
     def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
@@ -137,7 +152,9 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs):
         replica = self._handle._pick_replica()
-        return replica.handle_method.remote(self._method, args, kwargs)
+        ref = replica.handle_method.remote(self._method, args, kwargs)
+        self._handle._record(replica._actor_id, ref)
+        return ref
 
     def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
         return ray_tpu.get(self.remote(*args, **kwargs), timeout=timeout)

@@ -193,3 +193,57 @@ def test_serve_replicas_recover_from_kill(cluster):
         assert served_new_pid, "no healthy replica took over"
     finally:
         serve.shutdown()
+
+
+def test_dead_worker_arena_pins_reclaimed(cluster):
+    """A SIGKILLed actor's shared-arena pins (put-time owner pins) are
+    force-released; objects still referenced by the driver survive via
+    pin adoption, and dropping the last ref frees the space."""
+    node = _node()
+    store = node.store
+    if store._arena is None:
+        pytest.skip("native arena unavailable")
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            return np.zeros(n, dtype=np.uint8)
+
+        def pid(self):
+            return os.getpid()
+
+    a = Producer.remote()
+    used0 = store._arena.stats()["used"]
+    ref = a.make.remote(2_000_000)          # arena-backed (beyond inline)
+    arr = ray_tpu.get(ref, timeout=60)
+    pid = ray_tpu.get(a.pid.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)
+
+    # wait until the node notices the death and reclaims the dead pid's pins
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with node.lock:
+            dead = not any(
+                w.alive and getattr(w.proc, "pid", None) == pid
+                for w in node.workers.values())
+        if dead:
+            break
+        time.sleep(0.1)
+    assert dead
+
+    # the object survives the producer's death (driver adopted the pin)
+    arr2 = ray_tpu.get(ref, timeout=60)
+    assert arr2.shape == (2_000_000,)
+
+    # dropping every reference frees the arena space even though the
+    # origin worker can never deliver its FreeObject release
+    del arr, arr2, ref
+    import gc
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        gc.collect()
+        ray_tpu._worker._drain_decs()
+        if store._arena.stats()["used"] <= used0:
+            break
+        time.sleep(0.2)
+    assert store._arena.stats()["used"] <= used0

@@ -138,6 +138,55 @@ def test_create_failure_cleanup_path(tmp_path):
     store.close()
 
 
+def test_payload_cacheline_alignment(arena):
+    """Zero-copy numpy views get 64-byte-aligned buffers."""
+    import ctypes
+    for name, size in (("obj_al1", 100), ("obj_al2", 70_000)):
+        buf = arena.create(name, size)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        assert addr % 64 == 0
+        arena.seal(name)
+
+
+def _crash_child_pins(session_dir):
+    """Simulate a worker that pins objects then dies without releasing:
+    owner pin on its own put, reader pin on another object, plus an
+    unsealed create (crash mid-put)."""
+    a = Arena.open(session_dir)
+    a.create("obj_mine", 50_000)
+    a.pin("obj_mine", 1)         # put-time owner pin
+    a.seal("obj_mine")
+    a.acquire("obj_theirs")      # reader pin
+    a.create("obj_unsealed", 50_000)   # crash before seal
+    os._exit(1)                  # no cleanup — hard crash
+
+
+def test_release_all_reclaims_dead_process_pins(tmp_path):
+    """A crashed client's pins are force-released (plasma disconnected-
+    client analog): condemned blocks free, unsealed creations reclaim."""
+    a = Arena.open(str(tmp_path), capacity=4 * 1024 * 1024)
+    if a is None:
+        pytest.skip("native toolchain unavailable")
+    used0 = a.stats()["used"]
+    buf = a.create("obj_theirs", 50_000)
+    a.seal("obj_theirs")
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_crash_child_pins, args=(str(tmp_path),))
+    p.start()
+    p.join(60)                   # child pins, then hard-exits
+    child_pid = p.pid
+
+    # Without reclamation both deletes would condemn forever.
+    a.delete("obj_theirs")       # child reader pin -> condemned
+    a.delete("obj_mine")         # child owner pin -> condemned
+    assert a.stats()["used"] > used0
+    touched = a.release_all(child_pid)
+    assert touched >= 3          # reader pin + owner pin + unsealed create
+    assert a.stats()["used"] == used0
+    a.close()
+
+
 def _xproc_child(session_dir, q):
     a = Arena.open(session_dir)
     v = a.lookup("obj_shared")
