@@ -1,0 +1,579 @@
+"""Per-host daemon: local worker pool, object store, and pull server.
+
+Counterpart of the reference's raylet (`src/ray/raylet/node_manager.h:117`
+NodeManager + worker_pool.h:80 WorkerPool) plus the node-to-node object
+manager (`src/ray/object_manager/object_manager.h:117`), with scheduling
+deliberately left at the head: the head's cluster scheduler assigns a task
+to a node and sends a `LeaseTask`; this daemon only localizes dependencies
+(pulling from peer nodes or the head), runs the task on a local worker, and
+reports the sealed results back. That matches the reference's
+GCS-scheduling mode (gcs_actor_scheduler.h:349 ScheduleByGcs) rather than
+its raylet-autonomy mode — the right trade for TPU pods, where gang
+placement decisions need the global view anyway.
+
+Data plane: objects live in this node's own shm arena (store.cc); remote
+reads are chunked pulls over UNIX sockets (object_manager.h:130,139
+HandlePush/HandlePull). Workers on this host connect to this daemon's
+listener and share its arena zero-copy, exactly like workers on the head.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from multiprocessing import connection
+
+from ray_tpu._private import constants, ids, protocol, spawn
+from ray_tpu._private.object_store import Descriptor, ObjectStore
+from ray_tpu._private.pull_plane import PullClient, serve_pull
+from ray_tpu.exceptions import ObjectLostError
+
+logger = logging.getLogger("ray_tpu.daemon")
+
+
+@dataclass
+class _DWorker:
+    worker_id: str
+    conn: connection.Connection | None = None
+    proc: object = None
+    kind: str = "generic"            # generic | tpu | actor
+    idle: bool = False
+    alive: bool = False
+    actor_id: str | None = None
+    known_functions: set = field(default_factory=set)
+    inflight: dict = field(default_factory=dict)   # task_id -> TaskSpec
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def send(self, msg) -> bool:
+        with self.send_lock:
+            if self.conn is None:
+                return False
+            try:
+                self.conn.send(msg)
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
+
+
+class HostDaemon:
+    def __init__(self, head_address: str, node_id: str, resources: dict,
+                 num_tpu_chips: int):
+        self.node_id = node_id
+        self.authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+        session_dir = os.path.dirname(head_address)
+        self.node_dir = os.path.join(session_dir, "nodes", node_id)
+        os.makedirs(self.node_dir, exist_ok=True)
+        self.store = ObjectStore(self.node_dir)
+        self.address = os.path.join(self.node_dir, "node.sock")
+
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        self.workers: dict[str, _DWorker] = {}
+        self.actors: dict[str, _DWorker] = {}
+        self._objs: dict[str, Descriptor] = {}     # sealed in OUR store
+        self._origin: dict[str, str] = {}          # oid -> worker_id
+        self._copies: dict[str, Descriptor] = {}   # pulled remote objects
+        self._pulling: set = set()                 # oids with pull in flight
+        self.peer_addrs: dict[str, str] = {}
+        self._peers: dict[str, tuple] = {}         # node -> (conn, lock)
+        self._req = itertools.count(1)
+        self._pull_client = PullClient()
+        # head_req_id -> (kind, worker, worker_req_id, task_id)
+        self._proxy: dict[int, tuple] = {}
+        self._shutdown = False
+
+        self._listener = connection.Listener(
+            family="AF_UNIX", address=self.address, authkey=self.authkey)
+        self._head = connection.Client(head_address, family="AF_UNIX",
+                                       authkey=self.authkey)
+        self._head_lock = threading.Lock()
+        self._head_send(protocol.RegisterNode(
+            node_id=node_id, pid=os.getpid(), resources=resources,
+            num_tpu_chips=num_tpu_chips, address=self.address))
+
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="daemon-accept").start()
+
+    # ------------------------------------------------------------------
+    # channels
+    # ------------------------------------------------------------------
+
+    def _head_send(self, msg) -> None:
+        with self._head_lock:
+            try:
+                self._head.send(msg)
+            except (OSError, ValueError, BrokenPipeError):
+                pass
+
+    def head_loop(self):
+        """Main thread: serve the head channel until it closes."""
+        while not self._shutdown:
+            try:
+                msg = self._head.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle_head(msg)
+            except Exception:
+                logger.exception("error handling %r from head", type(msg))
+        self._die()
+
+    def _handle_head(self, msg):
+        if isinstance(msg, protocol.LeaseTask):
+            threading.Thread(target=self._run_lease, args=(msg,),
+                             daemon=True).start()
+        elif isinstance(msg, protocol.PullRequest):
+            threading.Thread(
+                target=self._serve_pull,
+                args=(self._head_send, msg), daemon=True).start()
+        elif isinstance(msg, protocol.PullChunk):
+            self._pull_client.on_chunk(msg)
+        elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
+                              protocol.SubmitReply, protocol.ActorCallReply)):
+            self._route_reply(msg)
+        elif isinstance(msg, protocol.FreeObjectNode):
+            self._free_local(msg.object_id)
+        elif isinstance(msg, protocol.KillActorOnNode):
+            with self.lock:
+                w = self.actors.get(msg.actor_id)
+            if w is not None and w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        elif isinstance(msg, (protocol.KillNode, protocol.KillWorker)):
+            self._die()
+        else:
+            logger.warning("unknown head message %r", type(msg))
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if self._shutdown:
+                    return
+                time.sleep(0.05)
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            reg = conn.recv()
+        except (EOFError, OSError):
+            return
+        if isinstance(reg, protocol.RegisterWorker):
+            with self.lock:
+                w = self.workers.get(reg.worker_id)
+                if w is None:
+                    w = _DWorker(reg.worker_id, conn)
+                    self.workers[reg.worker_id] = w
+                else:
+                    w.conn = conn
+                w.alive = True
+                w.pid = reg.pid
+                self.cv.notify_all()
+            self._worker_loop(w)
+        elif isinstance(reg, protocol.RegisterPeer):
+            send_lock = threading.Lock()
+
+            def psend(msg, _c=conn, _l=send_lock):
+                with _l:
+                    try:
+                        _c.send(msg)
+                    except (OSError, ValueError, BrokenPipeError):
+                        pass
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if isinstance(msg, protocol.PullRequest):
+                    threading.Thread(target=self._serve_pull,
+                                     args=(psend, msg), daemon=True).start()
+        else:
+            conn.close()
+
+    # ------------------------------------------------------------------
+    # worker-facing protocol (same surface the head offers its workers)
+    # ------------------------------------------------------------------
+
+    def _worker_loop(self, w: _DWorker):
+        while True:
+            try:
+                msg = w.conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_death(w)
+                return
+            try:
+                self._handle_worker(w, msg)
+            except Exception:
+                logger.exception("error handling %r from %s", type(msg),
+                                 w.worker_id)
+
+    def _handle_worker(self, w: _DWorker, msg):
+        if isinstance(msg, protocol.TaskDone):
+            self._on_task_done(w, msg)
+        elif isinstance(msg, protocol.PutRequest):
+            with self.lock:
+                if msg.desc.inline is None:
+                    self._objs[msg.object_id] = msg.desc
+                    self._origin[msg.object_id] = w.worker_id
+            self._head_send(protocol.PutRequest(
+                msg.object_id, self._tag(msg.desc), origin=w.worker_id))
+        elif isinstance(msg, protocol.GetRequest):
+            task_id = next(iter(w.inflight), None)
+            hreq = next(self._req)
+            with self.lock:
+                self._proxy[hreq] = ("get", w, msg.req_id, task_id)
+            if task_id is not None:
+                self._head_send(protocol.NodeWorkerBlocked(task_id, True))
+            self._head_send(protocol.GetRequest(
+                hreq, msg.object_ids, msg.timeout))
+        elif isinstance(msg, (protocol.WaitRequest, protocol.SubmitRequest,
+                              protocol.ActorCallRequest)):
+            hreq = next(self._req)
+            with self.lock:
+                self._proxy[hreq] = ("fwd", w, msg.req_id, None)
+            if isinstance(msg, protocol.SubmitRequest):
+                # identify the real submitter so the head keys the implicit
+                # holds on its fresh return refs by the right worker id
+                fwd = replace(msg, req_id=hreq, submitter=w.worker_id)
+            else:
+                fwd = replace(msg, req_id=hreq)
+            self._head_send(fwd)
+        else:
+            logger.warning("unknown worker message %r", type(msg))
+
+    def _route_reply(self, msg):
+        with self.lock:
+            entry = self._proxy.pop(msg.req_id, None)
+        if entry is None:
+            return
+        kind, w, wreq, task_id = entry
+        if kind == "get":
+            def _finish():
+                if msg.timed_out or msg.error is not None:
+                    reply = protocol.GetReply(wreq, {}, msg.timed_out,
+                                              msg.error)
+                else:
+                    try:
+                        locs = {oid: self._ensure_local(d)
+                                for oid, d in msg.locations.items()}
+                        reply = protocol.GetReply(wreq, locs)
+                    except (ObjectLostError, OSError) as e:
+                        # OSError: a peer daemon died mid-pull (connect or
+                        # stream failure) — must still answer the worker
+                        reply = protocol.GetReply(
+                            wreq, {}, error=f"ObjectLostError: {e}")
+                if task_id is not None:
+                    self._head_send(
+                        protocol.NodeWorkerBlocked(task_id, False))
+                w.send(reply)
+            threading.Thread(target=_finish, daemon=True).start()
+        else:
+            w.send(replace(msg, req_id=wreq))
+
+    # ------------------------------------------------------------------
+    # task execution
+    # ------------------------------------------------------------------
+
+    def _tag(self, desc: Descriptor) -> Descriptor:
+        if desc.inline is not None:
+            return desc
+        return replace(desc, node=self.node_id)
+
+    def _run_lease(self, lease: protocol.LeaseTask):
+        spec = lease.spec
+        with self.lock:
+            self.peer_addrs.update(lease.peer_addrs)
+        try:
+            arg_locs = {oid: self._ensure_local(d)
+                        for oid, d in lease.arg_locations.items()}
+        except (ObjectLostError, OSError) as e:
+            self._head_send(protocol.NodeTaskFailed(
+                spec.task_id, f"dependency pull failed: {e}"))
+            return
+        if spec.actor_id is not None and not spec.actor_creation:
+            with self.cv:
+                deadline = time.monotonic() + 30.0
+                w = self.actors.get(spec.actor_id)
+                while w is None or not w.alive:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0 or self._shutdown:
+                        self._head_send(protocol.NodeTaskFailed(
+                            spec.task_id, "actor worker not on this node"))
+                        return
+                    self.cv.wait(min(rem, 0.2))
+                    w = self.actors.get(spec.actor_id)
+        elif spec.actor_creation:
+            w = self._spawn_worker("actor", lease.tpu_chips,
+                                   spec.runtime_env)
+            if w is None:
+                self._head_send(protocol.NodeTaskFailed(
+                    spec.task_id, "actor worker failed to start"))
+                return
+            w.actor_id = spec.actor_id
+            with self.cv:
+                self.actors[spec.actor_id] = w
+                self.cv.notify_all()
+        elif spec.resources.get("TPU", 0) > 0:
+            w = self._spawn_worker("tpu", lease.tpu_chips, spec.runtime_env)
+            if w is None:
+                self._head_send(protocol.NodeTaskFailed(
+                    spec.task_id, "TPU worker failed to start"))
+                return
+        else:
+            with self.lock:
+                w = next((x for x in self.workers.values()
+                          if x.kind == "generic" and x.idle and x.alive),
+                         None)
+                if w is not None:
+                    w.idle = False
+            if w is None:
+                w = self._spawn_worker("generic", None, None)
+                if w is None:
+                    self._head_send(protocol.NodeTaskFailed(
+                        spec.task_id, "worker failed to start"))
+                    return
+        with self.lock:
+            w.inflight[spec.task_id] = spec
+            if spec.function_id in w.known_functions:
+                spec = protocol.TaskSpec(
+                    **{**spec.__dict__, "function_blob": None})
+            else:
+                w.known_functions.add(spec.function_id)
+        w.send(protocol.PushTask(spec=spec, arg_locations=arg_locs))
+
+    def _spawn_worker(self, kind, chips, runtime_env):
+        wid = ids.new_worker_id()
+        w = _DWorker(wid, kind=kind)
+        with self.lock:
+            self.workers[wid] = w
+        env = spawn.worker_env(chips=chips or None, runtime_env=runtime_env)
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        w.proc = spawn.spawn_worker_proc(self.address, self.authkey, wid, env)
+        deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
+        with self.cv:
+            while not w.alive:
+                rem = deadline - time.monotonic()
+                if rem <= 0 or self._shutdown:
+                    self.workers.pop(wid, None)
+                    return None
+                if w.proc.poll() is not None:
+                    self.workers.pop(wid, None)
+                    return None
+                self.cv.wait(min(rem, 0.2))
+        return w
+
+    def _on_task_done(self, w: _DWorker, msg: protocol.TaskDone):
+        retire = None
+        with self.lock:
+            spec = w.inflight.pop(msg.task_id, None)
+            if spec is None:
+                logger.warning("TaskDone for unknown task %s", msg.task_id)
+                return
+            tagged = []
+            for oid, desc in zip(spec.return_ids, msg.return_descs):
+                if desc.inline is None:
+                    self._objs[oid] = desc
+                    self._origin[oid] = w.worker_id
+                tagged.append(self._tag(desc))
+            if w.kind == "tpu":
+                retire = w
+            elif w.kind == "generic":
+                w.idle = True
+        self._head_send(protocol.NodeTaskDone(
+            task_id=msg.task_id, return_descs=tagged, error=msg.error,
+            actor_ready=msg.actor_ready))
+        if retire is not None:
+            retire.send(protocol.KillWorker())
+            with self.lock:
+                self.workers.pop(retire.worker_id, None)
+
+    def _on_worker_death(self, w: _DWorker):
+        with self.lock:
+            if not w.alive and not w.inflight:
+                self.workers.pop(w.worker_id, None)
+                return
+            w.alive = False
+            w.idle = False
+            self.workers.pop(w.worker_id, None)
+            inflight, w.inflight = w.inflight, {}
+            actor_id = w.actor_id
+            if actor_id is not None:
+                self.actors.pop(actor_id, None)
+            # Reclaim the dead process's arena pins; adopt the owner pin of
+            # every live object it put first (same order as the head,
+            # node.py _on_worker_death).
+            pid = getattr(w.proc, "pid", None)
+            if pid is not None:
+                for oid, origin in list(self._origin.items()):
+                    if origin != w.worker_id:
+                        continue
+                    desc = self._objs.get(oid)
+                    if desc is not None and desc.arena:
+                        self.store.adopt(oid)
+                    self._origin[oid] = "daemon"
+                self.store.release_all_pins(pid)
+        self._head_send(protocol.NodeWorkerGone(w.worker_id))
+        if actor_id is not None:
+            self._head_send(protocol.NodeActorDied(
+                actor_id, "worker process died"))
+        else:
+            for tid in inflight:
+                self._head_send(protocol.NodeTaskFailed(
+                    tid, "worker died while running task"))
+
+    # ------------------------------------------------------------------
+    # object data plane
+    # ------------------------------------------------------------------
+
+    def _ensure_local(self, desc: Descriptor) -> Descriptor:
+        if desc.inline is not None or desc.node == self.node_id:
+            return desc
+        oid = desc.object_id
+        with self.cv:
+            while True:
+                c = self._copies.get(oid)
+                if c is not None:
+                    return c
+                if oid not in self._pulling:
+                    self._pulling.add(oid)
+                    break
+                self.cv.wait(0.2)
+        try:
+            payload = self._pull(desc.node, oid)
+            local = self.store.put_serialized(oid, payload)
+            # publish BEFORE dropping the _pulling claim, or a waiter can
+            # wake to no-copy/no-claim and start a duplicate pull
+            with self.lock:
+                self._copies[oid] = local
+        finally:
+            with self.cv:
+                self._pulling.discard(oid)
+                self.cv.notify_all()
+        self._head_send(protocol.ObjectCopyNote(oid, self.node_id))
+        return local
+
+    def _peer_send(self, node_id: str):
+        with self.lock:
+            entry = self._peers.get(node_id)
+            addr = self.peer_addrs.get(node_id)
+        if entry is not None:
+            return entry[0]
+        if addr is None:
+            raise ObjectLostError(f"no address for node {node_id}")
+        conn = connection.Client(addr, family="AF_UNIX",
+                                 authkey=self.authkey)
+        lock = threading.Lock()
+
+        def send(msg, _c=conn, _l=lock):
+            with _l:
+                try:
+                    _c.send(msg)
+                except (OSError, ValueError, BrokenPipeError):
+                    pass
+        send(protocol.RegisterPeer(self.node_id))
+
+        def reader(_c=conn):
+            while True:
+                try:
+                    msg = _c.recv()
+                except (EOFError, OSError):
+                    return
+                if isinstance(msg, protocol.PullChunk):
+                    self._pull_client.on_chunk(msg)
+        threading.Thread(target=reader, daemon=True,
+                         name=f"peer-{node_id}").start()
+        with self.lock:
+            self._peers[node_id] = (send, conn)
+        return send
+
+    def _pull(self, source_node: str | None, oid: str) -> bytes:
+        if source_node is None:
+            send = self._head_send
+        else:
+            send = self._peer_send(source_node)
+        return self._pull_client.pull(send, oid)
+
+    def _serve_pull(self, send, msg: protocol.PullRequest):
+        with self.lock:
+            desc = self._objs.get(msg.object_id) or \
+                self._copies.get(msg.object_id)
+        if desc is None:
+            serve_pull(send, msg, None)
+            return
+        try:
+            payload = self.store.raw_bytes(desc)
+        except (ObjectLostError, OSError) as e:
+            payload = e
+        serve_pull(send, msg, payload)
+
+    def _free_local(self, oid: str):
+        with self.lock:
+            desc = self._objs.pop(oid, None)
+            copy = self._copies.pop(oid, None)
+            origin = self._origin.pop(oid, None)
+        for d in (desc, copy):
+            if d is not None:
+                try:
+                    self.store.delete(d)
+                except Exception:
+                    pass
+        if origin is not None and origin != "daemon" and desc is not None:
+            with self.lock:
+                w = self.workers.get(origin)
+            if w is not None and w.alive:
+                w.send(protocol.FreeObject(oid, desc))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def _die(self):
+        with self.lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            workers = list(self.workers.values())
+        for w in workers:
+            w.send(protocol.KillWorker())
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            if w.proc is None:
+                continue
+            try:
+                while w.proc.poll() is None and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                if w.proc.poll() is None:
+                    w.proc.kill()
+            except OSError:
+                pass
+        self.store.close()
+        os._exit(0)
+
+
+def main():
+    head_address = sys.argv[1]
+    node_id = sys.argv[2]
+    resources = json.loads(sys.argv[3])
+    num_tpus = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    logging.basicConfig(level=logging.INFO)
+    daemon = HostDaemon(head_address, node_id, resources, num_tpus)
+    daemon.head_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,23 @@
-"""Driver-side node server: scheduler, object directory, worker pool.
+"""Head node server: cluster store, cluster scheduler, object directory,
+and the head host's own worker pool.
 
-This process plays the role of three reference components at once, collapsed
-because a TPU host is one failure/scheduling domain:
+The head process plays the reference's GCS (gcs_server.h:78: named actors,
+KV, job table, node membership, placement groups) plus the head host's
+raylet (node_manager.h:117: worker leasing, dependency management, local
+dispatch) plus the ownership-based object directory
+(reference_count.h:61 + ownership_based_object_directory.h).
 
-- the raylet's NodeManager + ClusterTaskManager (worker leasing, dependency
-  management, dispatch — src/ray/raylet/node_manager.h:117,
-  scheduling/cluster_task_manager.h),
-- the GCS tables it needs locally (named actors, KV, job info —
-  src/ray/gcs/gcs_server/gcs_server.h:78), and
-- the ownership-based object directory (which object lives where —
-  src/ray/core_worker/reference_count.h:61 + ownership_based_object_directory.h).
+Additional hosts run a `HostDaemon` each (`daemon.py` — the raylet
+equivalent owning that host's object store and worker pool). The head's
+cluster scheduler (`_pick_node`: affinity → SPREAD → locality → pack, the
+hybrid_scheduling_policy.h:50 counterpart) assigns tasks to nodes and
+leases them over the node channel; object bytes move node-to-node through
+chunked pulls (object_manager.h:130,139). `cluster_utils.Cluster` spins up
+N daemons on one machine with fake resources — the reference's
+one-host multi-raylet test fixture (python/ray/cluster_utils.py:99).
 
 Worker processes connect over a UNIX socket; the message set is
-`protocol.py`. The design keeps every interface process-shaped (submit /
-register_object / lease) so a multi-host deployment can split this class back
-into per-host daemons + a cluster store without changing callers — that split
-is the round-2+ path to the reference's 2000-node envelope (BASELINE.md).
+`protocol.py`.
 """
 
 from __future__ import annotations
@@ -30,7 +32,7 @@ import sys
 import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from multiprocessing import connection
 
 from ray_tpu._private import constants, ids, protocol
@@ -39,7 +41,10 @@ from ray_tpu._private.serialization import dumps
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectFreedError,
+    ObjectLostError,
     PlacementGroupError,
+    SchedulingError,
     TaskCancelledError,
     WorkerCrashedError,
 )
@@ -71,6 +76,10 @@ class _TaskState:
     retries_left: int = 0
     retry_exceptions: bool = False
     cancelled: bool = False
+    node: str | None = None                  # node leased to (None = head)
+    node_released: bool = False              # resources released (blocked)
+    tpu_chips: list = field(default_factory=list)
+    localizing: bool = False                 # remote-arg pull in flight
 
 
 @dataclass
@@ -117,6 +126,7 @@ class _ActorState:
     tpu_chips: list = field(default_factory=list)
     method_meta: dict = field(default_factory=dict)  # for get_actor handles
     pending_restart: bool = False
+    node: str | None = None      # node hosting the actor (None = head)
 
 
 @dataclass
@@ -125,10 +135,44 @@ class _PlacementGroup:
     bundles: list            # list[dict]
     strategy: str
     available: list = None   # per-bundle remaining resources
+    bundle_nodes: list = None  # per-bundle node id (None = head)
 
     def __post_init__(self):
         if self.available is None:
             self.available = [dict(b) for b in self.bundles]
+        if self.bundle_nodes is None:
+            self.bundle_nodes = [None] * len(self.bundles)
+
+
+@dataclass
+class _RemoteNode:
+    """Head-side record of a registered HostDaemon (the GCS's view of one
+    raylet: gcs_node_manager + per-node resource bookkeeping)."""
+    node_id: str
+    conn: connection.Connection
+    address: str                              # daemon listener (peer pulls)
+    pid: int = 0
+    proc: object = None                       # Popen if the head spawned it
+    total: dict = field(default_factory=dict)
+    available: dict = field(default_factory=dict)
+    free_tpu_chips: list = field(default_factory=list)
+    alive: bool = True
+    inflight: dict = field(default_factory=dict)  # task_id -> _TaskState
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    # duck-typing so the shared get/wait request handlers accept a node
+    # channel in place of a _WorkerConn
+    kind: str = "node"
+    worker_id: str = ""
+    current: object = None
+    released: dict = field(default_factory=dict)
+
+    def send(self, msg) -> bool:
+        with self.send_lock:
+            try:
+                self.conn.send(msg)
+                return True
+            except (OSError, ValueError, BrokenPipeError):
+                return False
 
 
 class NodeServer:
@@ -171,6 +215,20 @@ class NodeServer:
         self.named_actors: dict[str, str] = {}
         self.placement_groups: dict[str, _PlacementGroup] = {}
         self.kv: dict[tuple, bytes] = {}
+
+        # Multi-node state (the GCS side of the split, gcs_server.h:78):
+        # registered HostDaemons, head-local cached copies of remote
+        # objects, which nodes cached copies of what (for promotion on
+        # owner-node death, object_recovery_manager.h:41), and objects
+        # whose every copy died with a node.
+        self.nodes: dict[str, _RemoteNode] = {}
+        self.local_copies: dict[str, Descriptor] = {}
+        self.copy_nodes: dict[str, set] = {}      # oid -> node ids w/ copy
+        self.lost_objects: dict[str, str] = {}    # oid -> cause
+        self._spread_rr = 0
+        from ray_tpu._private.pull_plane import PullClient
+        self._pull_client = PullClient()
+        self._head_pulling: set = set()       # oids being pulled to head
 
         self._task_errors: dict[str, str] = {}
         # Observability: task lifecycle records (reference: TaskEventBuffer →
@@ -227,6 +285,9 @@ class NodeServer:
         try:
             reg = conn.recv()
         except (EOFError, OSError):
+            return
+        if isinstance(reg, protocol.RegisterNode):
+            self._serve_node_conn(conn, reg)
             return
         if not isinstance(reg, protocol.RegisterWorker):
             conn.close()
@@ -295,6 +356,91 @@ class NodeServer:
             logger.warning("unknown message %r", type(msg))
 
     # ------------------------------------------------------------------
+    # node channels (head <-> HostDaemon; the GCS side of the split)
+    # ------------------------------------------------------------------
+
+    def _serve_node_conn(self, conn, reg: protocol.RegisterNode):
+        node = _RemoteNode(
+            node_id=reg.node_id, conn=conn, address=reg.address,
+            pid=reg.pid, total=dict(reg.resources),
+            available=dict(reg.resources),
+            free_tpu_chips=list(range(reg.num_tpu_chips)),
+            worker_id="node:" + reg.node_id)
+        with self.lock:
+            self.nodes[reg.node_id] = node
+            self.cv.notify_all()
+        logger.info("node %s registered: %s", reg.node_id, reg.resources)
+        self._schedule()
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                self._on_node_death(node)
+                return
+            try:
+                self._handle_node(node, msg)
+            except Exception:
+                logger.exception("error handling %r from node %s",
+                                 type(msg), reg.node_id)
+
+    def _handle_node(self, node: _RemoteNode, msg):
+        if isinstance(msg, protocol.NodeTaskDone):
+            self._on_node_task_done(node, msg)
+        elif isinstance(msg, protocol.NodeTaskFailed):
+            self._on_node_task_failed(node, msg)
+        elif isinstance(msg, protocol.NodeActorDied):
+            self._on_node_actor_died(node, msg)
+        elif isinstance(msg, protocol.NodeWorkerBlocked):
+            self._on_node_worker_blocked(node, msg)
+        elif isinstance(msg, protocol.NodeWorkerGone):
+            self._drop_ref_holder(msg.worker_id)
+        elif isinstance(msg, protocol.ObjectCopyNote):
+            with self.lock:
+                if msg.object_id in self.directory:
+                    self.copy_nodes.setdefault(
+                        msg.object_id, set()).add(msg.node_id)
+        elif isinstance(msg, protocol.PullRequest):
+            threading.Thread(target=self._serve_pull, args=(node, msg),
+                             daemon=True).start()
+        elif isinstance(msg, protocol.PullChunk):
+            self._pull_client.on_chunk(msg)
+        elif isinstance(msg, protocol.PutRequest):
+            if msg.origin:
+                self.ref_hold(msg.object_id, msg.origin)
+            self.register_object(msg.object_id, msg.desc,
+                                 origin="node:" + node.node_id)
+        elif isinstance(msg, protocol.GetRequest):
+            threading.Thread(target=self._serve_get, args=(node, msg),
+                             daemon=True).start()
+        elif isinstance(msg, protocol.WaitRequest):
+            threading.Thread(target=self._serve_wait, args=(node, msg),
+                             daemon=True).start()
+        elif isinstance(msg, protocol.SubmitRequest):
+            try:
+                self.submit(msg.spec,
+                            submitter=msg.submitter or node.worker_id)
+                node.send(protocol.SubmitReply(msg.req_id, ok=True))
+            except Exception as e:
+                node.send(protocol.SubmitReply(msg.req_id, ok=False,
+                                               error=repr(e)))
+        elif isinstance(msg, protocol.ActorCallRequest):
+            try:
+                result = self._control(msg.method, msg.payload, node)
+                node.send(protocol.ActorCallReply(msg.req_id, result=result))
+            except Exception as e:
+                node.send(protocol.ActorCallReply(msg.req_id, error=repr(e)))
+        else:
+            logger.warning("unknown node message %r", type(msg))
+
+    def _drop_ref_holder(self, holder: str) -> None:
+        with self.lock:
+            affected = [oid for oid, holders in self.ref_holders.items()
+                        if holder in holders]
+            for oid in affected:
+                self.ref_holders[oid].discard(holder)
+                self._maybe_free_locked(oid)
+
+    # ------------------------------------------------------------------
     # control-plane RPCs (named actors, KV, kill, ...)
     # ------------------------------------------------------------------
 
@@ -324,10 +470,25 @@ class NodeServer:
                         and k.startswith(prefix)]
         if method == "cluster_resources":
             with self.lock:
-                return dict(self.total_resources)
+                out = dict(self.total_resources)
+                for n in self.nodes.values():
+                    if n.alive:
+                        _add(out, n.total)
+                return out
         if method == "available_resources":
             with self.lock:
-                return dict(self.available)
+                out = dict(self.available)
+                for n in self.nodes.values():
+                    if n.alive:
+                        _add(out, n.available)
+                return out
+        if method == "add_node":
+            p = payload or {}
+            return self.add_node(p.get("resources"),
+                                 int(p.get("num_tpus", 0)))
+        if method == "kill_node":
+            p = payload or {}
+            return self.kill_node(p["node_id"], force=p.get("force", True))
         if method == "create_pg":
             return self.create_placement_group(**payload)
         if method == "remove_pg":
@@ -389,12 +550,19 @@ class NodeServer:
                     (payload or {}).get("limit", 10_000))]
         if method == "list_nodes":
             with self.lock:
-                return [{
-                    "node_id": self.node_id, "alive": True,
+                out = [{
+                    "node_id": self.node_id, "alive": True, "head": True,
                     "resources_total": dict(self.total_resources),
                     "resources_available": dict(self.available),
                     "session_dir": self.session_dir,
                 }]
+                out += [{
+                    "node_id": n.node_id, "alive": n.alive, "head": False,
+                    "resources_total": dict(n.total),
+                    "resources_available": dict(n.available),
+                    "inflight_tasks": len(n.inflight),
+                } for n in self.nodes.values()]
+                return out
         if method.startswith("job_"):
             jm = self._job_manager()
             if method == "job_submit":
@@ -525,12 +693,28 @@ class NodeServer:
         while len(self.freed_refs) > 100_000:
             self.freed_refs.popitem(last=False)
         origin = self.obj_origin.pop(oid, "driver")
-        self.store.delete(desc)
-        if origin != "driver":
-            w = self.workers.get(origin)
-            if w is not None and w.alive:
-                # origin worker still holds the put-time owner pin
-                w.send(protocol.FreeObject(oid, desc))
+        # head-local cached copy of a remote object
+        lc = self.local_copies.pop(oid, None)
+        if lc is not None:
+            self.store.delete(lc)
+        copies = self.copy_nodes.pop(oid, ())
+        if desc.node is None:
+            self.store.delete(desc)
+            if origin != "driver" and not origin.startswith("node:"):
+                w = self.workers.get(origin)
+                if w is not None and w.alive:
+                    # origin worker still holds the put-time owner pin
+                    w.send(protocol.FreeObject(oid, desc))
+        else:
+            node = self.nodes.get(desc.node)
+            if node is not None and node.alive:
+                node.send(protocol.FreeObjectNode(oid))
+        for nid in copies:
+            if nid == desc.node:
+                continue
+            n2 = self.nodes.get(nid)
+            if n2 is not None and n2.alive:
+                n2.send(protocol.FreeObjectNode(oid))
         self.cv.notify_all()   # wake racing gets so they fail fast
 
     def _register_locked(self, object_id: str, desc: Descriptor,
@@ -540,6 +724,7 @@ class NodeServer:
         Caller holds the lock; returns True if tasks were unblocked."""
         self.directory[object_id] = desc
         self.obj_origin[object_id] = origin
+        self.lost_objects.pop(object_id, None)
         if object_id in self.dead_pending:
             self.dead_pending.discard(object_id)
             self._maybe_free_locked(object_id)
@@ -562,20 +747,27 @@ class NodeServer:
         self.register_object(oid, desc)
         return oid
 
-    def get_locations(self, object_ids, timeout=None) -> dict:
-        """Block until every id has a descriptor; driver-side fast path."""
+    def get_locations(self, object_ids, timeout=None, localize=True) -> dict:
+        """Block until every id has a descriptor. With `localize` (the
+        default), remote descriptors are pulled into the head's store first
+        so the returned locations are all readable here."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self.cv:
             while True:
                 missing = [o for o in object_ids if o not in self.directory]
                 freed = [o for o in missing if o in self.freed_refs]
                 if freed:
-                    from ray_tpu.exceptions import ObjectFreedError
                     raise ObjectFreedError(
                         f"object {freed[0]} was freed by reference "
                         "counting before this get()")
+                lost = [o for o in missing if o in self.lost_objects]
+                if lost:
+                    raise ObjectLostError(
+                        f"object {lost[0]} was lost: "
+                        f"{self.lost_objects[lost[0]]}")
                 if not missing:
-                    return {o: self.directory[o] for o in object_ids}
+                    locs = {o: self.directory[o] for o in object_ids}
+                    break
                 if deadline is not None:
                     rem = deadline - time.monotonic()
                     if rem <= 0:
@@ -584,6 +776,9 @@ class NodeServer:
                     self.cv.wait(rem)
                 else:
                     self.cv.wait(1.0)
+        if localize:
+            locs = self._localize(locs)
+        return locs
 
     def wait_objects(self, object_ids, num_returns, timeout):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -612,7 +807,7 @@ class NodeServer:
             not_ready = [o for o in object_ids if o not in ready_set]
             return ready_list, not_ready
 
-    def _serve_get(self, w: _WorkerConn, msg: protocol.GetRequest):
+    def _serve_get(self, w, msg: protocol.GetRequest):
         # Release the blocked worker's resources so nested tasks can run
         # (the reference releases the worker's lease while it blocks in get).
         with self.lock:
@@ -622,10 +817,16 @@ class NodeServer:
                     _add(self.available, held)
                     w.released = held
         try:
-            locs = self.get_locations(msg.object_ids, msg.timeout)
+            # Daemons localize to their own store themselves; local workers
+            # need descriptors readable in the head's store.
+            locs = self.get_locations(msg.object_ids, msg.timeout,
+                                      localize=(w.kind != "node"))
             reply = protocol.GetReply(msg.req_id, locs)
         except GetTimeoutError:
             reply = protocol.GetReply(msg.req_id, {}, timed_out=True)
+        except (ObjectFreedError, ObjectLostError) as e:
+            reply = protocol.GetReply(msg.req_id, {},
+                                      error=f"{type(e).__name__}: {e}")
         with self.lock:
             if w.released:
                 _sub(self.available, w.released)  # may dip below zero briefly
@@ -633,10 +834,333 @@ class NodeServer:
         w.send(reply)
         self._schedule()
 
-    def _serve_wait(self, w: _WorkerConn, msg: protocol.WaitRequest):
+    def _serve_wait(self, w, msg: protocol.WaitRequest):
         ready, not_ready = self.wait_objects(
             msg.object_ids, msg.num_returns, msg.timeout)
         w.send(protocol.WaitReply(msg.req_id, ready, not_ready))
+
+    # ------------------------------------------------------------------
+    # cross-node object data plane (object_manager.h:117 equivalent)
+    # ------------------------------------------------------------------
+
+    def _localize(self, locs: dict) -> dict:
+        """Return locations readable in the head's store, pulling remote
+        primaries into a head-local cached copy as needed."""
+        out = dict(locs)
+        for oid, desc in locs.items():
+            if desc.inline is not None or desc.node is None:
+                continue
+            out[oid] = self._pull_to_head(oid, desc)
+        return out
+
+    def _pull_to_head(self, oid: str, desc: Descriptor) -> Descriptor:
+        with self.cv:
+            while True:
+                lc = self.local_copies.get(oid)
+                if lc is not None:
+                    return lc
+                if oid not in self._head_pulling:
+                    self._head_pulling.add(oid)
+                    break
+                self.cv.wait(0.2)
+        try:
+            with self.lock:
+                node = self.nodes.get(desc.node)
+            if node is None or not node.alive:
+                raise ObjectLostError(
+                    f"object {oid} lives on dead node {desc.node}")
+            payload = self._pull_bytes(node, oid)
+            local = self.store.put_serialized(oid, payload)
+            with self.lock:
+                # freed while we pulled? drop the stray copy immediately
+                if oid in self.freed_refs:
+                    self.store.delete(local)
+                    raise ObjectFreedError(
+                        f"object {oid} was freed during pull")
+                self.local_copies[oid] = local
+            return local
+        finally:
+            with self.cv:
+                self._head_pulling.discard(oid)
+                self.cv.notify_all()
+
+    def _pull_bytes(self, node: _RemoteNode, oid: str) -> bytes:
+        return self._pull_client.pull(
+            node.send, oid,
+            abort_check=lambda: None if node.alive
+            else f"hit dead node {node.node_id}")
+
+    def _serve_pull(self, node: _RemoteNode, msg: protocol.PullRequest):
+        """A daemon asked for an object's bytes held by the head."""
+        from ray_tpu._private.pull_plane import serve_pull
+        with self.lock:
+            desc = self.directory.get(msg.object_id)
+            if desc is not None and desc.node is not None:
+                desc = self.local_copies.get(msg.object_id)
+        if desc is None:
+            serve_pull(node.send, msg, None)
+            return
+        try:
+            payload = self.store.raw_bytes(desc)
+        except (ObjectLostError, OSError) as e:
+            payload = e
+        serve_pull(node.send, msg, payload)
+
+    # ------------------------------------------------------------------
+    # leased-task lifecycle + node failure (raylet-side events)
+    # ------------------------------------------------------------------
+
+    def _on_node_task_done(self, node: _RemoteNode, msg: protocol.NodeTaskDone):
+        with self.lock:
+            t = node.inflight.pop(msg.task_id, None)
+            if t is None:
+                logger.warning("NodeTaskDone for unknown task %s",
+                               msg.task_id)
+                return
+            spec = t.spec
+            a = self.actors.get(spec.actor_id) if spec.actor_id else None
+            if (msg.error and t.retry_exceptions and t.retries_left > 0
+                    and not spec.actor_creation):
+                t.retries_left -= 1
+                self.task_events.requeued(spec)
+                if a is None:
+                    self._release_task_resources(t)
+                    t.node = None
+                    self.pending.append(t)
+                else:
+                    if t in a.inflight:
+                        a.inflight.remove(t)
+                    a.queue.insert(0, t)
+            else:
+                self.task_events.finished(
+                    msg.task_id,
+                    error="application_error" if msg.error else None)
+                self._release_task_args(spec)
+                for oid, desc in zip(spec.return_ids, msg.return_descs):
+                    self._register_locked(oid, desc,
+                                          origin="node:" + node.node_id)
+                self.cv.notify_all()
+                if a is not None:
+                    if t in a.inflight:
+                        a.inflight.remove(t)
+                    if spec.actor_creation:
+                        if msg.error:
+                            a.dead = True
+                            a.death_cause = "constructor raised"
+                            self._release_actor_resources(a)
+                            failed, a.queue = a.queue, []
+                            for qt in failed:
+                                self._store_error(
+                                    qt.spec.return_ids,
+                                    ActorDiedError(
+                                        f"actor {a.actor_id} constructor "
+                                        "raised"),
+                                    spec=qt.spec)
+                        else:
+                            a.ready = True
+                else:
+                    self._release_task_resources(t)
+                    t.node = None
+        self._schedule()
+
+    def _on_node_task_failed(self, node: _RemoteNode,
+                             msg: protocol.NodeTaskFailed):
+        """A leased task's worker died on the node (actor-worker deaths
+        arrive as NodeActorDied instead)."""
+        with self.lock:
+            t = node.inflight.pop(msg.task_id, None)
+            if t is None:
+                return
+            spec = t.spec
+            if spec.actor_creation or spec.actor_id is not None:
+                # actor path (resources incl.) is driven by NodeActorDied
+                retry = False
+                t = None
+            else:
+                self._release_task_resources(t)
+                t.node = None
+                if t.retries_left > 0:
+                    t.retries_left -= 1
+                    self.pending.append(t)
+                    self.task_events.requeued(spec)
+                    retry = True
+                else:
+                    retry = False
+        if t is not None and not retry:
+            self._store_error(
+                t.spec.return_ids,
+                WorkerCrashedError(
+                    f"worker died on {node.node_id} while running "
+                    f"{t.spec.function_desc}: {msg.error}"),
+                spec=t.spec)
+        self._schedule()
+
+    def _on_node_actor_died(self, node: _RemoteNode,
+                            msg: protocol.NodeActorDied):
+        with self.lock:
+            a = self.actors.get(msg.actor_id)
+            if a is None:
+                return
+            for tid in [tid for tid, t in node.inflight.items()
+                        if t.spec.actor_id == msg.actor_id]:
+                node.inflight.pop(tid)
+        self._on_actor_death(a)
+
+    def _on_node_worker_blocked(self, node: _RemoteNode,
+                                msg: protocol.NodeWorkerBlocked):
+        with self.lock:
+            t = node.inflight.get(msg.task_id)
+            if t is None:
+                return
+            held = dict(t.spec.resources)
+            if msg.blocked and not t.node_released:
+                t.node_released = True
+                if held:
+                    _add(node.available, held)
+            elif not msg.blocked and t.node_released:
+                t.node_released = False
+                if held:
+                    _sub(node.available, held)
+        self._schedule()
+
+    def _on_node_death(self, node: _RemoteNode):
+        to_fail = []
+        dead_actors = []
+        lost_oids = []
+        with self.lock:
+            if not node.alive:
+                return
+            node.alive = False
+            logger.warning("node %s died", node.node_id)
+            inflight, node.inflight = dict(node.inflight), {}
+            dead_actors = [a for a in self.actors.values()
+                           if a.node == node.node_id and not a.dead]
+            dead_actor_ids = {a.actor_id for a in dead_actors}
+            for t in inflight.values():
+                if t.spec.actor_creation or t.spec.actor_id is not None:
+                    continue    # handled via the actor restart path
+                self._release_task_resources(t)
+                t.node = None
+                if t.retries_left > 0:
+                    t.retries_left -= 1
+                    self.pending.append(t)
+                    self.task_events.requeued(t.spec)
+                else:
+                    to_fail.append(t)
+            # drop ref-holders owned by the dead node's workers wholesale:
+            # their ids are unknown here, but every holder whose holds came
+            # through this node died with it — conservative: leave them;
+            # the daemon reported NodeWorkerGone for orderly deaths, and
+            # leaked holds from a killed node only delay frees.
+            # Objects whose primary copy lived on the dead node: promote a
+            # surviving copy (head cache first, then another node), else
+            # mark lost (object_recovery_manager.h:41 recovery-from-copy).
+            for oid, desc in list(self.directory.items()):
+                if desc.node != node.node_id:
+                    continue
+                lc = self.local_copies.get(oid)
+                if lc is not None:
+                    self.directory[oid] = lc
+                    self.obj_origin[oid] = "driver"
+                    continue
+                survivors = [
+                    nid for nid in self.copy_nodes.get(oid, ())
+                    if nid != node.node_id
+                    and (n2 := self.nodes.get(nid)) is not None and n2.alive]
+                if survivors:
+                    self.directory[oid] = replace(desc, node=survivors[0])
+                    self.obj_origin[oid] = "node:" + survivors[0]
+                    continue
+                del self.directory[oid]
+                self.obj_origin.pop(oid, None)
+                self.lost_objects[oid] = f"node {node.node_id} died"
+                lost_oids.append(oid)
+            for oid, s in list(self.copy_nodes.items()):
+                s.discard(node.node_id)
+            # placement-group bundles reserved on the node can no longer
+            # host anything (the reference reschedules bundles; v1 marks
+            # them unavailable so dispatch skips them)
+            for pg in self.placement_groups.values():
+                for i, nid in enumerate(pg.bundle_nodes):
+                    if nid == node.node_id:
+                        pg.available[i] = {}
+            self.cv.notify_all()    # wake gets blocked on now-lost objects
+        self._pull_client.abort_all()    # wake pulls targeting the node
+        # Every surviving reference to a lost object now resolves to an
+        # ObjectLostError *value*: gets raise it, and tasks that consume
+        # the object fail with it through the normal dep-poisoning path —
+        # no pending task can reach a directory hole and wedge dispatch.
+        # (Lineage reconstruction will replace this with resubmission.)
+        for oid in lost_oids:
+            self._store_error(
+                [oid],
+                ObjectLostError(
+                    f"object {oid} lost: node {node.node_id} died and no "
+                    "other copy exists"))
+        for a in dead_actors:
+            self._on_actor_death(a)
+        for t in to_fail:
+            self._store_error(
+                t.spec.return_ids,
+                WorkerCrashedError(
+                    f"node {node.node_id} died while running "
+                    f"{t.spec.function_desc}"),
+                spec=t.spec)
+        self._schedule()
+
+    # ------------------------------------------------------------------
+    # node management (add/kill; the Cluster fixture + autoscaler seam)
+    # ------------------------------------------------------------------
+
+    def add_node(self, resources: dict | None = None,
+                 num_tpus: int = 0) -> str:
+        """Spawn a HostDaemon subprocess for a new (possibly fake-resource)
+        node and wait for it to register — the one-host multi-daemon
+        fixture of the reference (python/ray/cluster_utils.py:99)."""
+        import json as _json
+        from ray_tpu._private import spawn as _spawn
+        node_id = ids.new_node_id()
+        res = {str(k): float(v) for k, v in (resources or {}).items()}
+        res.setdefault("CPU", 1.0)
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        env = _spawn.propagate_pythonpath(dict(os.environ))
+        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        cmd = [sys.executable, "-m", "ray_tpu._private.daemon",
+               self._address, node_id, _json.dumps(res), str(int(num_tpus))]
+        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
+        deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
+        with self.cv:
+            while node_id not in self.nodes:
+                if self._shutdown or time.monotonic() > deadline \
+                        or proc.poll() is not None:
+                    try:
+                        proc.terminate()
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"node daemon {node_id} failed to register")
+                self.cv.wait(0.2)
+            self.nodes[node_id].proc = proc
+        return node_id
+
+    def kill_node(self, node_id: str, force: bool = True) -> bool:
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return False
+            proc = node.proc
+        if force:
+            if proc is not None:
+                try:
+                    proc.kill()     # SIGKILL: chaos-test path; EOF on the
+                except OSError:     # channel triggers _on_node_death
+                    pass
+            else:
+                self._on_node_death(node)
+        else:
+            node.send(protocol.KillNode())
+        return True
 
     # ------------------------------------------------------------------
     # task submission + scheduling
@@ -664,13 +1188,15 @@ class NodeServer:
                     self.obj_waiting_tasks.setdefault(v, []).append(t)
             self.task_events.submitted(spec, bool(t.deps))
             self._pin_task_args_locked(spec)
-            if submitter is not None:
+            submitter_id = (submitter if isinstance(submitter, str)
+                            else getattr(submitter, "worker_id", None))
+            if submitter_id is not None:
                 # worker-submitted task: the submitter holds the return
                 # refs it just minted, but its batched hold report may
                 # lag — record implicit holds (see PutRequest handler)
                 for oid in spec.return_ids:
                     self.ref_holders.setdefault(oid, set()).add(
-                        submitter.worker_id)
+                        submitter_id)
             if spec.actor_creation:
                 _name = (spec.runtime_env or {}).get("_name")
                 if _name and _name in self.named_actors:
@@ -758,26 +1284,194 @@ class NodeServer:
                                  daemon=True).start()
         for w, msg in to_send:
             if not w.send(msg):
-                self._on_worker_death(w)
+                if isinstance(w, _RemoteNode):
+                    self._on_node_death(w)
+                else:
+                    self._on_worker_death(w)
+
+    def _pick_node(self, spec) -> str | None:
+        """Cluster scheduling policy (counterpart of
+        ClusterResourceScheduler::GetBestSchedulableNode + the hybrid
+        pack-then-spread policy, hybrid_scheduling_policy.h:50): hard/soft
+        node affinity first, then SPREAD round-robin when requested, then
+        locality (most argument bytes), then pack head-first. Returns
+        "head", a node id, or None (nothing fits now). Caller holds lock."""
+        req = spec.resources
+        n_tpu = int(req.get("TPU", 0))
+
+        def head_fits():
+            return (_fits(self.available, req)
+                    and len(self.free_tpu_chips) >= n_tpu)
+
+        def node_fits(node):
+            return (node.alive and _fits(node.available, req)
+                    and len(node.free_tpu_chips) >= n_tpu)
+
+        strategy = (spec.runtime_env or {}).get("_scheduling_strategy")
+        if isinstance(strategy, dict) and strategy.get("node_id"):
+            nid = strategy["node_id"]
+            if nid in ("head", self.node_id):
+                if head_fits():
+                    return "head"
+            else:
+                node = self.nodes.get(nid)
+                if node is not None and node_fits(node):
+                    return nid
+                if not strategy.get("soft", False) and (
+                        node is None or not node.alive):
+                    # hard affinity to a node that can never come back:
+                    # fail fast instead of pending forever
+                    return "__infeasible__"
+            if not strategy.get("soft", False):
+                return None     # hard affinity: wait for the target
+        candidates = []
+        if head_fits():
+            candidates.append("head")
+        candidates += [nid for nid, node in self.nodes.items()
+                       if node_fits(node)]
+        if not candidates:
+            return None
+        if strategy == "SPREAD":
+            self._spread_rr += 1
+            return candidates[self._spread_rr % len(candidates)]
+        arg_bytes: dict[str, int] = {}
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind != "ref":
+                continue
+            d = self.directory.get(v)
+            if d is None or d.inline is not None:
+                continue
+            where = d.node or "head"
+            arg_bytes[where] = arg_bytes.get(where, 0) + d.size
+        if arg_bytes:
+            best = max(candidates, key=lambda c: arg_bytes.get(c, 0))
+            if arg_bytes.get(best, 0) > 0:
+                return best
+        return candidates[0]
+
+    def _needs_localize_locked(self, t: _TaskState) -> bool:
+        """Head-local dispatch needs every ref arg readable in the head's
+        store; kick off a background pull for remote ones. Caller holds
+        the lock. True = not ready yet (stay pending)."""
+        remote = {}
+        for kind, v in list(t.spec.args) + list(t.spec.kwargs.values()):
+            if kind != "ref":
+                continue
+            d = self.directory.get(v)
+            if (d is None or d.inline is not None or d.node is None
+                    or v in self.local_copies):
+                continue
+            remote[v] = d
+        if not remote:
+            return False
+        if not t.localizing:
+            t.localizing = True
+
+            def _pull_all():
+                try:
+                    self._localize(remote)
+                except Exception as e:
+                    logger.warning("arg localization failed: %s", e)
+                finally:
+                    t.localizing = False
+                    self._schedule()
+            threading.Thread(target=_pull_all, daemon=True).start()
+        return True
+
+    def _lease_to_node(self, node: _RemoteNode, t: _TaskState, to_send):
+        """Hand a scheduled task to a HostDaemon (caller holds the lock and
+        has already debited resources/chips)."""
+        spec = t.spec
+        locs = {}
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "ref":
+                d = self.directory.get(v)
+                if d is None:
+                    # can't happen while task_arg_refs pins the entry, but a
+                    # hole must fail the lease (daemon pull error -> retry/
+                    # error), never KeyError the scheduler mid-pass
+                    logger.error("arg %s missing from directory at lease "
+                                 "time for %s", v, spec.task_id)
+                    continue
+                locs[v] = d
+        peer_addrs = {nid: n.address for nid, n in self.nodes.items()
+                      if n.alive and n.address}
+        t.node = node.node_id
+        node.inflight[spec.task_id] = t
+        self.task_events.running(spec, "node:" + node.node_id)
+        to_send.append((node, protocol.LeaseTask(
+            spec=spec, arg_locations=locs, peer_addrs=peer_addrs,
+            tpu_chips=list(t.tpu_chips))))
+
+    def _pick_bundle_target(self, req: dict, n_tpu: int, pg):
+        """Pick the first placement-group bundle that fits `req` and whose
+        node can also supply the TPU chips; the chosen bundle pins the
+        node (bundles were placed at PG creation; the 2PC of
+        placement_group_resource_manager.h:46 collapses to this
+        reservation). Returns (target, bundle_idx) or (None, None).
+        Caller holds the lock."""
+        for i, b in enumerate(pg.available):
+            if not _fits(b, req):
+                continue
+            cand = pg.bundle_nodes[i] or "head"
+            if cand == "head":
+                if len(self.free_tpu_chips) >= n_tpu:
+                    return "head", i
+            else:
+                node = self.nodes.get(cand)
+                if (node is not None and node.alive
+                        and len(node.free_tpu_chips) >= n_tpu):
+                    return cand, i
+        return None, None
 
     def _try_dispatch_generic(self, t: _TaskState, to_send):
-        """True=dispatched, False=resources don't fit, None=no idle worker."""
+        """True=dispatched, False=doesn't fit anywhere right now,
+        None=head has the resources but no idle worker (caller spawns)."""
         req = t.spec.resources
+        n_tpu = int(req.get("TPU", 0))
         pg = self.placement_groups.get(t.spec.placement_group_id or "")
+        target = None
+        idx = None
         if pg is not None:
-            if not any(_fits(b, req) for b in pg.available):
+            target, idx = self._pick_bundle_target(req, n_tpu, pg)
+            if target is None:
                 return False
-        elif not _fits(self.available, req):
+        else:
+            target = self._pick_node(t.spec)
+            if target is None:
+                return False
+            if target == "__infeasible__":
+                self._store_error(
+                    t.spec.return_ids,
+                    SchedulingError(
+                        f"task {t.spec.function_desc} has hard node "
+                        "affinity to a dead or unknown node"),
+                    spec=t.spec)
+                return True     # consumed: removed from pending as failed
+        if target != "head":
+            node = self.nodes[target]
+            if pg is not None:
+                _sub(pg.available[idx], req)
+            else:
+                _sub(node.available, req)
+            if n_tpu:
+                t.tpu_chips = node.free_tpu_chips[:n_tpu]
+                del node.free_tpu_chips[:n_tpu]
+            self._lease_to_node(node, t, to_send)
+            return True
+        if self._needs_localize_locked(t):
             return False
-        if req.get("TPU", 0) > 0:
+        if n_tpu > 0:
             # TPU tasks need TPU_VISIBLE_CHIPS in the environment BEFORE the
             # process initializes JAX (the reference's CUDA_VISIBLE_DEVICES
             # is equally process-birth-scoped for safety), so they run on a
             # dedicated fresh worker that retires afterwards, not the pool.
-            n_tpu = int(req["TPU"])
             if len(self.free_tpu_chips) < n_tpu:
                 return False
-            self._take_resources(t, pg)
+            if pg is not None:
+                _sub(pg.available[idx], req)
+            else:
+                _sub(self.available, req)
             t.tpu_chips = self.free_tpu_chips[:n_tpu]
             del self.free_tpu_chips[:n_tpu]
             threading.Thread(target=self._spawn_tpu_worker, args=(t,),
@@ -787,22 +1481,15 @@ class NodeServer:
                        if w.kind == "generic" and w.idle and w.alive), None)
         if worker is None:
             return None
-        self._take_resources(t, pg)
+        if pg is not None:
+            _sub(pg.available[idx], req)
+        else:
+            _sub(self.available, req)
         t.tpu_chips = []
         worker.idle = False
         worker.current = t
         to_send.append((worker, self._push_msg(worker, t)))
         return True
-
-    def _take_resources(self, t: _TaskState, pg):
-        req = t.spec.resources
-        if pg is not None:
-            for b in pg.available:
-                if _fits(b, req):
-                    _sub(b, req)
-                    break
-        else:
-            _sub(self.available, req)
 
     def _spawn_tpu_worker(self, t: _TaskState):
         worker_id = ids.new_worker_id()
@@ -836,28 +1523,61 @@ class NodeServer:
         locs = {}
         for kind, v in list(spec.args) + list(spec.kwargs.values()):
             if kind == "ref":
-                locs[v] = self.directory[v]
+                d = self.directory.get(v)
+                if d is not None and d.node is not None:
+                    # remote primary: the dispatch gate (_needs_localize_
+                    # locked) guaranteed a head-local copy exists
+                    d = self.local_copies.get(v, d)
+                if d is None:
+                    # directory hole (should be unreachable): let the
+                    # worker fail the task; never KeyError the scheduler
+                    logger.error("arg %s missing from directory at push "
+                                 "time for %s", v, spec.task_id)
+                    continue
+                locs[v] = d
         self.task_events.running(t.spec, worker.worker_id)
         return protocol.PushTask(spec=spec, arg_locations=locs)
 
     def _try_dispatch_actor_creation(self, t: _TaskState, to_send):
         a = self.actors[t.spec.actor_id]
         req = a.resources
+        n_tpu = int(req.get("TPU", 0))
         pg = self.placement_groups.get(t.spec.placement_group_id or "")
+        target = None
+        idx = None
         if pg is not None:
-            ok = any(_fits(b, req) for b in pg.available)
+            target, idx = self._pick_bundle_target(req, n_tpu, pg)
+            if target is None:
+                return False
         else:
-            ok = _fits(self.available, req)
-        if not ok:
+            target = self._pick_node(t.spec)
+            if target is None:
+                return False
+            if target == "__infeasible__":
+                self._fail_actor(
+                    a, "actor has hard node affinity to a dead or "
+                       "unknown node")
+                return True     # consumed: removed from pending as failed
+        if target != "head":
+            node = self.nodes[target]
+            if pg is not None:
+                _sub(pg.available[idx], req)
+            else:
+                _sub(node.available, req)
+            if n_tpu:
+                a.tpu_chips = node.free_tpu_chips[:n_tpu]
+                del node.free_tpu_chips[:n_tpu]
+            a.node = target
+            t.tpu_chips = list(a.tpu_chips)
+            a.inflight.append(t)
+            self._lease_to_node(node, t, to_send)
+            return True
+        if self._needs_localize_locked(t):
             return False
         if pg is not None:
-            for b in pg.available:
-                if _fits(b, req):
-                    _sub(b, req)
-                    break
+            _sub(pg.available[idx], req)
         else:
             _sub(self.available, req)
-        n_tpu = int(req.get("TPU", 0))
         if n_tpu and len(self.free_tpu_chips) >= n_tpu:
             a.tpu_chips = self.free_tpu_chips[:n_tpu]
             del self.free_tpu_chips[:n_tpu]
@@ -866,7 +1586,24 @@ class NodeServer:
         return True
 
     def _pump_actor(self, a: _ActorState, to_send):
-        if a.dead or not a.ready or a.worker is None or not a.worker.alive:
+        if a.dead or not a.ready:
+            return
+        if a.node is not None:
+            node = self.nodes.get(a.node)
+            if node is None or not node.alive:
+                return
+            while a.queue and len(a.inflight) < a.max_concurrency:
+                t = a.queue[0]
+                if t.deps:
+                    break   # preserve submission order per actor
+                if t.cancelled:
+                    a.queue.pop(0)
+                    continue
+                a.queue.pop(0)
+                a.inflight.append(t)
+                self._lease_to_node(node, t, to_send)
+            return
+        if a.worker is None or not a.worker.alive:
             return
         while a.queue and len(a.inflight) < a.max_concurrency:
             t = a.queue[0]
@@ -875,6 +1612,8 @@ class NodeServer:
             if t.cancelled:
                 a.queue.pop(0)
                 continue
+            if self._needs_localize_locked(t):
+                break
             a.queue.pop(0)
             a.inflight.append(t)
             to_send.append((a.worker, self._push_msg(a.worker, t)))
@@ -884,58 +1623,13 @@ class NodeServer:
     # ------------------------------------------------------------------
 
     def _worker_env(self, chips=None, runtime_env=None):
-        env = dict(os.environ)
-        env["RAY_TPU_WORKER"] = "1"
-        # Per-task/actor env overrides first (reference: runtime_env
-        # env_vars, _private/runtime_env/) so an explicit JAX_PLATFORMS
-        # override is visible to the FORCE_CPU decision below.
-        overrides = {
-            str(k): str(v)
-            for k, v in ((runtime_env or {}).get("env_vars") or {}).items()
-        }
-        env.update(overrides)
-        if chips:
-            env[constants.TPU_VISIBLE_CHIPS_ENV] = ",".join(map(str, chips))
-            env["TPU_PROCESS_BOUNDS"] = ""
-        else:
-            # Workers must not grab the host's TPU runtime by default: only
-            # tasks that requested TPU resources see chips (the reference
-            # hides GPUs the same way via CUDA_VISIBLE_DEVICES="").
-            # RAY_TPU_WORKER_FORCE_CPU drives worker_site/sitecustomize.py,
-            # which blocks accelerator plugin registration pre-jax-import.
-            if "JAX_PLATFORMS" not in overrides:
-                env["JAX_PLATFORMS"] = env.get(
-                    "RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
-            if env["JAX_PLATFORMS"] == "cpu":
-                env["RAY_TPU_WORKER_FORCE_CPU"] = "1"
-        return env
+        from ray_tpu._private import spawn
+        return spawn.worker_env(chips=chips, runtime_env=runtime_env)
 
     def _spawn_proc(self, worker_id, env):
-        # subprocess (not mp.Process) so we control the child env exactly and
-        # never inherit the driver's TPU runtime handles/locks.
-        cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
-               self._address, worker_id]
-        env = dict(env)
-        env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
-        # Workers must resolve the same modules as the driver: cloudpickle
-        # serializes module-level functions by reference, so the driver's
-        # full sys.path (which includes the uninstalled checkout and the
-        # user's script dir) is propagated (reference: workers inherit the
-        # driver's load path / working_dir runtime env, services.py).
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        worker_site = os.path.join(pkg_root, "ray_tpu", "_private",
-                                   "worker_site")
-        entries = [worker_site, pkg_root] + [p for p in sys.path if p]
-        pypath = env.get("PYTHONPATH", "")
-        entries += [p for p in pypath.split(os.pathsep) if p]
-        seen, uniq = set(), []
-        for p in entries:
-            if p not in seen:
-                seen.add(p)
-                uniq.append(p)
-        env["PYTHONPATH"] = os.pathsep.join(uniq)
-        return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
+        from ray_tpu._private import spawn
+        return spawn.spawn_worker_proc(self._address, self._authkey,
+                                       worker_id, env)
 
     def _spawn_generic_worker(self):
         worker_id = ids.new_worker_id()
@@ -1096,22 +1790,33 @@ class NodeServer:
             self.pending.append(t)
 
     def _release_task_resources(self, t: _TaskState):
-        pg = self.placement_groups.get(t.spec.placement_group_id or "")
-        if pg is not None:
-            # return to the first bundle with headroom vs its spec
-            for b, orig in zip(pg.available, pg.bundles):
-                if all(b.get(k, 0) + v <= orig.get(k, 0) + _EPS
-                       for k, v in t.spec.resources.items()):
-                    _add(b, t.spec.resources)
-                    break
+        if not t.node_released:
+            pg = self.placement_groups.get(t.spec.placement_group_id or "")
+            if pg is not None:
+                # return to the first bundle with headroom vs its spec
+                for b, orig in zip(pg.available, pg.bundles):
+                    if all(b.get(k, 0) + v <= orig.get(k, 0) + _EPS
+                           for k, v in t.spec.resources.items()):
+                        _add(b, t.spec.resources)
+                        break
+                else:
+                    if pg.available:
+                        _add(pg.available[0], t.spec.resources)
+            elif t.node is not None:
+                node = self.nodes.get(t.node)
+                if node is not None:
+                    _add(node.available, t.spec.resources)
             else:
-                if pg.available:
-                    _add(pg.available[0], t.spec.resources)
-        else:
-            _add(self.available, t.spec.resources)
-        chips = getattr(t, "tpu_chips", None)
+                _add(self.available, t.spec.resources)
+        t.node_released = False
+        chips, t.tpu_chips = t.tpu_chips, []
         if chips:
-            self.free_tpu_chips.extend(chips)
+            if t.node is not None:
+                node = self.nodes.get(t.node)
+                if node is not None:
+                    node.free_tpu_chips.extend(chips)
+            else:
+                self.free_tpu_chips.extend(chips)
 
     def _release_actor_resources(self, a: _ActorState):
         pg = self.placement_groups.get(
@@ -1119,10 +1824,21 @@ class NodeServer:
         if pg is not None and pg.available:
             _add(pg.available[0], a.resources)
         elif pg is None:
-            _add(self.available, a.resources)
+            if a.node is not None:
+                node = self.nodes.get(a.node)
+                if node is not None:
+                    _add(node.available, a.resources)
+            else:
+                _add(self.available, a.resources)
         if a.tpu_chips:
-            self.free_tpu_chips.extend(a.tpu_chips)
+            if a.node is not None:
+                node = self.nodes.get(a.node)
+                if node is not None:
+                    node.free_tpu_chips.extend(a.tpu_chips)
+            else:
+                self.free_tpu_chips.extend(a.tpu_chips)
             a.tpu_chips = []
+        a.node = None
 
     def _store_error(self, return_ids, exc, spec=None):
         """Store `exc` as the value of every return id (under or out of lock).
@@ -1238,6 +1954,10 @@ class NodeServer:
                 spec=t.spec)
         self._schedule()
 
+    # the same restart/fail state machine serves remote actors, whose
+    # worker lives under a HostDaemon (we only hear NodeActorDied)
+    _on_actor_death = _on_actor_worker_death
+
     def _fail_actor(self, a: _ActorState, cause: str):
         with self.lock:
             a.dead = True
@@ -1278,7 +1998,10 @@ class NodeServer:
                 if a.name:
                     self.named_actors.pop(a.name, None)
             w = a.worker
-        if w is not None and w.proc is not None:
+            node = self.nodes.get(a.node) if a.node is not None else None
+        if node is not None:
+            node.send(protocol.KillActorOnNode(actor_id))
+        elif w is not None and w.proc is not None:
             try:
                 w.proc.terminate()
             except OSError:
@@ -1307,24 +2030,87 @@ class NodeServer:
         return False
 
     # ------------------------------------------------------------------
-    # placement groups (single-node: pure resource accounting; the 2PC
-    # prepare/commit of the reference (placement_group_resource_manager.h)
-    # becomes relevant with multi-host support)
+    # placement groups: bundles are placed onto nodes at creation time by
+    # strategy (PACK/SPREAD/STRICT_*), reserving resources on each node —
+    # the reference's bundle scheduling policies
+    # (policy/bundle_scheduling_policy.h:82-106) with the 2PC
+    # (placement_group_resource_manager.h:46) collapsed into the head's
+    # single resource ledger.
     # ------------------------------------------------------------------
 
-    def create_placement_group(self, bundles, strategy="PACK", name=""):
-        total = {}
+    def _assign_bundles(self, bundles, strategy):
+        """Pick a node for every bundle. Returns list of node ids (None =
+        head) or None if infeasible. Caller holds the lock. The head pool
+        is keyed "head" internally so it can't collide with the "no
+        fitting pool" sentinel."""
+        pools = [("head", self.available)]
+        pools += [(nid, n.available) for nid, n in self.nodes.items()
+                  if n.alive]
+        sim = {pid: dict(av) for pid, av in pools}
+        order = [pid for pid, _ in pools]
+
+        def out(assignment):
+            return [None if pid == "head" else pid for pid in assignment]
+
+        if strategy == "STRICT_PACK":
+            # every bundle on ONE node
+            for pid in order:
+                s = dict(sim[pid])
+                if all(_fits(s, b) and (_sub(s, b) or True)
+                       for b in bundles):
+                    return out([pid] * len(bundles))
+            return None
+        assignment = []
+        if strategy == "STRICT_SPREAD":
+            used = set()
+            for b in bundles:
+                pid = next((p for p in order
+                            if p not in used and _fits(sim[p], b)), None)
+                if pid is None:
+                    return None
+                _sub(sim[pid], b)
+                used.add(pid)
+                assignment.append(pid)
+            return out(assignment)
+        if strategy == "SPREAD":
+            # best-effort distinct: prefer the fitting node with the
+            # fewest bundles so far
+            counts = {p: 0 for p in order}
+            for b in bundles:
+                ranked = sorted(order, key=lambda p: counts[p])
+                pid = next((p for p in ranked if _fits(sim[p], b)), None)
+                if pid is None:
+                    return None
+                _sub(sim[pid], b)
+                counts[pid] += 1
+                assignment.append(pid)
+            return out(assignment)
+        # PACK (default): first-fit, head first
         for b in bundles:
-            _add(total, b)
+            pid = next((p for p in order if _fits(sim[p], b)), None)
+            if pid is None:
+                return None
+            _sub(sim[pid], b)
+            assignment.append(pid)
+        return out(assignment)
+
+    def create_placement_group(self, bundles, strategy="PACK", name=""):
+        bundles = [dict(b) for b in bundles]
         with self.lock:
-            if not _fits(self.available, total):
+            assignment = self._assign_bundles(bundles, strategy)
+            if assignment is None:
                 raise PlacementGroupError(
-                    f"infeasible placement group: need {total}, "
-                    f"available {self.available}")
-            _sub(self.available, total)
+                    f"infeasible placement group ({strategy}): "
+                    f"bundles {bundles}")
+            for b, nid in zip(bundles, assignment):
+                if nid is None:
+                    _sub(self.available, b)
+                else:
+                    _sub(self.nodes[nid].available, b)
             pg_id = ids.new_placement_group_id()
             self.placement_groups[pg_id] = _PlacementGroup(
-                pg_id, [dict(b) for b in bundles], strategy)
+                pg_id, bundles, strategy,
+                bundle_nodes=list(assignment))
         return pg_id
 
     def remove_placement_group(self, pg_id: str):
@@ -1332,10 +2118,13 @@ class NodeServer:
             pg = self.placement_groups.pop(pg_id, None)
             if pg is None:
                 return False
-            total = {}
-            for b in pg.bundles:
-                _add(total, b)
-            _add(self.available, total)
+            for b, nid in zip(pg.bundles, pg.bundle_nodes):
+                if nid is None:
+                    _add(self.available, b)
+                else:
+                    node = self.nodes.get(nid)
+                    if node is not None and node.alive:
+                        _add(node.available, b)
         self._schedule()
         return True
 
@@ -1349,8 +2138,21 @@ class NodeServer:
                 return
             self._shutdown = True
             workers = list(self.workers.values())
+            nodes = list(self.nodes.values())
+        for node in nodes:
+            node.alive = False
+            node.send(protocol.KillNode())
         for w in workers:
             w.send(protocol.KillWorker())
+        for node in nodes:
+            if node.proc is not None:
+                try:
+                    node.proc.wait(2.0)
+                except Exception:
+                    try:
+                        node.proc.kill()
+                    except OSError:
+                        pass
         try:
             self._listener.close()
         except OSError:

@@ -38,12 +38,19 @@ from ray_tpu.exceptions import ObjectLostError
 
 @dataclass(frozen=True)
 class Descriptor:
-    """Location of a sealed object's bytes: inline, arena, or file-backed."""
+    """Location of a sealed object's bytes: inline, arena, or file-backed.
+
+    `node` names the cluster node whose store holds the bytes (None = the
+    head node). A process on a different node must pull the bytes into its
+    own store before reading — the counterpart of the reference's
+    object-location entry in the ownership-based directory
+    (ownership_based_object_directory.h)."""
     object_id: str
     size: int
     inline: bytes | None = None  # set iff the object is small
     path: str | None = None      # set iff the object lives in the store dir
     arena: bool = False          # set iff the object lives in the shm arena
+    node: str | None = None      # owning node id; None = head node
 
 
 class ObjectStore:

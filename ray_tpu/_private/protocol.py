@@ -91,9 +91,13 @@ class TaskDone:
 
 @dataclass
 class PutRequest:
-    """Worker already wrote the object into the store; register it."""
+    """Worker already wrote the object into the store; register it.
+    `origin` carries the putting worker's id when the request is relayed
+    through a HostDaemon (the implicit ref-hold must be keyed by the worker
+    whose later release event clears it)."""
     object_id: str
     desc: Descriptor
+    origin: str | None = None
 
 
 @dataclass
@@ -111,6 +115,9 @@ class GetReply:
     req_id: int
     locations: dict          # object_id -> Descriptor
     timed_out: bool = False
+    # "ExceptionClassName: message" when the get failed terminally (object
+    # freed by refcounting or lost with a node); the worker re-raises.
+    error: str | None = None
 
 
 @dataclass
@@ -131,9 +138,12 @@ class WaitReply:
 
 @dataclass
 class SubmitRequest:
-    """Nested task/actor submission from inside a worker."""
+    """Nested task/actor submission from inside a worker. `submitter`
+    carries the submitting worker's id when relayed through a HostDaemon
+    (implicit holds on the fresh return refs must be keyed by it)."""
     req_id: int
     spec: TaskSpec
+    submitter: str | None = None
 
 
 @dataclass
@@ -157,3 +167,132 @@ class ActorCallReply:
     req_id: int
     result: Any = None
     error: str | None = None
+
+
+# ---- multi-node control plane (head <-> per-host daemon) ------------------
+#
+# The head process keeps the cluster store + cluster scheduler (the
+# reference's GCS, gcs_server.h:78); each additional host runs a HostDaemon
+# (the raylet, node_manager.h:117) owning its local object store, worker
+# pool, and task execution. These messages are the raylet<->GCS and
+# object-manager (object_manager.h:130,139 Push/Pull) contracts.
+
+@dataclass
+class RegisterNode:
+    """Daemon -> head: first message on the node channel."""
+    node_id: str
+    pid: int
+    resources: dict
+    num_tpu_chips: int = 0
+    address: str = ""            # daemon's own listener, for peer pulls
+
+
+@dataclass
+class LeaseTask:
+    """Head -> daemon: run this task on your node (the lease+push pipeline
+    of the reference collapsed into one hop, direct_task_transport.h:75).
+
+    `arg_locations` carries the directory's descriptors, which may point at
+    other nodes; the daemon pulls whatever isn't local before dispatch.
+    `peer_addrs` maps node_id -> daemon listener address for those pulls.
+    """
+    spec: TaskSpec
+    arg_locations: dict = field(default_factory=dict)
+    peer_addrs: dict = field(default_factory=dict)
+    tpu_chips: list = field(default_factory=list)
+
+
+@dataclass
+class NodeTaskDone:
+    """Daemon -> head: a leased task finished; returns are sealed in the
+    daemon's store (descriptors tagged with its node id)."""
+    task_id: str
+    return_descs: list
+    error: bool = False
+    actor_ready: bool = False
+
+
+@dataclass
+class NodeTaskFailed:
+    """Daemon -> head: a leased task's worker died or its deps were lost;
+    the head decides retry vs error (task_manager.h:173)."""
+    task_id: str
+    error: str = ""
+
+
+@dataclass
+class NodeActorDied:
+    """Daemon -> head: an actor's dedicated worker process died while idle
+    (in-flight deaths also arrive as NodeTaskFailed per task)."""
+    actor_id: str
+    cause: str = ""
+
+
+@dataclass
+class NodeWorkerGone:
+    """Daemon -> head: a worker process on this node exited; drop its
+    ref-holder entries (the head does the same for local worker deaths)."""
+    worker_id: str
+
+
+@dataclass
+class NodeWorkerBlocked:
+    """Daemon -> head: the worker running `task_id` blocked in get()
+    (blocked=True) or resumed (False); the head releases/re-takes its
+    resources like the local blocked-on-get path."""
+    task_id: str
+    blocked: bool
+
+
+@dataclass
+class PullRequest:
+    """Ask the receiving node for an object's serialized bytes
+    (object_manager.h:139 HandlePull)."""
+    req_id: int
+    object_id: str
+
+
+@dataclass
+class PullChunk:
+    """Chunked reply to PullRequest (object_manager.h:130 HandlePush uses
+    the same chunking; ObjectBufferPool's chunk size analog)."""
+    req_id: int
+    seq: int
+    data: bytes
+    last: bool = False
+    error: str | None = None
+
+
+@dataclass
+class RegisterPeer:
+    """Daemon -> daemon: first message on a peer data channel; the
+    connecting side then issues PullRequests on it."""
+    node_id: str
+
+
+@dataclass
+class ObjectCopyNote:
+    """Daemon -> head: this node cached a copy of the object (enables
+    promotion to primary if the owner node dies — object recovery from
+    another copy, object_recovery_manager.h:41)."""
+    object_id: str
+    node_id: str
+
+
+@dataclass
+class FreeObjectNode:
+    """Head -> daemon: drop this object (primary or cached copy) from your
+    store; forward the owner-pin release to the origin worker."""
+    object_id: str
+
+
+@dataclass
+class KillActorOnNode:
+    """Head -> daemon: terminate the worker hosting this actor."""
+    actor_id: str
+
+
+@dataclass
+class KillNode:
+    """Head -> daemon: graceful node shutdown."""
+    graceful: bool = True

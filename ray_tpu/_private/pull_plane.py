@@ -1,0 +1,108 @@
+"""Chunked object-pull data plane shared by the head and HostDaemons.
+
+Counterpart of the reference's object-manager transfer internals
+(`object_manager.h:130,139` HandlePush/HandlePull + `object_buffer_pool.h`
+chunking): one side asks for an object's serialized bytes with a
+PullRequest, the other streams PullChunks back on the same channel. Both
+the head (node.py) and the daemons (daemon.py) embed a `PullClient` for
+their outgoing pulls and call `serve_pull` for incoming ones, so the
+protocol lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ray_tpu._private import protocol
+from ray_tpu.exceptions import ObjectLostError
+
+PULL_CHUNK_BYTES = 1 << 20
+PULL_TIMEOUT_S = 120.0
+
+
+class _PullBuf:
+    """Reassembly buffer for one in-flight chunked pull."""
+    __slots__ = ("parts", "done", "error")
+
+    def __init__(self):
+        self.parts = []
+        self.done = False
+        self.error = None
+
+
+class PullClient:
+    """Issues PullRequests and reassembles PullChunk streams. The owner
+    routes every incoming PullChunk to `on_chunk` (from whichever channel
+    reader received it — req ids are process-global, so replies can't
+    collide across channels)."""
+
+    def __init__(self):
+        self._req = itertools.count(1)
+        self._bufs: dict[int, _PullBuf] = {}
+        self._cv = threading.Condition()
+
+    def on_chunk(self, msg: protocol.PullChunk) -> None:
+        with self._cv:
+            buf = self._bufs.get(msg.req_id)
+            if buf is None:
+                return
+            if msg.error is not None:
+                buf.error = msg.error
+                buf.done = True
+            else:
+                buf.parts.append(msg.data)
+                if msg.last:
+                    buf.done = True
+            if buf.done:
+                self._cv.notify_all()
+
+    def abort_all(self) -> None:
+        """Wake every waiter (e.g. a source node died) so their
+        abort_check can run immediately."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def pull(self, send, oid: str, abort_check=None,
+             timeout: float = PULL_TIMEOUT_S) -> bytes:
+        """Send a PullRequest via `send` and block for the reassembled
+        payload. `abort_check()` (optional) is polled while waiting;
+        returning a truthy string aborts with that cause."""
+        req = next(self._req)
+        buf = _PullBuf()
+        with self._cv:
+            self._bufs[req] = buf
+        send(protocol.PullRequest(req, oid))
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not buf.done:
+                cause = abort_check() if abort_check is not None else None
+                rem = deadline - time.monotonic()
+                if rem <= 0 or cause:
+                    self._bufs.pop(req, None)
+                    raise ObjectLostError(
+                        f"pull of {oid} {cause or 'timed out'}")
+                self._cv.wait(min(rem, 0.5))
+            self._bufs.pop(req, None)
+        if buf.error is not None:
+            raise ObjectLostError(f"pull of {oid} failed: {buf.error}")
+        return b"".join(buf.parts)
+
+
+def serve_pull(send, msg: protocol.PullRequest, payload) -> None:
+    """Stream `payload` (bytes, or an exception/None for failure) back as
+    PullChunks on `send`."""
+    if payload is None or isinstance(payload, BaseException):
+        send(protocol.PullChunk(
+            msg.req_id, 0, b"", last=True,
+            error=str(payload) if payload is not None
+            else "object not on this node"))
+        return
+    n = len(payload)
+    seq = 0
+    for off in range(0, max(n, 1), PULL_CHUNK_BYTES):
+        chunk = bytes(payload[off:off + PULL_CHUNK_BYTES])
+        send(protocol.PullChunk(msg.req_id, seq, chunk,
+                                last=off + PULL_CHUNK_BYTES >= n))
+        seq += 1

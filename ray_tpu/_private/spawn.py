@@ -1,0 +1,77 @@
+"""Worker-process spawning shared by the head NodeServer and HostDaemons.
+
+Counterpart of the reference's worker-command assembly in
+`python/ray/_private/services.py` (start_raylet builds the worker command
+string the raylet's WorkerPool execs, worker_pool.h:80): environment
+scoping (TPU chip visibility, JAX platform forcing) and sys.path
+propagation so cloudpickled functions resolve in the child.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from ray_tpu._private import constants
+
+
+def worker_env(chips=None, runtime_env=None) -> dict:
+    env = dict(os.environ)
+    env["RAY_TPU_WORKER"] = "1"
+    # Per-task/actor env overrides first (reference: runtime_env env_vars,
+    # _private/runtime_env/) so an explicit JAX_PLATFORMS override is
+    # visible to the FORCE_CPU decision below.
+    overrides = {
+        str(k): str(v)
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items()
+    }
+    env.update(overrides)
+    if chips:
+        env[constants.TPU_VISIBLE_CHIPS_ENV] = ",".join(map(str, chips))
+        env["TPU_PROCESS_BOUNDS"] = ""
+    else:
+        # Workers must not grab the host's TPU runtime by default: only
+        # tasks that requested TPU resources see chips (the reference hides
+        # GPUs the same way via CUDA_VISIBLE_DEVICES="").
+        # RAY_TPU_WORKER_FORCE_CPU drives worker_site/sitecustomize.py,
+        # which blocks accelerator plugin registration pre-jax-import.
+        if "JAX_PLATFORMS" not in overrides:
+            env["JAX_PLATFORMS"] = env.get(
+                "RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+        if env["JAX_PLATFORMS"] == "cpu":
+            env["RAY_TPU_WORKER_FORCE_CPU"] = "1"
+    return env
+
+
+def propagate_pythonpath(env: dict) -> dict:
+    """Make the child resolve the same modules as this process: cloudpickle
+    serializes module-level functions by reference, so the full sys.path
+    (including the uninstalled checkout and the user's script dir) is
+    propagated (reference: workers inherit the driver's load path /
+    working_dir runtime env, services.py)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    worker_site = os.path.join(pkg_root, "ray_tpu", "_private", "worker_site")
+    entries = [worker_site, pkg_root] + [p for p in sys.path if p]
+    pypath = env.get("PYTHONPATH", "")
+    entries += [p for p in pypath.split(os.pathsep) if p]
+    seen, uniq = set(), []
+    for p in entries:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    env["PYTHONPATH"] = os.pathsep.join(uniq)
+    return env
+
+
+def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
+                      env: dict) -> subprocess.Popen:
+    """Exec a worker process that will register at `address`. subprocess
+    (not mp.Process) so we control the child env exactly and never inherit
+    the parent's TPU runtime handles/locks."""
+    cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
+           address, worker_id]
+    env = propagate_pythonpath(dict(env))
+    env["RAY_TPU_AUTHKEY"] = authkey.hex()
+    return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)

@@ -114,6 +114,12 @@ class WorkerRuntime:
         if reply.timed_out:
             from ray_tpu.exceptions import GetTimeoutError
             raise GetTimeoutError(f"get() timed out: {object_ids[:3]}")
+        if getattr(reply, "error", None):
+            from ray_tpu.exceptions import ObjectFreedError, ObjectLostError
+            cls_name, _, detail = reply.error.partition(": ")
+            cls = (ObjectFreedError if cls_name == "ObjectFreedError"
+                   else ObjectLostError)
+            raise cls(detail or reply.error)
         out = []
         for oid in object_ids:
             value = self.store.get(reply.locations[oid])

@@ -81,9 +81,13 @@ class ActorClass:
         enc_args, enc_kwargs = _encode_args(args, kwargs)
         method_meta = _collect_method_meta(self._cls)
         pg_id = None
+        strategy_enc = None
         strategy = o.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):
             pg_id = strategy.placement_group.id
+        elif strategy is not None:
+            from ray_tpu.remote_function import encode_strategy
+            strategy_enc = encode_strategy(strategy)
         spec = protocol.TaskSpec(
             task_id=task_id,
             function_id=function_id,
@@ -104,6 +108,7 @@ class ActorClass:
                 "_max_task_retries": int(o.get("max_task_retries", 0)),
                 "_name": o.get("name"),
                 "_method_meta": method_meta,
+                "_scheduling_strategy": strategy_enc,
             },
             placement_group_id=pg_id,
             name=o.get("name") or self.__name__,

@@ -1,0 +1,66 @@
+"""One-host multi-daemon cluster fixture.
+
+Counterpart of the reference's `python/ray/cluster_utils.py:99` `Cluster`:
+N HostDaemons (each with its own object store, worker pool, and — faked —
+resources) on one machine, sharing the head's cluster store. Resource
+shapes are just scheduler numbers, so a laptop can simulate a multi-host
+TPU pod the same way the reference fakes `num_gpus=8` nodes; this is the
+load-bearing fixture for multi-node scheduling, placement-strategy, object
+-transfer, and chaos tests.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu._private.worker import get_client
+
+
+class Cluster:
+    """Start a head session plus `initial_nodes` extra daemon nodes.
+
+    Usage::
+
+        cluster = Cluster(head_resources={"CPU": 2})
+        n1 = cluster.add_node({"CPU": 2, "accel": 1})
+        ...
+        cluster.shutdown()
+    """
+
+    def __init__(self, head_resources: dict | None = None,
+                 num_tpus: int = 0, **init_kwargs):
+        res = dict(head_resources or {})
+        num_cpus = res.pop("CPU", None)
+        self.client = ray_tpu.init(
+            num_cpus=int(num_cpus) if num_cpus is not None else None,
+            num_tpus=num_tpus, resources=res, **init_kwargs)
+        self.node_ids: list[str] = []
+
+    @classmethod
+    def attach(cls) -> "Cluster":
+        """Wrap the already-initialized session (shared test fixtures)."""
+        c = cls.__new__(cls)
+        c.client = get_client()
+        c.node_ids = []
+        return c
+
+    def add_node(self, resources: dict | None = None,
+                 num_tpus: int = 0) -> str:
+        """Spawn one HostDaemon with the given (fake) resource shape and
+        block until it registers with the head."""
+        node_id = get_client().control(
+            "add_node", {"resources": resources or {},
+                         "num_tpus": num_tpus})
+        self.node_ids.append(node_id)
+        return node_id
+
+    def kill_node(self, node_id: str, force: bool = True) -> bool:
+        """SIGKILL the daemon (chaos path): its workers die with it and the
+        head's failure handling kicks in, exactly like losing a host."""
+        return get_client().control(
+            "kill_node", {"node_id": node_id, "force": force})
+
+    def list_nodes(self):
+        return get_client().control("list_nodes")
+
+    def shutdown(self):
+        ray_tpu.shutdown()

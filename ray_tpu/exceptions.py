@@ -88,3 +88,8 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PlacementGroupError(RayTpuError):
     """Placement group creation or lookup failed."""
+
+
+class SchedulingError(RayTpuError):
+    """The task can never be scheduled (e.g. hard node affinity to a dead
+    or unknown node)."""

@@ -62,11 +62,17 @@ def _pipe_loop(stacked_params, x_micro, stage_fn, axis_name):
 
 def pipeline_apply(stage_fn, per_stage_params: list, x, *,
                    mesh: Mesh, num_microbatches: int,
-                   axis_name: str = "pipe"):
+                   axis_name: str = "pipe",
+                   batch_spec: P | None = None):
     """Run `x` through S pipeline stages of `stage_fn`.
 
     stage_fn(params, microbatch) -> microbatch-shaped output; every stage
     must be shape-preserving in v1 (transformer blocks are).
+
+    `batch_spec` partitions the microbatched input/output
+    [M, mb, ...] across OTHER mesh axes (e.g. P(None, "data") combines
+    pipeline with data parallelism: each pipe rank streams its data
+    shard); default fully replicated.
     """
     s_count = mesh.shape.get(axis_name, 1)
     if len(per_stage_params) != max(s_count, 1):
@@ -85,13 +91,14 @@ def pipeline_apply(stage_fn, per_stage_params: list, x, *,
     x_micro = x.reshape((num_microbatches, x.shape[0] // num_microbatches)
                         + x.shape[1:])
 
+    io_spec = P() if batch_spec is None else batch_spec
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked)
     fn = jax.shard_map(
         functools.partial(_pipe_loop, stage_fn=stage_fn,
                           axis_name=axis_name),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, io_spec),
+        out_specs=io_spec,
         check_vma=False)
     out_micro = fn(stacked, x_micro)
     return out_micro.reshape(x.shape[:1] + out_micro.shape[2:])

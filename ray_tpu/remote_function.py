@@ -34,6 +34,18 @@ def _resources_from_options(o: dict, default_cpus: float) -> dict:
     return res
 
 
+def encode_strategy(strategy):
+    """Flatten a scheduling-strategy object into the TaskSpec side channel
+    the cluster scheduler reads (node.py _pick_node): "SPREAD" or
+    {"node_id": ..., "soft": ...} for NodeAffinitySchedulingStrategy."""
+    if isinstance(strategy, str):
+        return strategy
+    if hasattr(strategy, "node_id"):
+        return {"node_id": strategy.node_id,
+                "soft": bool(getattr(strategy, "soft", False))}
+    return None
+
+
 def _encode_args(args, kwargs):
     """Top-level ObjectRefs become ("ref", id); other values are serialized
     inline, spilling to the object store above the inline cap (the reference
@@ -88,9 +100,13 @@ class RemoteFunction:
         return_ids = [ids.new_object_id() for _ in range(num_returns)]
         enc_args, enc_kwargs = _encode_args(args, kwargs)
         pg_id = None
+        runtime_env = o.get("runtime_env")
         strategy = o.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):
             pg_id = strategy.placement_group.id
+        elif strategy is not None:
+            runtime_env = dict(runtime_env or {})
+            runtime_env["_scheduling_strategy"] = encode_strategy(strategy)
         spec = protocol.TaskSpec(
             task_id=task_id,
             function_id=function_id,
@@ -104,7 +120,7 @@ class RemoteFunction:
             resources=_resources_from_options(o, DEFAULT_TASK_NUM_CPUS),
             max_retries=int(o.get("max_retries", 0)),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
-            runtime_env=o.get("runtime_env"),
+            runtime_env=runtime_env,
             placement_group_id=pg_id,
             name=o.get("name") or getattr(self._function, "__name__", ""),
         )

@@ -179,6 +179,61 @@ def _make_lm_trainer(init_fn, logical_axes, loss_fn, mesh: Mesh, rng,
     return state, step_fn, shard_tokens
 
 
+def make_gpt_pipeline_trainer(cfg, mesh: Mesh, num_microbatches: int = 2,
+                              rng=None,
+                              optimizer: optax.GradientTransformation | None
+                              = None,
+                              rules: dict | None = None):
+    """GPipe-staged GPT trainer: the layer stack splits into
+    mesh["pipe"] contiguous stages, activations stream between neighbor
+    stages via ppermute (parallel/pipeline.py), combinable with the data
+    axis (each pipe rank streams its own data shard). The reference has no
+    pipeline parallelism at all (SURVEY.md §2.4); this is the TPU-native
+    member of the same trainer family as make_gpt_trainer."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    s_count = max(mesh.shape.get("pipe", 1), 1)
+    if cfg.n_layers % s_count:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={s_count}")
+    per = cfg.n_layers // s_count
+
+    def loss_fn(params, batch):
+        adt = cfg.activation_dtype()
+        tokens = batch["inputs"]
+        t = tokens.shape[1]
+        x = params["embed"].astype(adt)[tokens]
+        x = x + params["pos_embed"].astype(adt)[:t][None]
+        per_stage = [
+            jax.tree.map(lambda p: p[i * per:(i + 1) * per],
+                         params["layers"])
+            for i in range(s_count)
+        ]
+
+        def stage_fn(sp, xm):
+            def body(h, lp):
+                # mesh=None: attention stays local to the stage shard (no
+                # nested seq-axis collectives inside the pipe shard_map)
+                return gpt._block(h, lp, cfg, None), None
+            out, _ = jax.lax.scan(body, xm, sp)
+            return out
+
+        x = pipeline_apply(stage_fn, per_stage, x, mesh=mesh,
+                           num_microbatches=num_microbatches,
+                           batch_spec=P(None, ("data", "fsdp")))
+        x = gpt._rms_norm(x, params["final_ln_scale"].astype(adt))
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(adt),
+                            preferred_element_type=jnp.float32)
+        return jnp.mean(softmax_xent(logits, batch["targets"]))
+
+    return _make_lm_trainer(
+        lambda key: gpt.init_params(key, cfg), gpt.param_logical_axes(cfg),
+        loss_fn, mesh, rng, optimizer, rules)
+
+
 def make_moe_trainer(cfg, mesh: Mesh, rng=None,
                      optimizer: optax.GradientTransformation | None = None,
                      rules: dict | None = None):
