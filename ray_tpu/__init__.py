@@ -103,6 +103,14 @@ def _gc_stale_sessions():
                 pid = int(f.read().strip())
             os.kill(pid, 0)       # raises if the driver is dead
         except (FileNotFoundError, ValueError, ProcessLookupError):
+            for sub in glob.glob(os.path.join(d, "nodes", "*")):
+                shutil.rmtree(
+                    os.path.join(constants.OBJECT_SPILL_ROOT,
+                                 os.path.basename(sub)),
+                    ignore_errors=True)
+            shutil.rmtree(
+                os.path.join(constants.OBJECT_SPILL_ROOT,
+                             os.path.basename(d)), ignore_errors=True)
             shutil.rmtree(d, ignore_errors=True)
         except PermissionError:
             pass                  # someone else's live session

@@ -52,6 +52,36 @@ BUFFER_ALIGNMENT = 64
 # Polling granularity for blocking waits.
 WAIT_POLL_S = 0.01
 
+# How many times a lost task-produced object may be rebuilt from lineage
+# before readers get ObjectLostError (reference: task max retries gate
+# reconstruction, object_recovery_manager.h:41 + task_manager.h:173).
+MAX_OBJECT_RECONSTRUCTIONS = _env_int("MAX_OBJECT_RECONSTRUCTIONS", 3)
+
+# Lineage table caps: specs of recent task-produced objects are kept for
+# reconstruction, bounded BOTH by entry count and by accumulated spec
+# bytes (function blobs + inline args — the reference's
+# RAY_max_lineage_bytes); oldest entries evict first and their objects
+# simply stop being reconstructable.
+MAX_LINEAGE_ENTRIES = _env_int("MAX_LINEAGE_ENTRIES", 100_000)
+MAX_LINEAGE_BYTES = _env_int("MAX_LINEAGE_BYTES", 256 * 1024 * 1024)
+
+# Object spilling (reference: LocalObjectManager + external_storage.py
+# FileSystemStorage): arena-overflow objects and proactively spilled
+# objects land under OBJECT_SPILL_ROOT on real disk — NOT tmpfs — so a
+# session's shm usage is bounded by the arena capacity. The store owner
+# spills sealed objects above SPILL_HIGH_WATER of arena capacity until
+# usage drops below SPILL_LOW_WATER.
+OBJECT_SPILL_ROOT = _env_str("OBJECT_SPILL_ROOT", "/tmp/ray_tpu_spill")
+SPILL_HIGH_WATER = _env_float("SPILL_HIGH_WATER", 0.80)
+SPILL_LOW_WATER = _env_float("SPILL_LOW_WATER", 0.50)
+
+# Memory monitor (reference: memory_monitor.h:52 + worker-killing
+# policies): when host memory usage exceeds the threshold fraction, the
+# newest worker running a retriable task is killed (and retried) instead
+# of letting the OS OOM-killer take down a daemon. 0 disables.
+MEMORY_MONITOR_THRESHOLD = _env_float("MEMORY_MONITOR_THRESHOLD", 0.95)
+MEMORY_MONITOR_INTERVAL_S = _env_float("MEMORY_MONITOR_INTERVAL_S", 1.0)
+
 # How many task submissions a single client may have in flight before
 # submit blocks (simple backpressure; reference has per-lease backlogs).
 MAX_INFLIGHT_SUBMISSIONS = _env_int("MAX_INFLIGHT_SUBMISSIONS", 100000)

@@ -51,14 +51,7 @@ class _DWorker:
     send_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def send(self, msg) -> bool:
-        with self.send_lock:
-            if self.conn is None:
-                return False
-            try:
-                self.conn.send(msg)
-                return True
-            except (OSError, ValueError, BrokenPipeError):
-                return False
+        return protocol.safe_send(self.conn, self.send_lock, msg)
 
 
 class HostDaemon:
@@ -86,6 +79,8 @@ class HostDaemon:
         self._pull_client = PullClient()
         # head_req_id -> (kind, worker, worker_req_id, task_id)
         self._proxy: dict[int, tuple] = {}
+        self._ctl: dict[int, dict] = {}     # daemon's own head RPCs
+        self._ctl_cv = threading.Condition()
         self._shutdown = False
 
         self._listener = connection.Listener(
@@ -99,6 +94,9 @@ class HostDaemon:
 
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="daemon-accept").start()
+        if self.store.arena_stats() is not None:
+            threading.Thread(target=self._spill_loop, daemon=True,
+                             name="daemon-spill").start()
 
     # ------------------------------------------------------------------
     # channels
@@ -182,14 +180,7 @@ class HostDaemon:
                 self.cv.notify_all()
             self._worker_loop(w)
         elif isinstance(reg, protocol.RegisterPeer):
-            send_lock = threading.Lock()
-
-            def psend(msg, _c=conn, _l=send_lock):
-                with _l:
-                    try:
-                        _c.send(msg)
-                    except (OSError, ValueError, BrokenPipeError):
-                        pass
+            psend = protocol.SafeConn(conn)
             while True:
                 try:
                     msg = conn.recv()
@@ -229,9 +220,13 @@ class HostDaemon:
             self._head_send(protocol.PutRequest(
                 msg.object_id, self._tag(msg.desc), origin=w.worker_id))
         elif isinstance(msg, protocol.GetRequest):
-            task_id = next(iter(w.inflight), None)
             hreq = next(self._req)
             with self.lock:
+                # resource release is only attributable when exactly one
+                # task is in flight on this worker (a concurrent actor's
+                # GetRequest doesn't say which method blocked)
+                task_id = (next(iter(w.inflight))
+                           if len(w.inflight) == 1 else None)
                 self._proxy[hreq] = ("get", w, msg.req_id, task_id)
             if task_id is not None:
                 self._head_send(protocol.NodeWorkerBlocked(task_id, True))
@@ -252,7 +247,39 @@ class HostDaemon:
         else:
             logger.warning("unknown worker message %r", type(msg))
 
+    def _head_control(self, method, payload=None, timeout: float = 30.0):
+        """The daemon's OWN control RPC to the head (distinct from the
+        worker-request proxying): e.g. resolving a peer address it was
+        never told about."""
+        hreq = next(self._req)
+        box = {"done": False, "result": None, "error": None}
+        with self._ctl_cv:
+            self._ctl[hreq] = box
+        self._head_send(protocol.ActorCallRequest(hreq, method, payload))
+        deadline = time.monotonic() + timeout
+        with self._ctl_cv:
+            while not box["done"]:
+                rem = deadline - time.monotonic()
+                if rem <= 0 or self._shutdown:
+                    self._ctl.pop(hreq, None)
+                    raise ObjectLostError(
+                        f"head control {method} timed out")
+                self._ctl_cv.wait(min(rem, 0.5))
+        if box["error"] is not None:
+            raise ObjectLostError(
+                f"head control {method} failed: {box['error']}")
+        return box["result"]
+
     def _route_reply(self, msg):
+        if isinstance(msg, protocol.ActorCallReply):
+            with self._ctl_cv:
+                box = self._ctl.pop(msg.req_id, None)
+                if box is not None:
+                    box["result"] = msg.result
+                    box["error"] = msg.error
+                    box["done"] = True
+                    self._ctl_cv.notify_all()
+                    return
         with self.lock:
             entry = self._proxy.pop(msg.req_id, None)
         if entry is None:
@@ -460,7 +487,8 @@ class HostDaemon:
             with self.cv:
                 self._pulling.discard(oid)
                 self.cv.notify_all()
-        self._head_send(protocol.ObjectCopyNote(oid, self.node_id))
+        self._head_send(protocol.ObjectCopyNote(
+            oid, self.node_id, self._tag(local)))
         return local
 
     def _peer_send(self, node_id: str):
@@ -470,17 +498,16 @@ class HostDaemon:
         if entry is not None:
             return entry[0]
         if addr is None:
-            raise ObjectLostError(f"no address for node {node_id}")
+            # never told about this node (it joined after our last lease):
+            # ask the head's membership table
+            addr = self._head_control("node_address", node_id)
+            if addr is None:
+                raise ObjectLostError(f"no address for node {node_id}")
+            with self.lock:
+                self.peer_addrs[node_id] = addr
         conn = connection.Client(addr, family="AF_UNIX",
                                  authkey=self.authkey)
-        lock = threading.Lock()
-
-        def send(msg, _c=conn, _l=lock):
-            with _l:
-                try:
-                    _c.send(msg)
-                except (OSError, ValueError, BrokenPipeError):
-                    pass
+        send = protocol.SafeConn(conn)
         send(protocol.RegisterPeer(self.node_id))
 
         def reader(_c=conn):
@@ -516,6 +543,40 @@ class HostDaemon:
         except (ObjectLostError, OSError) as e:
             payload = e
         serve_pull(send, msg, payload)
+
+    def _spill_loop(self):
+        """Above the arena high-water mark, move sealed local objects to
+        the disk spill dir and re-register their descriptors with the head
+        (LocalObjectManager equivalent on the daemon's own store)."""
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                self._maybe_spill()
+            except Exception:
+                logger.exception("daemon spill pass failed")
+
+    def _maybe_spill(self):
+        from ray_tpu._private.spill import run_spill_pass
+
+        def candidates():
+            with self.lock:
+                return [(oid, d) for oid, d in self._objs.items()
+                        if d.arena]
+
+        def try_swap(oid, old, new):
+            with self.lock:
+                if self._objs.get(oid) != old:
+                    return False
+                self._objs[oid] = new
+                origin = self._origin.get(oid)
+                self._origin[oid] = "daemon"
+                w = self.workers.get(origin) if origin else None
+            # refresh the head's directory so future arg_locations carry
+            # the file-backed descriptor
+            self._head_send(protocol.PutRequest(oid, self._tag(new)))
+            return w
+
+        run_spill_pass(self.store, candidates, try_swap)
 
     def _free_local(self, oid: str):
         with self.lock:
@@ -561,6 +622,7 @@ class HostDaemon:
                     w.proc.kill()
             except OSError:
                 pass
+        self.store.purge_spill()
         self.store.close()
         os._exit(0)
 

@@ -1,0 +1,108 @@
+"""Host memory monitor + worker-killing policy.
+
+Counterpart of the reference's node memory monitor
+(`src/ray/common/memory_monitor.h:52`) and worker-killing policies
+(`worker_killing_policy_retriable_fifo.h`): when host memory usage crosses
+the threshold, kill the newest worker running a retriable task (so the
+work is retried) — or, failing that, the newest busy worker — instead of
+letting the kernel OOM-killer take down the head or a daemon.
+
+Disabled when RAY_TPU_MEMORY_MONITOR_THRESHOLD=0.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ray_tpu._private import constants
+
+logger = logging.getLogger("ray_tpu")
+
+
+def host_memory_fraction() -> float:
+    """Fraction of host memory in use, from /proc/meminfo (MemTotal -
+    MemAvailable) / MemTotal. Returns 0.0 when unreadable."""
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        return 0.0
+    if not total or avail is None:
+        return 0.0
+    return 1.0 - avail / total
+
+
+class MemoryMonitor:
+    """Polls host memory; kills one worker per trip above the threshold.
+    `usage_fn` is injectable for tests."""
+
+    def __init__(self, node_server, threshold: float | None = None,
+                 interval: float | None = None, usage_fn=None):
+        self.node = node_server
+        self.threshold = (constants.MEMORY_MONITOR_THRESHOLD
+                          if threshold is None else threshold)
+        self.interval = (constants.MEMORY_MONITOR_INTERVAL_S
+                         if interval is None else interval)
+        self.usage_fn = usage_fn or host_memory_fraction
+        self.kills = 0
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        if self.threshold <= 0:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="ray_tpu-memmon", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self.node._shutdown:
+            time.sleep(self.interval)
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("memory monitor tick failed")
+
+    def tick(self) -> bool:
+        """One check; returns True if a worker was killed."""
+        usage = self.usage_fn()
+        if usage < self.threshold:
+            return False
+        victim = self.pick_victim()
+        if victim is None:
+            return False
+        w, retriable = victim
+        logger.warning(
+            "memory pressure %.0f%% >= %.0f%%: killing worker %s "
+            "(task %s, %s)", usage * 100, self.threshold * 100,
+            w.worker_id,
+            w.current.spec.task_id if w.current else "?",
+            "will retry" if retriable else "NOT retriable")
+        self.kills += 1
+        try:
+            w.proc.kill()
+        except OSError:
+            return False
+        return True
+
+    def pick_victim(self):
+        """Newest busy worker, preferring ones whose task can retry
+        (retriable-FIFO: kill the most recently started retriable work
+        first — it loses the least progress and costs nothing to redo)."""
+        with self.node.lock:
+            busy = [w for w in self.node.workers.values()
+                    if w.alive and w.current is not None
+                    and w.proc is not None]
+            if not busy:
+                return None
+            retriable = [w for w in busy if w.current.retries_left > 0]
+            pool = retriable or busy
+            return pool[-1], bool(retriable)

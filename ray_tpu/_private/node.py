@@ -54,6 +54,16 @@ logger = logging.getLogger("ray_tpu")
 _EPS = 1e-9
 
 
+def _lineage_size(spec) -> int:
+    """Approximate retained bytes of one lineage entry (blob + inline
+    args + fixed overhead)."""
+    n = len(spec.function_blob or b"") + 256
+    for kind, v in list(spec.args) + list(spec.kwargs.values()):
+        if kind == "v":
+            n += len(v)
+    return n
+
+
 def _fits(avail: dict, req: dict) -> bool:
     return all(avail.get(k, 0.0) + _EPS >= v for k, v in req.items())
 
@@ -80,6 +90,7 @@ class _TaskState:
     node_released: bool = False              # resources released (blocked)
     tpu_chips: list = field(default_factory=list)
     localizing: bool = False                 # remote-arg pull in flight
+    dep_failures: int = 0                    # free requeues on dep pulls
 
 
 @dataclass
@@ -97,14 +108,8 @@ class _WorkerConn:
     alive: bool = True
 
     def send(self, msg) -> bool:
-        with self.send_lock:
-            if self.conn is None:     # spawned but not yet registered
-                return False
-            try:
-                self.conn.send(msg)
-                return True
-            except (OSError, ValueError, BrokenPipeError):
-                return False
+        # conn is None between spawn and registration
+        return protocol.safe_send(self.conn, self.send_lock, msg)
 
 
 @dataclass
@@ -167,12 +172,7 @@ class _RemoteNode:
     released: dict = field(default_factory=dict)
 
     def send(self, msg) -> bool:
-        with self.send_lock:
-            try:
-                self.conn.send(msg)
-                return True
-            except (OSError, ValueError, BrokenPipeError):
-                return False
+        return protocol.safe_send(self.conn, self.send_lock, msg)
 
 
 class NodeServer:
@@ -223,8 +223,19 @@ class NodeServer:
         # whose every copy died with a node.
         self.nodes: dict[str, _RemoteNode] = {}
         self.local_copies: dict[str, Descriptor] = {}
-        self.copy_nodes: dict[str, set] = {}      # oid -> node ids w/ copy
+        # oid -> {node_id: that node's OWN copy descriptor} (backing can
+        # differ from the primary's, so promotion must use it verbatim)
+        self.copy_nodes: dict[str, dict] = {}
         self.lost_objects: dict[str, str] = {}    # oid -> cause
+        # Lineage: producing TaskSpec per live task-returned object, so a
+        # copy lost with its node can be rebuilt by re-executing the task
+        # (reference: lineage pinning in ReferenceCounter + resubmission,
+        # task_manager.h:173, object_recovery_manager.h:41). Entries drop
+        # when the object is freed or the FIFO cap evicts them.
+        self.lineage: "OrderedDict[str, protocol.TaskSpec]" = OrderedDict()
+        self._lineage_bytes = 0                    # accumulated spec bytes
+        self.reconstructions: dict[str, int] = {}  # oid -> rebuild count
+        self.reconstructing: set = set()           # oids being rebuilt
         self._spread_rr = 0
         from ray_tpu._private.pull_plane import PullClient
         self._pull_client = PullClient()
@@ -260,6 +271,12 @@ class NodeServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-accept", daemon=True)
         self._accept_thread.start()
+        if self.store.arena_stats() is not None:
+            threading.Thread(target=self._spill_loop,
+                             name="ray_tpu-spill", daemon=True).start()
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self._memory_monitor = MemoryMonitor(self)
+        self._memory_monitor.start()
         atexit.register(self.shutdown)
 
     # ------------------------------------------------------------------
@@ -398,7 +415,7 @@ class NodeServer:
             with self.lock:
                 if msg.object_id in self.directory:
                     self.copy_nodes.setdefault(
-                        msg.object_id, set()).add(msg.node_id)
+                        msg.object_id, {})[msg.node_id] = msg.desc
         elif isinstance(msg, protocol.PullRequest):
             threading.Thread(target=self._serve_pull, args=(node, msg),
                              daemon=True).start()
@@ -482,6 +499,10 @@ class NodeServer:
                     if n.alive:
                         _add(out, n.available)
                 return out
+        if method == "node_address":
+            with self.lock:
+                n = self.nodes.get(payload)
+                return n.address if n is not None and n.alive else None
         if method == "add_node":
             p = payload or {}
             return self.add_node(p.get("resources"),
@@ -693,6 +714,10 @@ class NodeServer:
         while len(self.freed_refs) > 100_000:
             self.freed_refs.popitem(last=False)
         origin = self.obj_origin.pop(oid, "driver")
+        dropped = self.lineage.pop(oid, None)
+        if dropped is not None:
+            self._lineage_bytes -= _lineage_size(dropped)
+        self.reconstructions.pop(oid, None)
         # head-local cached copy of a remote object
         lc = self.local_copies.pop(oid, None)
         if lc is not None:
@@ -725,6 +750,7 @@ class NodeServer:
         self.directory[object_id] = desc
         self.obj_origin[object_id] = origin
         self.lost_objects.pop(object_id, None)
+        self.reconstructing.discard(object_id)
         if object_id in self.dead_pending:
             self.dead_pending.discard(object_id)
             self._maybe_free_locked(object_id)
@@ -777,7 +803,7 @@ class NodeServer:
                 else:
                     self.cv.wait(1.0)
         if localize:
-            locs = self._localize(locs)
+            locs = self._localize(locs, deadline=deadline)
         return locs
 
     def wait_objects(self, object_ids, num_returns, timeout):
@@ -843,17 +869,28 @@ class NodeServer:
     # cross-node object data plane (object_manager.h:117 equivalent)
     # ------------------------------------------------------------------
 
-    def _localize(self, locs: dict) -> dict:
+    def _localize(self, locs: dict, deadline: float | None = None) -> dict:
         """Return locations readable in the head's store, pulling remote
-        primaries into a head-local cached copy as needed."""
+        primaries into a head-local cached copy as needed. `deadline`
+        (monotonic) bounds the whole pass: a caller's get(timeout=) covers
+        the transfer, not just the directory wait."""
         out = dict(locs)
         for oid, desc in locs.items():
             if desc.inline is not None or desc.node is None:
                 continue
-            out[oid] = self._pull_to_head(oid, desc)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"get() timed out pulling {oid} to the head")
+            out[oid] = self._pull_to_head(oid, desc, deadline)
         return out
 
-    def _pull_to_head(self, oid: str, desc: Descriptor) -> Descriptor:
+    def _pull_to_head(self, oid: str, desc: Descriptor,
+                      deadline: float | None = None) -> Descriptor:
+        def budget(default: float) -> float:
+            if deadline is None:
+                return default
+            return max(min(default, deadline - time.monotonic()), 0.01)
+
         with self.cv:
             while True:
                 lc = self.local_copies.get(oid)
@@ -862,31 +899,80 @@ class NodeServer:
                 if oid not in self._head_pulling:
                     self._head_pulling.add(oid)
                     break
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"get() timed out awaiting pull of {oid}")
                 self.cv.wait(0.2)
         try:
-            with self.lock:
-                node = self.nodes.get(desc.node)
-            if node is None or not node.alive:
-                raise ObjectLostError(
-                    f"object {oid} lives on dead node {desc.node}")
-            payload = self._pull_bytes(node, oid)
-            local = self.store.put_serialized(oid, payload)
-            with self.lock:
-                # freed while we pulled? drop the stray copy immediately
-                if oid in self.freed_refs:
-                    self.store.delete(local)
-                    raise ObjectFreedError(
-                        f"object {oid} was freed during pull")
-                self.local_copies[oid] = local
-            return local
+            for _attempt in range(4):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"get() timed out pulling {oid}")
+                try:
+                    with self.lock:
+                        node = self.nodes.get(desc.node)
+                    if node is None or not node.alive:
+                        raise ObjectLostError(
+                            f"object {oid} lives on dead node {desc.node}")
+                    payload = self._pull_bytes(node, oid,
+                                               timeout=budget(120.0))
+                    local = self.store.put_serialized(oid, payload)
+                    with self.lock:
+                        # freed while we pulled? drop the stray copy now
+                        if oid in self.freed_refs:
+                            self.store.delete(local)
+                            raise ObjectFreedError(
+                                f"object {oid} was freed during pull")
+                        self.local_copies[oid] = local
+                    return local
+                except ObjectLostError:
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        with self.lock:
+                            n = self.nodes.get(desc.node)
+                            source_alive = n is not None and n.alive
+                        if source_alive:
+                            # the caller's budget expired mid-transfer of
+                            # a healthy object: that's a timeout, not loss
+                            raise GetTimeoutError(
+                                f"get() timed out pulling {oid}")
+                    # the source died mid-pull: wait for a promoted copy
+                    # or a reconstructed re-registration, then retry
+                    desc = self._await_fresh_desc(oid, desc,
+                                                  timeout=budget(60.0))
+                    if desc.node is None or desc.inline is not None:
+                        return desc     # now head-local (or error value)
+            raise ObjectLostError(f"pull of {oid} kept failing")
         finally:
             with self.cv:
                 self._head_pulling.discard(oid)
                 self.cv.notify_all()
 
-    def _pull_bytes(self, node: _RemoteNode, oid: str) -> bytes:
+    def _await_fresh_desc(self, oid: str, stale: Descriptor,
+                          timeout: float = 60.0) -> Descriptor:
+        """Block until the directory carries a different descriptor for
+        `oid` (promotion to a surviving copy, or lineage reconstruction);
+        raise ObjectLostError if it is terminally lost."""
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            while True:
+                if oid in self.lost_objects:
+                    raise ObjectLostError(
+                        f"object {oid} lost: {self.lost_objects[oid]}")
+                d = self.directory.get(oid)
+                if d is not None and d != stale:
+                    return d
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise ObjectLostError(
+                        f"object {oid} unavailable: source died and no "
+                        "replacement appeared")
+                self.cv.wait(min(rem, 0.5))
+
+    def _pull_bytes(self, node: _RemoteNode, oid: str,
+                    timeout: float = 120.0) -> bytes:
         return self._pull_client.pull(
-            node.send, oid,
+            node.send, oid, timeout=timeout,
             abort_check=lambda: None if node.alive
             else f"hit dead node {node.node_id}")
 
@@ -976,6 +1062,26 @@ class NodeServer:
                 # actor path (resources incl.) is driven by NodeActorDied
                 retry = False
                 t = None
+            elif (msg.error.startswith("dependency pull failed")
+                  and t.dep_failures < 10):
+                # not the task's fault: requeue WITHOUT consuming a retry,
+                # re-blocking on args whose directory entry is gone (they
+                # may be reconstructing; if terminally lost, the stored
+                # ObjectLostError value fails the task through normal dep
+                # poisoning on the next dispatch). dep_failures caps a
+                # persistent pull failure with an intact directory entry —
+                # otherwise this would hot-loop forever.
+                t.dep_failures += 1
+                self._release_task_resources(t)
+                t.node = None
+                for kind, v in (list(spec.args)
+                                + list(spec.kwargs.values())):
+                    if kind == "ref" and v not in self.directory:
+                        t.deps.add(v)
+                        self.obj_waiting_tasks.setdefault(v, []).append(t)
+                self.pending.append(t)
+                self.task_events.requeued(spec)
+                retry = True
             else:
                 self._release_task_resources(t)
                 t.node = None
@@ -1012,6 +1118,10 @@ class NodeServer:
             t = node.inflight.get(msg.task_id)
             if t is None:
                 return
+            if t.spec.placement_group_id:
+                # PG tasks debited a bundle, not node.available; releasing
+                # into the node pool would leak the bundle slot on death
+                return
             held = dict(t.spec.resources)
             if msg.blocked and not t.node_released:
                 t.node_released = True
@@ -1027,6 +1137,7 @@ class NodeServer:
         to_fail = []
         dead_actors = []
         lost_oids = []
+        rebuild_oids = []
         with self.lock:
             if not node.alive:
                 return
@@ -1064,19 +1175,48 @@ class NodeServer:
                     self.obj_origin[oid] = "driver"
                     continue
                 survivors = [
-                    nid for nid in self.copy_nodes.get(oid, ())
-                    if nid != node.node_id
+                    (nid, d) for nid, d in self.copy_nodes.get(
+                        oid, {}).items()
+                    if nid != node.node_id and d is not None
                     and (n2 := self.nodes.get(nid)) is not None and n2.alive]
                 if survivors:
-                    self.directory[oid] = replace(desc, node=survivors[0])
-                    self.obj_origin[oid] = "node:" + survivors[0]
+                    # promote the survivor's own descriptor — its backing
+                    # (arena vs file) can differ from the dead primary's
+                    nid, d = survivors[0]
+                    self.directory[oid] = d
+                    self.obj_origin[oid] = "node:" + nid
                     continue
                 del self.directory[oid]
                 self.obj_origin.pop(oid, None)
-                self.lost_objects[oid] = f"node {node.node_id} died"
-                lost_oids.append(oid)
-            for oid, s in list(self.copy_nodes.items()):
-                s.discard(node.node_id)
+                if (oid in self.lineage
+                        and self.reconstructions.get(oid, 0)
+                        < constants.MAX_OBJECT_RECONSTRUCTIONS):
+                    # rebuildable: leave a directory hole (readers keep
+                    # waiting) and resubmit the producing task below
+                    rebuild_oids.append(oid)
+                else:
+                    self.lost_objects[oid] = f"node {node.node_id} died"
+                    lost_oids.append(oid)
+            for oid, copies in list(self.copy_nodes.items()):
+                copies.pop(node.node_id, None)
+            if rebuild_oids:
+                # tasks whose deps were already satisfied would otherwise
+                # dispatch into the directory hole and fail; re-block them
+                # until the reconstructed object re-registers
+                rb = set(rebuild_oids)
+
+                def _reblock(t):
+                    for kind, v in (list(t.spec.args)
+                                    + list(t.spec.kwargs.values())):
+                        if kind == "ref" and v in rb and v not in t.deps:
+                            t.deps.add(v)
+                            self.obj_waiting_tasks.setdefault(
+                                v, []).append(t)
+                for t in self.pending:
+                    _reblock(t)
+                for a2 in self.actors.values():
+                    for t in a2.queue:
+                        _reblock(t)
             # placement-group bundles reserved on the node can no longer
             # host anything (the reference reschedules bundles; v1 marks
             # them unavailable so dispatch skips them)
@@ -1097,6 +1237,8 @@ class NodeServer:
                 ObjectLostError(
                     f"object {oid} lost: node {node.node_id} died and no "
                     "other copy exists"))
+        for oid in rebuild_oids:
+            self._reconstruct(oid)
         for a in dead_actors:
             self._on_actor_death(a)
         for t in to_fail:
@@ -1107,6 +1249,86 @@ class NodeServer:
                     f"{t.spec.function_desc}"),
                 spec=t.spec)
         self._schedule()
+
+    # ------------------------------------------------------------------
+    # object spilling (LocalObjectManager equivalent,
+    # local_object_manager.h:110): above the arena high-water mark, sealed
+    # head-primary objects move to disk; their directory descriptor flips
+    # to file-backed, and the arena block is released (origin worker drops
+    # its owner pin via FreeObject).
+    # ------------------------------------------------------------------
+
+    def _spill_loop(self):
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                self._maybe_spill()
+            except Exception:
+                logger.exception("spill pass failed")
+
+    def _maybe_spill(self):
+        from ray_tpu._private.spill import run_spill_pass
+
+        def candidates():
+            with self.lock:
+                return [(oid, desc) for oid, desc in self.directory.items()
+                        if desc.node is None and desc.arena]
+
+        def try_swap(oid, old, new):
+            with self.lock:
+                if self.directory.get(oid) != old:
+                    return False
+                self.directory[oid] = new
+                origin = self.obj_origin.get(oid, "driver")
+                self.obj_origin[oid] = "driver"
+                if origin == "driver" or origin.startswith("node:"):
+                    return None
+                return self.workers.get(origin)
+
+        run_spill_pass(self.store, candidates, try_swap)
+
+    def _reconstruct(self, oid: str) -> bool:
+        """Rebuild a lost task-produced object by re-executing its
+        producing task (lineage resubmission, object_recovery_manager.h:41
+        + TaskResubmissionInterface, task_manager.h:173). Recurses into
+        lost arguments. Returns False if the object cannot be rebuilt (an
+        ObjectLostError value is stored instead)."""
+        with self.lock:
+            if oid in self.directory:
+                return True           # raced with promotion/re-register
+            if oid in self.reconstructing:
+                return True           # a resubmission is already in flight
+            spec = self.lineage.get(oid)
+            n = self.reconstructions.get(oid, 0)
+            if spec is None or n >= constants.MAX_OBJECT_RECONSTRUCTIONS:
+                cause = ("no lineage" if spec is None
+                         else f"exceeded {n} reconstructions")
+                self.lost_objects[oid] = cause
+            else:
+                cause = None
+                # one resubmit rebuilds ALL the task's returns
+                for rid in spec.return_ids:
+                    self.reconstructions[rid] = max(
+                        self.reconstructions.get(rid, 0), n + 1)
+                    self.reconstructing.add(rid)
+                # fresh task_id so event records and the exactly-once
+                # arg-release guard treat this as a new execution
+                clone = protocol.TaskSpec(
+                    **{**spec.__dict__, "task_id": ids.new_task_id()})
+                missing = [
+                    v for kind, v in (list(clone.args)
+                                      + list(clone.kwargs.values()))
+                    if kind == "ref" and v not in self.directory]
+        if cause is not None:
+            self._store_error(
+                [oid], ObjectLostError(f"object {oid} lost: {cause}"))
+            return False
+        logger.warning("reconstructing %s by re-running %s",
+                       oid, clone.function_desc)
+        for v in missing:
+            self._reconstruct(v)      # lineage chain: rebuild inputs first
+        self.submit(clone)
+        return True
 
     # ------------------------------------------------------------------
     # node management (add/kill; the Cluster fixture + autoscaler seam)
@@ -1188,6 +1410,20 @@ class NodeServer:
                     self.obj_waiting_tasks.setdefault(v, []).append(t)
             self.task_events.submitted(spec, bool(t.deps))
             self._pin_task_args_locked(spec)
+            if not spec.actor_creation and spec.actor_id is None:
+                # lineage: remember how to rebuild these returns (actor
+                # method outputs are not reconstructable, as in the
+                # reference)
+                size = _lineage_size(spec)
+                for oid in spec.return_ids:
+                    self.lineage[oid] = spec
+                    self._lineage_bytes += size
+                while self.lineage and (
+                        len(self.lineage) > constants.MAX_LINEAGE_ENTRIES
+                        or self._lineage_bytes
+                        > constants.MAX_LINEAGE_BYTES):
+                    _old_oid, old_spec = self.lineage.popitem(last=False)
+                    self._lineage_bytes -= _lineage_size(old_spec)
             submitter_id = (submitter if isinstance(submitter, str)
                             else getattr(submitter, "worker_id", None))
             if submitter_id is not None:
@@ -1424,40 +1660,50 @@ class NodeServer:
                     return cand, i
         return None, None
 
+    def _choose_target(self, t: _TaskState, req: dict, n_tpu: int, pg):
+        """Resolve where a task/actor should run: ("head"|node_id|
+        "__infeasible__"|None, bundle_idx|None). Caller holds the lock."""
+        if pg is not None:
+            return self._pick_bundle_target(req, n_tpu, pg)
+        return self._pick_node(t.spec), None
+
+    def _debit_target(self, target: str, idx, req: dict, n_tpu: int,
+                      pg) -> list:
+        """Debit `req` from the chosen pool (PG bundle, node, or head) and
+        carve TPU chips from the target host; returns the chip list.
+        Caller holds the lock and has verified fit (incl. chip count)."""
+        if pg is not None:
+            _sub(pg.available[idx], req)
+        elif target == "head":
+            _sub(self.available, req)
+        else:
+            _sub(self.nodes[target].available, req)
+        pool = (self.free_tpu_chips if target == "head"
+                else self.nodes[target].free_tpu_chips)
+        chips = pool[:n_tpu]
+        del pool[:n_tpu]
+        return chips
+
     def _try_dispatch_generic(self, t: _TaskState, to_send):
         """True=dispatched, False=doesn't fit anywhere right now,
         None=head has the resources but no idle worker (caller spawns)."""
         req = t.spec.resources
         n_tpu = int(req.get("TPU", 0))
         pg = self.placement_groups.get(t.spec.placement_group_id or "")
-        target = None
-        idx = None
-        if pg is not None:
-            target, idx = self._pick_bundle_target(req, n_tpu, pg)
-            if target is None:
-                return False
-        else:
-            target = self._pick_node(t.spec)
-            if target is None:
-                return False
-            if target == "__infeasible__":
-                self._store_error(
-                    t.spec.return_ids,
-                    SchedulingError(
-                        f"task {t.spec.function_desc} has hard node "
-                        "affinity to a dead or unknown node"),
-                    spec=t.spec)
-                return True     # consumed: removed from pending as failed
+        target, idx = self._choose_target(t, req, n_tpu, pg)
+        if target is None:
+            return False
+        if target == "__infeasible__":
+            self._store_error(
+                t.spec.return_ids,
+                SchedulingError(
+                    f"task {t.spec.function_desc} has hard node "
+                    "affinity to a dead or unknown node"),
+                spec=t.spec)
+            return True     # consumed: removed from pending as failed
         if target != "head":
-            node = self.nodes[target]
-            if pg is not None:
-                _sub(pg.available[idx], req)
-            else:
-                _sub(node.available, req)
-            if n_tpu:
-                t.tpu_chips = node.free_tpu_chips[:n_tpu]
-                del node.free_tpu_chips[:n_tpu]
-            self._lease_to_node(node, t, to_send)
+            t.tpu_chips = self._debit_target(target, idx, req, n_tpu, pg)
+            self._lease_to_node(self.nodes[target], t, to_send)
             return True
         if self._needs_localize_locked(t):
             return False
@@ -1466,14 +1712,7 @@ class NodeServer:
             # process initializes JAX (the reference's CUDA_VISIBLE_DEVICES
             # is equally process-birth-scoped for safety), so they run on a
             # dedicated fresh worker that retires afterwards, not the pool.
-            if len(self.free_tpu_chips) < n_tpu:
-                return False
-            if pg is not None:
-                _sub(pg.available[idx], req)
-            else:
-                _sub(self.available, req)
-            t.tpu_chips = self.free_tpu_chips[:n_tpu]
-            del self.free_tpu_chips[:n_tpu]
+            t.tpu_chips = self._debit_target("head", idx, req, n_tpu, pg)
             threading.Thread(target=self._spawn_tpu_worker, args=(t,),
                              daemon=True).start()
             return True
@@ -1481,11 +1720,7 @@ class NodeServer:
                        if w.kind == "generic" and w.idle and w.alive), None)
         if worker is None:
             return None
-        if pg is not None:
-            _sub(pg.available[idx], req)
-        else:
-            _sub(self.available, req)
-        t.tpu_chips = []
+        t.tpu_chips = self._debit_target("head", idx, req, 0, pg)
         worker.idle = False
         worker.current = t
         to_send.append((worker, self._push_msg(worker, t)))
@@ -1543,44 +1778,23 @@ class NodeServer:
         req = a.resources
         n_tpu = int(req.get("TPU", 0))
         pg = self.placement_groups.get(t.spec.placement_group_id or "")
-        target = None
-        idx = None
-        if pg is not None:
-            target, idx = self._pick_bundle_target(req, n_tpu, pg)
-            if target is None:
-                return False
-        else:
-            target = self._pick_node(t.spec)
-            if target is None:
-                return False
-            if target == "__infeasible__":
-                self._fail_actor(
-                    a, "actor has hard node affinity to a dead or "
-                       "unknown node")
-                return True     # consumed: removed from pending as failed
+        target, idx = self._choose_target(t, req, n_tpu, pg)
+        if target is None:
+            return False
+        if target == "__infeasible__":
+            self._fail_actor(
+                a, "actor has hard node affinity to a dead or unknown node")
+            return True         # consumed: removed from pending as failed
         if target != "head":
-            node = self.nodes[target]
-            if pg is not None:
-                _sub(pg.available[idx], req)
-            else:
-                _sub(node.available, req)
-            if n_tpu:
-                a.tpu_chips = node.free_tpu_chips[:n_tpu]
-                del node.free_tpu_chips[:n_tpu]
+            a.tpu_chips = self._debit_target(target, idx, req, n_tpu, pg)
             a.node = target
             t.tpu_chips = list(a.tpu_chips)
             a.inflight.append(t)
-            self._lease_to_node(node, t, to_send)
+            self._lease_to_node(self.nodes[target], t, to_send)
             return True
         if self._needs_localize_locked(t):
             return False
-        if pg is not None:
-            _sub(pg.available[idx], req)
-        else:
-            _sub(self.available, req)
-        if n_tpu and len(self.free_tpu_chips) >= n_tpu:
-            a.tpu_chips = self.free_tpu_chips[:n_tpu]
-            del self.free_tpu_chips[:n_tpu]
+        a.tpu_chips = self._debit_target("head", idx, req, n_tpu, pg)
         threading.Thread(target=self._spawn_actor_worker, args=(a, t),
                          daemon=True).start()
         return True
@@ -2172,6 +2386,11 @@ class NodeServer:
                         w.proc.kill()
             except OSError:
                 pass
+        self.store.purge_spill()
+        for node in nodes:
+            # SIGKILLed daemons can't purge their own spill dirs
+            shutil.rmtree(os.path.join(constants.OBJECT_SPILL_ROOT,
+                                       node.node_id), ignore_errors=True)
         self.store.close()
         shutil.rmtree(self.session_dir, ignore_errors=True)
         atexit.unregister(self.shutdown)

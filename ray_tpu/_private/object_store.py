@@ -28,10 +28,11 @@ counterpart of the ownership-based object directory
 
 import mmap
 import os
+import shutil
 import threading
 from dataclasses import dataclass
 
-from ray_tpu._private import serialization
+from ray_tpu._private import constants, serialization
 from ray_tpu._private.constants import INLINE_OBJECT_MAX_BYTES
 from ray_tpu.exceptions import ObjectLostError
 
@@ -59,6 +60,13 @@ class ObjectStore:
     def __init__(self, session_dir: str):
         self._dir = os.path.join(session_dir, "objects")
         os.makedirs(self._dir, exist_ok=True)
+        # Arena-overflow and spilled objects go to real disk, not tmpfs, so
+        # shm usage stays bounded by the arena capacity (reference:
+        # external_storage.py:246 FileSystemStorage). Paths are absolute in
+        # descriptors, so any local process can read another's spill files.
+        self._spill_dir = os.path.join(
+            constants.OBJECT_SPILL_ROOT,
+            os.path.basename(session_dir.rstrip("/")))
         # Keep mmaps alive while deserialized views may reference them.
         # obj_id -> (mmap, file size) for file-backed objects only.
         self._maps: dict[str, mmap.mmap] = {}
@@ -96,7 +104,7 @@ class ObjectStore:
                 with self._lock:
                     self._owned.add(object_id)
                 return Descriptor(object_id, n, arena=True)
-        path = os.path.join(self._dir, object_id)
+        path = self._spill_path(object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb+") as f:
             f.truncate(size)
@@ -121,12 +129,27 @@ class ObjectStore:
                 with self._lock:
                     self._owned.add(object_id)
                 return Descriptor(object_id, len(payload), arena=True)
-        path = os.path.join(self._dir, object_id)
+        return self.spill_payload(object_id, payload)
+
+    def _spill_path(self, object_id: str) -> str:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        return os.path.join(self._spill_dir, object_id)
+
+    def spill_payload(self, object_id: str, payload) -> Descriptor:
+        """Write a serialized envelope to the disk spill dir and return its
+        file-backed descriptor (reference: LocalObjectManager::SpillObjects,
+        local_object_manager.h:110)."""
+        path = self._spill_path(object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb") as f:
             f.write(payload)
         os.rename(tmp, path)
         return Descriptor(object_id, len(payload), path=path)
+
+    def purge_spill(self) -> None:
+        """Remove this store's spill dir (store OWNER only — head on
+        shutdown, daemon on exit; readers must never call this)."""
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     # -- read path ----------------------------------------------------------
 
@@ -181,6 +204,11 @@ class ObjectStore:
             return f.read()
 
     # -- lifecycle ----------------------------------------------------------
+
+    def arena_stats(self) -> dict | None:
+        """{capacity, used, num_objects, num_evictions} or None without a
+        native arena (drives the spill high-water check)."""
+        return self._arena.stats() if self._arena is not None else None
 
     def adopt(self, object_id: str) -> bool:
         """Take over the owner pin of an arena object whose origin process

@@ -7,10 +7,38 @@ channel (multiprocessing.connection); the field set intentionally mirrors the
 reference's TaskSpec so a future gRPC/C++ transport can adopt it 1:1.
 """
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any
 
 from ray_tpu._private.object_store import Descriptor
+
+
+def safe_send(conn, lock, msg) -> bool:
+    """Best-effort locked send on an mp.Connection: False on a dead/absent
+    peer instead of raising. The single implementation behind every
+    channel's `send` (head<->worker, head<->daemon, daemon<->peer)."""
+    with lock:
+        if conn is None:
+            return False
+        try:
+            conn.send(msg)
+            return True
+        except (OSError, ValueError, BrokenPipeError):
+            return False
+
+
+class SafeConn:
+    """Callable wrapper bundling a connection with its send lock."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._lock = threading.Lock()
+
+    def __call__(self, msg) -> bool:
+        return safe_send(self.conn, self._lock, msg)
+
+    send = __call__
 
 
 @dataclass
@@ -274,9 +302,12 @@ class RegisterPeer:
 class ObjectCopyNote:
     """Daemon -> head: this node cached a copy of the object (enables
     promotion to primary if the owner node dies — object recovery from
-    another copy, object_recovery_manager.h:41)."""
+    another copy, object_recovery_manager.h:41). `desc` is the copy's OWN
+    descriptor (tagged with node_id): the copy's backing (arena vs file)
+    can differ from the primary's, so promotion must use it verbatim."""
     object_id: str
     node_id: str
+    desc: Descriptor | None = None
 
 
 @dataclass

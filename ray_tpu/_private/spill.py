@@ -1,0 +1,72 @@
+"""Shared spill pass used by the head NodeServer and HostDaemons.
+
+One implementation of the LocalObjectManager state machine
+(local_object_manager.h:110): above the arena high-water mark, copy sealed
+arena objects to the disk spill dir, swap the authoritative descriptor,
+then release the arena block (drop this process's pins + tell the origin
+worker to drop its owner pin). The swap-or-unlink race check and the
+pin-release ordering live here exactly once; callers supply the candidate
+list and the descriptor-swap callback.
+
+Readers racing a spill (they hold the OLD arena descriptor) recover by
+re-fetching the location from their node server — see the retry in
+worker_main.get_objects / _resolve_args.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ray_tpu._private import constants
+from ray_tpu.exceptions import ObjectLostError
+
+logger = logging.getLogger("ray_tpu")
+
+
+def run_spill_pass(store, list_candidates, try_swap) -> int:
+    """One high-water check + spill-until-low-water pass.
+
+    - `store`: the owning process's ObjectStore.
+    - `list_candidates()` -> [(oid, arena_desc), ...] (called once).
+    - `try_swap(oid, old_desc, new_desc)` -> worker_conn | None | False:
+      atomically (under the caller's lock) replace the authoritative
+      descriptor IF it still equals old_desc; return False if it changed
+      (the pass unlinks the orphaned spill file), else the origin worker
+      connection holding the owner pin (or None if this process owns it).
+
+    Returns the number of objects spilled.
+    """
+    from ray_tpu._private import protocol
+
+    st = store.arena_stats()
+    if st is None or st["capacity"] == 0:
+        return 0
+    if st["used"] < constants.SPILL_HIGH_WATER * st["capacity"]:
+        return 0
+    target = constants.SPILL_LOW_WATER * st["capacity"]
+    spilled = 0
+    for oid, desc in list_candidates():
+        st = store.arena_stats()
+        if st["used"] <= target:
+            break
+        try:
+            payload = store.raw_bytes(desc)
+        except (ObjectLostError, OSError):
+            continue
+        new_desc = store.spill_payload(oid, payload)
+        origin_worker = try_swap(oid, desc, new_desc)
+        if origin_worker is False:
+            try:
+                os.unlink(new_desc.path)
+            except OSError:
+                pass
+            continue
+        store.delete(desc)              # drop THIS process's pins
+        if origin_worker is not None and origin_worker.alive:
+            origin_worker.send(protocol.FreeObject(oid, desc))
+        spilled += 1
+    if spilled:
+        logger.info("spilled %d arena objects to %s", spilled,
+                    store._spill_dir)
+    return spilled

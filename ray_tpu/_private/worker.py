@@ -185,8 +185,18 @@ class DriverClient(BaseClient):
         self.job_id = ids.new_job_id()
 
     def get_values(self, object_ids, timeout=None):
+        from ray_tpu.exceptions import ObjectLostError
         locs = self.node.get_locations(object_ids, timeout)
-        return [self.node.store.get(locs[o]) for o in object_ids]
+        out = []
+        for o in object_ids:
+            try:
+                out.append(self.node.store.get(locs[o]))
+            except ObjectLostError:
+                # the descriptor went stale under us (spill/promotion
+                # swapped the directory entry): one fresh lookup
+                fresh = self.node.get_locations([o], timeout)
+                out.append(self.node.store.get(fresh[o]))
+        return out
 
     def put(self, value):
         return self.node.put_value(value)

@@ -122,9 +122,27 @@ class WorkerRuntime:
             raise cls(detail or reply.error)
         out = []
         for oid in object_ids:
-            value = self.store.get(reply.locations[oid])
-            out.append(value)
+            out.append(self._read_with_refresh(oid, reply.locations[oid]))
         return out
+
+    def _read_with_refresh(self, oid, desc, retries: int = 2):
+        """Read a descriptor, re-fetching the location on a miss: a spill
+        or copy-promotion may have moved the bytes after this descriptor
+        was handed out (the spiller swaps the directory entry first, so a
+        fresh location always resolves)."""
+        from ray_tpu.exceptions import ObjectLostError
+        for attempt in range(retries + 1):
+            try:
+                return self.store.get(desc)
+            except ObjectLostError:
+                if attempt == retries:
+                    raise
+                reply = self.request(lambda rid: protocol.GetRequest(
+                    rid, [oid], 30.0))
+                if reply.timed_out or getattr(reply, "error", None) \
+                        or oid not in reply.locations:
+                    raise
+                desc = reply.locations[oid]
 
     def put_object(self, value) -> str:
         from ray_tpu._private import ids
@@ -192,7 +210,14 @@ class WorkerRuntime:
     def _resolve_args(self, spec, arg_locations):
         def one(kind, v):
             if kind == "ref":
-                value = self.store.get(arg_locations[v])
+                loc = arg_locations.get(v)
+                if loc is None:
+                    # directory hole at push time (object lost mid-flight):
+                    # fetch a fresh location — it resolves once the object
+                    # is reconstructed or raises the terminal error
+                    value = self.get_objects([v])[0]
+                else:
+                    value = self._read_with_refresh(v, loc)
             else:
                 value = serialization.loads(v)
             return value

@@ -34,6 +34,47 @@ from ray_tpu.data._internal import plan as plan_mod
 from ray_tpu.data.block import BlockAccessor, BlockMetadata, concat_blocks
 
 _DEFAULT_IN_FLIGHT = 8
+_DEFAULT_BYTES_IN_FLIGHT = 128 * 1024 * 1024
+
+
+def _item_bytes(item, ctx) -> int:
+    """Estimated input bytes of one work item: exact for (ref, meta) pairs
+    (block already in the store), estimated for ReadTask thunks (reference:
+    streaming executors budget on block-size estimates too)."""
+    if isinstance(item, tuple):
+        size = getattr(item[1], "size_bytes", None)
+        if size:
+            return int(size)
+    est = getattr(item, "estimated_size_bytes", None)
+    return int(est) if est else ctx.default_block_size_estimate
+
+
+class _InFlightBudget:
+    """Task-slot AND byte budget for one operator's outstanding tasks
+    (streaming_executor_state.py resource-budget equivalent): admit while
+    BOTH under budget; always admit at least one task so a single
+    over-budget block can't deadlock the pipeline."""
+
+    def __init__(self, ctx, max_tasks: int):
+        self.max_tasks = max_tasks
+        self.max_bytes = (ctx.max_bytes_in_flight
+                          or _DEFAULT_BYTES_IN_FLIGHT)
+        self.tasks = 0
+        self.bytes = 0
+
+    def admit(self, nbytes: int) -> bool:
+        if self.tasks == 0:
+            return True
+        return (self.tasks < self.max_tasks
+                and self.bytes + nbytes <= self.max_bytes)
+
+    def add(self, nbytes: int):
+        self.tasks += 1
+        self.bytes += nbytes
+
+    def remove(self, nbytes: int):
+        self.tasks -= 1
+        self.bytes -= nbytes
 
 
 # ---------------------------------------------------------------------------
@@ -162,14 +203,19 @@ def _task_map_stream(inputs, stages, op: plan_mod.MapOp | None):
     if opts:
         fn = fn.options(**opts)
     from ray_tpu.data.context import DataContext
-    ctx_max = (DataContext.get_current().max_tasks_per_operator
-               or _DEFAULT_IN_FLIGHT)
-    window: list = []
+    ctx = DataContext.get_current()
+    budget = _InFlightBudget(
+        ctx, ctx.max_tasks_per_operator or _DEFAULT_IN_FLIGHT)
+    window: list = []          # (task_ref, input_bytes)
     for item in inputs:
-        window.append(fn.remote(stages, _submit_arg(item)))
-        if len(window) >= ctx_max:
-            yield _result(window.pop(0))
-    for ref in window:
+        nbytes = _item_bytes(item, ctx)
+        while not budget.admit(nbytes):
+            ref, nb = window.pop(0)
+            budget.remove(nb)
+            yield _result(ref)
+        window.append((fn.remote(stages, _submit_arg(item)), nbytes))
+        budget.add(nbytes)
+    for ref, _nb in window:
         yield _result(ref)
 
 
@@ -189,14 +235,22 @@ def _actor_map_stream(inputs, stages, op: plan_mod.MapOp):
     try:
         ray_tpu.get([a.ready.remote() for a in actors], timeout=120)
         per_actor = max(1, strat.max_tasks_in_flight_per_actor)
+        from ray_tpu.data.context import DataContext
+        ctx = DataContext.get_current()
+        budget = _InFlightBudget(ctx, size * per_actor)
         window: list = []
         rr = itertools.cycle(range(size))
         for item in inputs:
+            nbytes = _item_bytes(item, ctx)
+            while not budget.admit(nbytes):
+                ref, nb = window.pop(0)
+                budget.remove(nb)
+                yield _result(ref)
             actor = actors[next(rr)]
-            window.append(actor.apply.remote(stages, _submit_arg(item)))
-            if len(window) >= size * per_actor:
-                yield _result(window.pop(0))
-        for ref in window:
+            window.append(
+                (actor.apply.remote(stages, _submit_arg(item)), nbytes))
+            budget.add(nbytes)
+        for ref, _nb in window:
             yield _result(ref)
     finally:
         for a in actors:

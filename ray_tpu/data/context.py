@@ -12,10 +12,15 @@ from dataclasses import dataclass
 
 @dataclass
 class DataContext:
-    # Max concurrent tasks per operator: the backpressure bound (the
-    # reference budgets bytes in streaming_executor_state; ours is task
-    # slots — the object store is node-local tmpfs, so slots ~ blocks).
+    # Backpressure bounds, both enforced by the executor (reference:
+    # streaming_executor_state.py byte budgets): at most
+    # max_tasks_per_operator tasks AND max_bytes_in_flight input bytes may
+    # be outstanding per operator. The byte budget is what keeps a
+    # pipeline whose working set exceeds the shm arena from overcommitting
+    # it (blocks of unknown size count as default_block_size_estimate).
     max_tasks_per_operator: int | None = None    # None = default (8)
+    max_bytes_in_flight: int | None = None       # None = default (128 MiB)
+    default_block_size_estimate: int = 8 * 1024 * 1024
     # Default parallelism for read_*/from_* when the call passes -1.
     read_parallelism: int = -1                   # -1 = #CPUs
     enable_operator_fusion: bool = True

@@ -233,6 +233,37 @@ def test_nested_submission_from_node_worker(cluster):
     assert val == 42
 
 
+def _train_loop_report_host(config):
+    from ray_tpu.train import session
+    rank = session.get_world_rank()
+    # metrics_history only carries rank 0's reports (reference behavior),
+    # so every rank records its host on the shared filesystem instead
+    with open(os.path.join(config["out"], f"rank{rank}.txt"), "w") as f:
+        f.write(where())
+    session.report({"host": where(), "rank": rank})
+
+
+def test_trainer_spans_nodes(cluster, tmp_path):
+    """JaxTrainer with STRICT_SPREAD places its worker gang on distinct
+    nodes and completes the jax.distributed rendezvous across them — the
+    multi-host Train path (worker_group.py setup_distributed seam)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    trainer = JaxTrainer(
+        _train_loop_report_host,
+        train_loop_config={"out": str(tmp_path)},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1},
+            placement_strategy="STRICT_SPREAD"),
+        run_config=RunConfig(name="span", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    hosts = {open(os.path.join(tmp_path, f"rank{r}.txt")).read()
+             for r in range(2)}
+    assert len(hosts) == 2, hosts
+
+
 class TestNodeFailure:
     """Chaos: SIGKILL a whole daemon (its workers die with it) and assert
     recovery — the NodeKillerActor pattern (test_utils.py:1400)."""
@@ -267,18 +298,68 @@ class TestNodeFailure:
         def produce(tag):
             return np.full(BIG, tag, np.uint8)
 
+        @ray_tpu.remote(resources={"purple": 1})
+        def put_obj():
+            # ray_tpu.put inside a task: the object lives in the node's
+            # store with NO lineage (puts are not reconstructable, as in
+            # the reference) — losing the node loses it for good
+            return ray_tpu.put(np.full(BIG, 4, np.uint8))
+
         # (a) object pulled to head before the kill survives via promotion
         survivor = produce.remote(3)
         a = ray_tpu.get(survivor, timeout=60)    # head now caches a copy
-        # (b) object never pulled is lost with the node
-        doomed = produce.remote(4)
-        time.sleep(1.0)  # let doomed finish sealing on the node
+        # (b) a put object never pulled is lost with the node
+        doomed = ray_tpu.get(put_obj.remote(), timeout=60)
+        time.sleep(1.0)  # let it finish sealing on the node
         c.kill_node(n1)
         time.sleep(0.5)
         again = ray_tpu.get(survivor, timeout=60)
         assert int(again[0]) == 3 and np.array_equal(a, again)
         with pytest.raises(ObjectLostError):
             ray_tpu.get(doomed, timeout=10)
+
+    def test_object_reconstruction_on_node_death(self, ray_session):
+        """The only copy of a task-produced object dies with its node;
+        get() still returns it — lineage resubmission re-executes the
+        producing task on a surviving node."""
+        c = Cluster.attach()
+        n1 = c.add_node({"CPU": 2, "silver": 2})
+
+        @ray_tpu.remote(resources={"silver": 1})
+        def produce(tag):
+            return np.full(BIG, tag, np.uint8), where()
+
+        ref = produce.remote(9)
+        ray_tpu.wait([ref], timeout=60)     # sealed on n1, never pulled
+        n2 = c.add_node({"CPU": 2, "silver": 2})
+        c.kill_node(n1)
+        arr, host = ray_tpu.get(ref, timeout=120)
+        assert int(arr[0]) == 9 and arr.shape == (BIG,)
+        assert host == n2       # re-executed on the surviving node
+        c.kill_node(n2)
+
+    def test_reconstruction_chain_feeds_consumer(self, ray_session):
+        """A consumer whose dependency is lost mid-flight gets requeued
+        (without burning a retry) and completes once the dep is rebuilt."""
+        c = Cluster.attach()
+        n1 = c.add_node({"CPU": 2, "iron": 2})
+
+        @ray_tpu.remote(resources={"iron": 1})
+        def produce():
+            return np.full(BIG, 5, np.uint8)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(arr):
+            return int(arr[0]) + len(arr)
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], timeout=60)     # sealed on n1 (only iron node)
+        n2 = c.add_node({"CPU": 2, "iron": 2})
+        c.kill_node(n1)
+        time.sleep(1.0)     # let the head observe the death
+        out = ray_tpu.get(consume.remote(ref), timeout=120)
+        assert out == 5 + BIG
+        c.kill_node(n2)
 
     def test_hard_affinity_to_dead_node_fails_fast(self, ray_session):
         from ray_tpu.exceptions import SchedulingError
