@@ -32,7 +32,12 @@ from multiprocessing import connection
 from ray_tpu._private import constants, ids, protocol, spawn
 from ray_tpu._private.object_store import Descriptor, ObjectStore
 from ray_tpu._private.pull_plane import PullClient, serve_pull
-from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.exceptions import ObjectLostError, RuntimeEnvSetupError
+
+
+def _env_trivial(spec) -> bool:
+    from ray_tpu._private.runtime_env import is_trivial
+    return is_trivial(spec.runtime_env)
 
 logger = logging.getLogger("ray_tpu.daemon")
 
@@ -341,21 +346,35 @@ class HostDaemon:
                     self.cv.wait(min(rem, 0.2))
                     w = self.actors.get(spec.actor_id)
         elif spec.actor_creation:
-            w = self._spawn_worker("actor", lease.tpu_chips,
-                                   spec.runtime_env)
+            try:
+                w = self._spawn_worker("actor", lease.tpu_chips,
+                                       spec.runtime_env)
+            except RuntimeEnvSetupError as e:
+                # actor lifecycle runs through NodeActorDied (a plain
+                # NodeTaskFailed for a creation task would strand the
+                # actor in PENDING forever on the head)
+                self._head_send(protocol.NodeActorDied(
+                    spec.actor_id, f"runtime env setup failed: {e}"))
+                return
             if w is None:
-                self._head_send(protocol.NodeTaskFailed(
-                    spec.task_id, "actor worker failed to start"))
+                self._head_send(protocol.NodeActorDied(
+                    spec.actor_id, "actor worker failed to start"))
                 return
             w.actor_id = spec.actor_id
             with self.cv:
                 self.actors[spec.actor_id] = w
                 self.cv.notify_all()
-        elif spec.resources.get("TPU", 0) > 0:
-            w = self._spawn_worker("tpu", lease.tpu_chips, spec.runtime_env)
+        elif spec.resources.get("TPU", 0) > 0 or not _env_trivial(spec):
+            try:
+                w = self._spawn_worker("dedicated", lease.tpu_chips,
+                                       spec.runtime_env)
+            except RuntimeEnvSetupError as e:
+                self._head_send(protocol.NodeTaskFailed(
+                    spec.task_id, f"runtime env setup failed: {e}"))
+                return
             if w is None:
                 self._head_send(protocol.NodeTaskFailed(
-                    spec.task_id, "TPU worker failed to start"))
+                    spec.task_id, "dedicated worker failed to start"))
                 return
         else:
             with self.lock:
@@ -365,7 +384,10 @@ class HostDaemon:
                 if w is not None:
                     w.idle = False
             if w is None:
-                w = self._spawn_worker("generic", None, None)
+                try:
+                    w = self._spawn_worker("generic", None, None)
+                except RuntimeEnvSetupError:
+                    w = None
                 if w is None:
                     self._head_send(protocol.NodeTaskFailed(
                         spec.task_id, "worker failed to start"))
@@ -380,13 +402,22 @@ class HostDaemon:
         w.send(protocol.PushTask(spec=spec, arg_locations=arg_locs))
 
     def _spawn_worker(self, kind, chips, runtime_env):
+        """Raises RuntimeEnvSetupError if the env can't materialize;
+        returns None on registration timeout/startup crash."""
         wid = ids.new_worker_id()
         w = _DWorker(wid, kind=kind)
         with self.lock:
             self.workers[wid] = w
         env = spawn.worker_env(chips=chips or None, runtime_env=runtime_env)
         env["RAY_TPU_NODE_ID"] = self.node_id
-        w.proc = spawn.spawn_worker_proc(self.address, self.authkey, wid, env)
+        try:
+            env, python_exe, cwd = spawn.setup_runtime_env(runtime_env, env)
+        except RuntimeEnvSetupError:
+            with self.lock:
+                self.workers.pop(wid, None)
+            raise
+        w.proc = spawn.spawn_worker_proc(self.address, self.authkey, wid,
+                                         env, python_exe, cwd)
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
         with self.cv:
             while not w.alive:
@@ -413,7 +444,7 @@ class HostDaemon:
                     self._objs[oid] = desc
                     self._origin[oid] = w.worker_id
                 tagged.append(self._tag(desc))
-            if w.kind == "tpu":
+            if w.kind == "dedicated":
                 retire = w
             elif w.kind == "generic":
                 w.idle = True

@@ -21,9 +21,7 @@ from ray_tpu._private import constants
 logger = logging.getLogger("ray_tpu")
 
 
-def host_memory_fraction() -> float:
-    """Fraction of host memory in use, from /proc/meminfo (MemTotal -
-    MemAvailable) / MemTotal. Returns 0.0 when unreadable."""
+def _meminfo_fraction() -> float:
     total = avail = None
     try:
         with open("/proc/meminfo") as f:
@@ -39,6 +37,39 @@ def host_memory_fraction() -> float:
     if not total or avail is None:
         return 0.0
     return 1.0 - avail / total
+
+
+def _read_int(path: str) -> int | None:
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+        return None if txt == "max" else int(txt)
+    except (OSError, ValueError):
+        return None
+
+
+def _cgroup_fraction() -> float | None:
+    """Usage fraction against the cgroup memory limit (v2 then v1); None
+    when unlimited/unreadable. Inside a memory-limited container the
+    cgroup limit is the real ceiling — /proc/meminfo is the HOST's (the
+    reference's memory monitor consults cgroups the same way)."""
+    for limit_p, used_p in (
+            ("/sys/fs/cgroup/memory.max", "/sys/fs/cgroup/memory.current"),
+            ("/sys/fs/cgroup/memory/memory.limit_in_bytes",
+             "/sys/fs/cgroup/memory/memory.usage_in_bytes")):
+        limit = _read_int(limit_p)
+        used = _read_int(used_p)
+        if limit and used is not None and limit < (1 << 60):
+            return used / limit
+    return None
+
+
+def host_memory_fraction() -> float:
+    """Fraction of available memory in use: the tighter of host meminfo
+    and this process tree's cgroup limit."""
+    frac = _meminfo_fraction()
+    cg = _cgroup_fraction()
+    return max(frac, cg) if cg is not None else frac
 
 
 class MemoryMonitor:

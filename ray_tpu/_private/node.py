@@ -98,7 +98,9 @@ class _WorkerConn:
     worker_id: str
     conn: connection.Connection
     proc: object = None                      # mp.Process | subprocess.Popen
-    kind: str = "generic"                    # "generic" | "actor"
+    # "generic" (pool) | "actor" | "dedicated" (TPU / runtime-env tasks,
+    # retire after one task) | "attach" (external CLI/job connections)
+    kind: str = "generic"
     idle: bool = True
     current: _TaskState | None = None
     known_functions: set = field(default_factory=set)
@@ -392,7 +394,11 @@ class NodeServer:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
-                self._on_node_death(node)
+                try:
+                    self._on_node_death(node)
+                except Exception:
+                    logger.exception("node death handling failed for %s",
+                                     node.node_id)
                 return
             try:
                 self._handle_node(node, msg)
@@ -1107,10 +1113,24 @@ class NodeServer:
             a = self.actors.get(msg.actor_id)
             if a is None:
                 return
+            if msg.cause and not a.death_cause:
+                a.death_cause = msg.cause
             for tid in [tid for tid, t in node.inflight.items()
                         if t.spec.actor_id == msg.actor_id]:
                 node.inflight.pop(tid)
         self._on_actor_death(a)
+        with self.lock:
+            rid = a.creation_spec.return_ids[0]
+            # an actor that died terminally WITHOUT ever becoming ready
+            # must still resolve its creation ref (wait_for_actor_ready
+            # would otherwise hang; the local path's _fail_actor does this)
+            stranded = (a.dead and rid not in self.directory
+                        and rid not in self.freed_refs)
+        if stranded:
+            self._store_error(
+                [rid], ActorDiedError(
+                    f"actor {a.actor_id} died: "
+                    f"{a.death_cause or msg.cause or 'unknown'}"))
 
     def _on_node_worker_blocked(self, node: _RemoteNode,
                                 msg: protocol.NodeWorkerBlocked):
@@ -1290,22 +1310,30 @@ class NodeServer:
     def _reconstruct(self, oid: str) -> bool:
         """Rebuild a lost task-produced object by re-executing its
         producing task (lineage resubmission, object_recovery_manager.h:41
-        + TaskResubmissionInterface, task_manager.h:173). Recurses into
-        lost arguments. Returns False if the object cannot be rebuilt (an
-        ObjectLostError value is stored instead)."""
-        with self.lock:
-            if oid in self.directory:
-                return True           # raced with promotion/re-register
-            if oid in self.reconstructing:
-                return True           # a resubmission is already in flight
-            spec = self.lineage.get(oid)
-            n = self.reconstructions.get(oid, 0)
-            if spec is None or n >= constants.MAX_OBJECT_RECONSTRUCTIONS:
-                cause = ("no lineage" if spec is None
-                         else f"exceeded {n} reconstructions")
-                self.lost_objects[oid] = cause
-            else:
-                cause = None
+        + TaskResubmissionInterface, task_manager.h:173). Walks the lost
+        lineage chain iteratively (a long x = f.remote(x) chain must not
+        overflow the Python stack). Returns False if the object cannot be
+        rebuilt (an ObjectLostError value is stored instead)."""
+        plan: list = []         # clones, discovery order (parents first)
+        failed: list = []       # (oid, cause)
+        stack = [oid]
+        seen: set = set()
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            with self.lock:
+                if cur in self.directory or cur in self.reconstructing:
+                    continue    # present, or a resubmission is in flight
+                spec = self.lineage.get(cur)
+                n = self.reconstructions.get(cur, 0)
+                if (spec is None
+                        or n >= constants.MAX_OBJECT_RECONSTRUCTIONS):
+                    failed.append((cur, "no lineage" if spec is None
+                                   else f"exceeded {n} reconstructions"))
+                    self.lost_objects[cur] = failed[-1][1]
+                    continue
                 # one resubmit rebuilds ALL the task's returns
                 for rid in spec.return_ids:
                     self.reconstructions[rid] = max(
@@ -1319,16 +1347,17 @@ class NodeServer:
                     v for kind, v in (list(clone.args)
                                       + list(clone.kwargs.values()))
                     if kind == "ref" and v not in self.directory]
-        if cause is not None:
+            plan.append(clone)
+            stack.extend(missing)
+        for lost_oid, cause in failed:
             self._store_error(
-                [oid], ObjectLostError(f"object {oid} lost: {cause}"))
-            return False
-        logger.warning("reconstructing %s by re-running %s",
-                       oid, clone.function_desc)
-        for v in missing:
-            self._reconstruct(v)      # lineage chain: rebuild inputs first
-        self.submit(clone)
-        return True
+                [lost_oid],
+                ObjectLostError(f"object {lost_oid} lost: {cause}"))
+        for clone in reversed(plan):    # inputs resubmit first
+            logger.warning("reconstructing %s by re-running %s",
+                           clone.return_ids[0], clone.function_desc)
+            self.submit(clone)
+        return bool(plan) and not any(f[0] == oid for f in failed)
 
     # ------------------------------------------------------------------
     # node management (add/kill; the Cluster fixture + autoscaler seam)
@@ -1416,6 +1445,12 @@ class NodeServer:
                 # reference)
                 size = _lineage_size(spec)
                 for oid in spec.return_ids:
+                    old = self.lineage.pop(oid, None)
+                    if old is not None:
+                        # reconstruction resubmits overwrite their entry;
+                        # without the subtract, phantom bytes accumulate
+                        # until eviction disables lineage entirely
+                        self._lineage_bytes -= _lineage_size(old)
                     self.lineage[oid] = spec
                     self._lineage_bytes += size
                 while self.lineage and (
@@ -1434,21 +1469,18 @@ class NodeServer:
                     self.ref_holders.setdefault(oid, set()).add(
                         submitter_id)
             if spec.actor_creation:
-                _name = (spec.runtime_env or {}).get("_name")
+                opts = spec.actor_options or {}
+                _name = opts.get("name")
                 if _name and _name in self.named_actors:
                     raise ValueError(f"actor name {_name!r} already taken")
                 a = _ActorState(
                     actor_id=spec.actor_id, creation_spec=spec,
-                    max_concurrency=(spec.runtime_env or {}).get(
-                        "_max_concurrency", 1),
-                    max_restarts=(spec.runtime_env or {}).get(
-                        "_max_restarts", 0),
-                    max_task_retries=(spec.runtime_env or {}).get(
-                        "_max_task_retries", 0),
-                    name=(spec.runtime_env or {}).get("_name"),
+                    max_concurrency=opts.get("max_concurrency", 1),
+                    max_restarts=opts.get("max_restarts", 0),
+                    max_task_retries=opts.get("max_task_retries", 0),
+                    name=_name,
                     resources=dict(spec.resources),
-                    method_meta=(spec.runtime_env or {}).get(
-                        "_method_meta", {}),
+                    method_meta=opts.get("method_meta", {}),
                 )
                 self.actors[spec.actor_id] = a
                 if a.name:
@@ -1543,7 +1575,7 @@ class NodeServer:
             return (node.alive and _fits(node.available, req)
                     and len(node.free_tpu_chips) >= n_tpu)
 
-        strategy = (spec.runtime_env or {}).get("_scheduling_strategy")
+        strategy = spec.scheduling_strategy
         if isinstance(strategy, dict) and strategy.get("node_id"):
             nid = strategy["node_id"]
             if nid in ("head", self.node_id):
@@ -1707,14 +1739,16 @@ class NodeServer:
             return True
         if self._needs_localize_locked(t):
             return False
-        if n_tpu > 0:
+        from ray_tpu._private.runtime_env import is_trivial
+        if n_tpu > 0 or not is_trivial(t.spec.runtime_env):
             # TPU tasks need TPU_VISIBLE_CHIPS in the environment BEFORE the
             # process initializes JAX (the reference's CUDA_VISIBLE_DEVICES
-            # is equally process-birth-scoped for safety), so they run on a
-            # dedicated fresh worker that retires afterwards, not the pool.
+            # is equally process-birth-scoped for safety); runtime-env tasks
+            # need their env materialized pre-exec. Both run on a dedicated
+            # fresh worker that retires afterwards, not the pool.
             t.tpu_chips = self._debit_target("head", idx, req, n_tpu, pg)
-            threading.Thread(target=self._spawn_tpu_worker, args=(t,),
-                             daemon=True).start()
+            threading.Thread(target=self._spawn_dedicated_worker,
+                             args=(t,), daemon=True).start()
             return True
         worker = next((w for w in self.workers.values()
                        if w.kind == "generic" and w.idle and w.alive), None)
@@ -1726,22 +1760,38 @@ class NodeServer:
         to_send.append((worker, self._push_msg(worker, t)))
         return True
 
-    def _spawn_tpu_worker(self, t: _TaskState):
+    def _spawn_dedicated_worker(self, t: _TaskState):
+        """Fresh single-task worker: used for TPU tasks (chip visibility is
+        process-birth-scoped) and for tasks with a non-trivial runtime
+        environment (the pool's workers have none)."""
+        from ray_tpu._private import spawn as spawn_mod
+        from ray_tpu.exceptions import RuntimeEnvSetupError
         worker_id = ids.new_worker_id()
-        w = _WorkerConn(worker_id, None, proc=None, kind="tpu",
+        w = _WorkerConn(worker_id, None, proc=None, kind="dedicated",
                         idle=False, alive=False)
         with self.lock:
             self.workers[worker_id] = w
-        w.proc = self._spawn_proc(
-            worker_id, self._worker_env(chips=t.tpu_chips,
-                                        runtime_env=t.spec.runtime_env))
+        try:
+            env = self._worker_env(chips=t.tpu_chips,
+                                   runtime_env=t.spec.runtime_env)
+            env, python_exe, cwd = spawn_mod.setup_runtime_env(
+                t.spec.runtime_env, env)
+            w.proc = spawn_mod.spawn_worker_proc(
+                self._address, self._authkey, worker_id, env,
+                python_exe, cwd)
+        except RuntimeEnvSetupError as e:
+            with self.lock:
+                self._release_task_resources(t)
+                self.workers.pop(worker_id, None)
+            self._store_error(t.spec.return_ids, e, spec=t.spec)
+            return
         if not self._await_registration(w):
             with self.lock:
                 self._release_task_resources(t)
                 self.workers.pop(worker_id, None)
             self._store_error(
                 t.spec.return_ids,
-                WorkerCrashedError("TPU worker failed to start"),
+                WorkerCrashedError("dedicated worker failed to start"),
                 spec=t.spec)
             return
         with self.lock:
@@ -1878,14 +1928,27 @@ class NodeServer:
         self._schedule()
 
     def _spawn_actor_worker(self, a: _ActorState, creation_task: _TaskState):
+        from ray_tpu._private import spawn as spawn_mod
+        from ray_tpu.exceptions import RuntimeEnvSetupError
         worker_id = ids.new_worker_id()
         w = _WorkerConn(worker_id, None, proc=None, kind="actor",
                         idle=False, alive=False)
         with self.lock:
             self.workers[worker_id] = w
-        w.proc = self._spawn_proc(
-            worker_id, self._worker_env(chips=a.tpu_chips,
-                                        runtime_env=a.creation_spec.runtime_env))
+        try:
+            env = self._worker_env(
+                chips=a.tpu_chips,
+                runtime_env=a.creation_spec.runtime_env)
+            env, python_exe, cwd = spawn_mod.setup_runtime_env(
+                a.creation_spec.runtime_env, env)
+            w.proc = spawn_mod.spawn_worker_proc(
+                self._address, self._authkey, worker_id, env,
+                python_exe, cwd)
+        except RuntimeEnvSetupError as e:
+            with self.lock:
+                self.workers.pop(worker_id, None)
+            self._fail_actor(a, f"runtime env setup failed: {e}")
+            return
         if not self._await_registration(w):
             self._fail_actor(a, "actor worker failed to start")
             return
@@ -1973,9 +2036,10 @@ class NodeServer:
                 if not w.released:
                     self._release_task_resources(t)
                 w.released = {}
-                if w.kind == "tpu":
-                    # Dedicated TPU workers retire with their task: the TPU
-                    # runtime can't be re-scoped in a live process.
+                if w.kind == "dedicated":
+                    # Dedicated workers retire with their task: the TPU
+                    # runtime (and a task-specific env) can't be re-scoped
+                    # in a live process.
                     w.idle = False
                     w.alive = False
                     retire = w

@@ -64,8 +64,17 @@ class TaskSpec:
     method_name: str | None = None
     max_retries: int = 0
     retry_exceptions: bool = False
+    # User runtime environment ONLY (env_vars/working_dir/pip/py_modules —
+    # _private/runtime_env.py schema). Actor options live in
+    # `actor_options`, scheduling hints in `scheduling_strategy`; they
+    # were previously smuggled through runtime_env as _-prefixed keys.
     runtime_env: dict | None = None
     placement_group_id: str | None = None
+    # Actor creation options: max_concurrency, max_restarts,
+    # max_task_retries, name, method_meta.
+    actor_options: dict | None = None
+    # "SPREAD" | {"node_id": ..., "soft": ...} | None (node.py _pick_node).
+    scheduling_strategy: object = None
     # Name shown in state API / dashboards.
     name: str = ""
 

@@ -1,0 +1,235 @@
+"""Per-task/actor runtime environments: working_dir, pip venvs, env_vars.
+
+Counterpart of the reference's `python/ray/_private/runtime_env/`
+(`working_dir.py`, `pip.py`, `uri_cache.py`) + the runtime-env agent
+(`dashboard/modules/runtime_env/runtime_env_agent.py:161`): the node that
+spawns a worker materializes the environment FIRST — a content-addressed
+cache entry per distinct environment — then launches the worker inside it
+(venv python, working_dir cwd, merged env vars).
+
+Supported runtime_env keys (same schema shape as the reference):
+
+- ``env_vars``:   {name: value} merged into the worker's environment
+- ``working_dir``: a local directory (copied into the cache; the worker
+                   starts with cwd there and the dir on sys.path)
+- ``pip``:        list of requirement strings / local wheel paths, or
+                   {"packages": [...]}. Installed into a cached venv
+                   created with --system-site-packages so the image's
+                   jax/numpy remain importable. No-network installs work
+                   when requirements are local wheels; anything needing
+                   egress fails with RuntimeEnvSetupError.
+- ``py_modules``:  list of local module dirs/files appended to sys.path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_CACHE_ROOT = os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE",
+                             "/tmp/ray_tpu_runtime_envs")
+_MAX_CACHE_ENTRIES = int(os.environ.get(
+    "RAY_TPU_RUNTIME_ENV_CACHE_ENTRIES", "20"))
+
+_SETUP_KEYS = ("working_dir", "pip", "py_modules", "env_vars")
+
+
+def is_trivial(runtime_env: dict | None) -> bool:
+    """True when the task can reuse a pool worker: no materialization AND
+    no env_vars (pool workers were spawned without them; the reference
+    likewise keys worker reuse on the runtime-env hash)."""
+    if not runtime_env:
+        return True
+    return not any(runtime_env.get(k) for k in _SETUP_KEYS)
+
+
+def _normalize_pip(spec) -> list[str]:
+    if isinstance(spec, dict):
+        spec = spec.get("packages", [])
+    return [str(p) for p in spec]
+
+
+def _dir_fingerprint(path: str) -> str:
+    """Content hash of a directory tree (URI of the packaged working_dir;
+    reference: packaging.py hashes the zip the same way)."""
+    h = hashlib.sha1()
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for name in sorted(files):
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, path)
+            h.update(rel.encode())
+            try:
+                st = os.stat(fp)
+                h.update(f"{st.st_size}:{int(st.st_mtime)}".encode())
+            except OSError:
+                continue
+    return h.hexdigest()[:16]
+
+
+class RuntimeEnvManager:
+    """Materializes runtime environments into a content-addressed cache.
+
+    One instance per worker-spawning process (head NodeServer and each
+    HostDaemon). Entries are shared across sessions (the point of the
+    cache: venv creation is seconds); an LRU cap bounds disk usage
+    (reference: uri_cache.py)."""
+
+    def __init__(self, cache_root: str = _CACHE_ROOT):
+        self.cache_root = cache_root
+        self._lock = threading.Lock()
+        self._entry_locks: dict[str, threading.Lock] = {}
+
+    # -- public -----------------------------------------------------------
+
+    def setup(self, runtime_env: dict | None):
+        """Materialize `runtime_env`. Returns (env_overrides, cwd,
+        python_exe) — python_exe is None unless a pip venv applies.
+        Raises RuntimeEnvSetupError on any failure."""
+        env: dict[str, str] = {}
+        cwd = None
+        python_exe = None
+        if not runtime_env:
+            return env, cwd, python_exe
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            env[str(k)] = str(v)
+        pypath: list[str] = []
+        wd = runtime_env.get("working_dir")
+        if wd:
+            cwd = self._setup_working_dir(wd)
+            pypath.append(cwd)
+        for mod in runtime_env.get("py_modules") or []:
+            pypath.append(self._setup_py_module(mod))
+        pip = _normalize_pip(runtime_env.get("pip") or [])
+        if pip:
+            python_exe = self._setup_pip(pip)
+        if pypath:
+            extra = os.pathsep.join(pypath)
+            env["PYTHONPATH"] = (
+                extra + os.pathsep + env["PYTHONPATH"]
+                if "PYTHONPATH" in env else extra)
+            # mark for spawn.propagate_pythonpath to keep these FIRST
+            env["RAY_TPU_RUNTIME_ENV_PATHS"] = extra
+        return env, cwd, python_exe
+
+    # -- working_dir ------------------------------------------------------
+
+    def _setup_working_dir(self, src: str) -> str:
+        src = os.path.abspath(os.path.expanduser(src))
+        if not os.path.isdir(src):
+            raise RuntimeEnvSetupError(
+                f"runtime_env working_dir {src!r} is not a directory")
+        key = "wd_" + _dir_fingerprint(src)
+        dest = os.path.join(self.cache_root, key)
+        with self._entry_lock(key):
+            if not os.path.isdir(dest):
+                tmp = dest + ".tmp.%d" % os.getpid()
+                shutil.copytree(src, tmp)
+                os.replace(tmp, dest)
+            self._touch(dest)
+        self._prune()
+        return dest
+
+    def _setup_py_module(self, mod: str) -> str:
+        mod = os.path.abspath(os.path.expanduser(mod))
+        if os.path.isdir(mod):
+            # containing dir goes on sys.path so `import <basename>` works
+            staged = self._setup_working_dir(mod)
+            parent = os.path.join(
+                os.path.dirname(staged), "pkg_" + os.path.basename(staged))
+            os.makedirs(parent, exist_ok=True)
+            link = os.path.join(parent, os.path.basename(mod))
+            if not os.path.exists(link):
+                try:
+                    os.symlink(staged, link)
+                except OSError:
+                    shutil.copytree(staged, link, dirs_exist_ok=True)
+            return parent
+        raise RuntimeEnvSetupError(
+            f"runtime_env py_modules entry {mod!r} is not a directory")
+
+    # -- pip --------------------------------------------------------------
+
+    def _setup_pip(self, packages: list[str]) -> str:
+        key = "pip_" + hashlib.sha1(
+            json.dumps(sorted(packages)).encode()).hexdigest()[:16]
+        venv_dir = os.path.join(self.cache_root, key)
+        python_exe = os.path.join(venv_dir, "bin", "python")
+        with self._entry_lock(key):
+            if not os.path.exists(python_exe):
+                tmp = venv_dir + ".tmp.%d" % os.getpid()
+                shutil.rmtree(tmp, ignore_errors=True)
+                try:
+                    # --system-site-packages: the baked-in jax/numpy stack
+                    # stays importable; the venv only ADDs packages
+                    subprocess.run(
+                        [sys.executable, "-m", "venv",
+                         "--system-site-packages", tmp],
+                        check=True, capture_output=True, timeout=120)
+                    subprocess.run(
+                        [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                         "install", "--quiet", "--no-input", *packages],
+                        check=True, capture_output=True, timeout=600)
+                except subprocess.CalledProcessError as e:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeEnvSetupError(
+                        "pip runtime_env setup failed: "
+                        f"{(e.stderr or b'').decode()[-2000:]}") from None
+                except subprocess.TimeoutExpired:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeEnvSetupError(
+                        "pip runtime_env setup timed out") from None
+                os.replace(tmp, venv_dir)
+            self._touch(venv_dir)
+        self._prune()
+        return python_exe
+
+    # -- cache plumbing ---------------------------------------------------
+
+    def _entry_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            return self._entry_locks.setdefault(key, threading.Lock())
+
+    @staticmethod
+    def _touch(path: str) -> None:
+        try:
+            os.utime(path, None)
+        except OSError:
+            pass
+
+    def _prune(self) -> None:
+        """Drop least-recently-used cache entries above the cap."""
+        try:
+            entries = [
+                os.path.join(self.cache_root, e)
+                for e in os.listdir(self.cache_root)
+                if not e.endswith(tuple(
+                    f".tmp.{p}" for p in ()))  # tmp dirs carry pids
+                and ".tmp." not in e]
+        except FileNotFoundError:
+            return
+        if len(entries) <= _MAX_CACHE_ENTRIES:
+            return
+        entries.sort(key=lambda p: os.path.getmtime(p))
+        for path in entries[:len(entries) - _MAX_CACHE_ENTRIES]:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+_manager: RuntimeEnvManager | None = None
+_manager_lock = threading.Lock()
+
+
+def get_manager() -> RuntimeEnvManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = RuntimeEnvManager()
+        return _manager

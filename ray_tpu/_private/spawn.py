@@ -66,12 +66,27 @@ def propagate_pythonpath(env: dict) -> dict:
 
 
 def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
-                      env: dict) -> subprocess.Popen:
+                      env: dict, python_exe: str | None = None,
+                      cwd: str | None = None) -> subprocess.Popen:
     """Exec a worker process that will register at `address`. subprocess
     (not mp.Process) so we control the child env exactly and never inherit
-    the parent's TPU runtime handles/locks."""
-    cmd = [sys.executable, "-m", "ray_tpu._private.worker_main",
-           address, worker_id]
+    the parent's TPU runtime handles/locks. `python_exe`/`cwd` come from a
+    materialized runtime environment (pip venv / working_dir)."""
+    cmd = [python_exe or sys.executable,
+           "-m", "ray_tpu._private.worker_main", address, worker_id]
     env = propagate_pythonpath(dict(env))
     env["RAY_TPU_AUTHKEY"] = authkey.hex()
-    return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
+    return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
+                            cwd=cwd)
+
+
+def setup_runtime_env(runtime_env: dict | None, env: dict):
+    """Materialize a runtime environment (runtime_env.py) and merge its
+    env overrides into `env`. Returns (env, python_exe, cwd); raises
+    RuntimeEnvSetupError on failure."""
+    from ray_tpu._private.runtime_env import get_manager, is_trivial
+    if is_trivial(runtime_env):
+        return env, None, None
+    overrides, cwd, python_exe = get_manager().setup(runtime_env)
+    env.update(overrides)
+    return env, python_exe, cwd

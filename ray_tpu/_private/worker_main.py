@@ -297,8 +297,8 @@ class WorkerRuntime:
                 break
             spec = push.spec
             if spec.actor_creation:
-                max_concurrency = (spec.runtime_env or {}).get(
-                    "_max_concurrency", 1)
+                max_concurrency = (spec.actor_options or {}).get(
+                    "max_concurrency", 1)
                 if max_concurrency > 1:
                     self._executor = ThreadPoolExecutor(
                         max_workers=max_concurrency,

@@ -101,15 +101,15 @@ class ActorClass:
                 o, DEFAULT_ACTOR_LIFETIME_CPUS),
             actor_id=actor_id,
             actor_creation=True,
-            runtime_env={
-                **(o.get("runtime_env") or {}),
-                "_max_concurrency": int(o.get("max_concurrency", 1)),
-                "_max_restarts": int(o.get("max_restarts", 0)),
-                "_max_task_retries": int(o.get("max_task_retries", 0)),
-                "_name": o.get("name"),
-                "_method_meta": method_meta,
-                "_scheduling_strategy": strategy_enc,
+            runtime_env=o.get("runtime_env"),
+            actor_options={
+                "max_concurrency": int(o.get("max_concurrency", 1)),
+                "max_restarts": int(o.get("max_restarts", 0)),
+                "max_task_retries": int(o.get("max_task_retries", 0)),
+                "name": o.get("name"),
+                "method_meta": method_meta,
             },
+            scheduling_strategy=strategy_enc,
             placement_group_id=pg_id,
             name=o.get("name") or self.__name__,
         )

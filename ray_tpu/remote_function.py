@@ -100,13 +100,12 @@ class RemoteFunction:
         return_ids = [ids.new_object_id() for _ in range(num_returns)]
         enc_args, enc_kwargs = _encode_args(args, kwargs)
         pg_id = None
-        runtime_env = o.get("runtime_env")
+        strategy_enc = None
         strategy = o.get("scheduling_strategy")
         if strategy is not None and hasattr(strategy, "placement_group"):
             pg_id = strategy.placement_group.id
         elif strategy is not None:
-            runtime_env = dict(runtime_env or {})
-            runtime_env["_scheduling_strategy"] = encode_strategy(strategy)
+            strategy_enc = encode_strategy(strategy)
         spec = protocol.TaskSpec(
             task_id=task_id,
             function_id=function_id,
@@ -120,7 +119,8 @@ class RemoteFunction:
             resources=_resources_from_options(o, DEFAULT_TASK_NUM_CPUS),
             max_retries=int(o.get("max_retries", 0)),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
-            runtime_env=runtime_env,
+            runtime_env=o.get("runtime_env"),
+            scheduling_strategy=strategy_enc,
             placement_group_id=pg_id,
             name=o.get("name") or getattr(self._function, "__name__", ""),
         )
