@@ -110,14 +110,15 @@ class RuntimeEnvManager:
             pypath.append(self._setup_py_module(mod))
         pip = _normalize_pip(runtime_env.get("pip") or [])
         if pip:
-            python_exe = self._setup_pip(pip)
+            python_exe, site_dir = self._setup_pip(pip)
+            if site_dir:
+                # the venv's site-packages must SHADOW the parent's
+                # propagated sys.path or version pins are silently ignored
+                pypath.append(site_dir)
         if pypath:
-            extra = os.pathsep.join(pypath)
-            env["PYTHONPATH"] = (
-                extra + os.pathsep + env["PYTHONPATH"]
-                if "PYTHONPATH" in env else extra)
-            # mark for spawn.propagate_pythonpath to keep these FIRST
-            env["RAY_TPU_RUNTIME_ENV_PATHS"] = extra
+            # spawn.propagate_pythonpath places these first (after the
+            # worker sitecustomize) so the env wins over inherited paths
+            env["RAY_TPU_RUNTIME_ENV_PATHS"] = os.pathsep.join(pypath)
         return env, cwd, python_exe
 
     # -- working_dir ------------------------------------------------------
@@ -133,7 +134,7 @@ class RuntimeEnvManager:
             if not os.path.isdir(dest):
                 tmp = dest + ".tmp.%d" % os.getpid()
                 shutil.copytree(src, tmp)
-                os.replace(tmp, dest)
+                self._commit(tmp, dest)
             self._touch(dest)
         self._prune()
         return dest
@@ -158,9 +159,20 @@ class RuntimeEnvManager:
 
     # -- pip --------------------------------------------------------------
 
-    def _setup_pip(self, packages: list[str]) -> str:
+    def _setup_pip(self, packages: list[str]):
+        """Returns (python_exe, site_packages_dir)."""
+        # local wheels/sdists contribute content identity (size+mtime) to
+        # the key: a rebuilt wheel at the same path must NOT reuse the
+        # stale venv
+        key_parts = []
+        for p in sorted(packages):
+            if os.path.exists(p):
+                st = os.stat(p)
+                key_parts.append(f"{p}:{st.st_size}:{int(st.st_mtime)}")
+            else:
+                key_parts.append(p)
         key = "pip_" + hashlib.sha1(
-            json.dumps(sorted(packages)).encode()).hexdigest()[:16]
+            json.dumps(key_parts).encode()).hexdigest()[:16]
         venv_dir = os.path.join(self.cache_root, key)
         python_exe = os.path.join(venv_dir, "bin", "python")
         with self._entry_lock(key):
@@ -187,12 +199,29 @@ class RuntimeEnvManager:
                     shutil.rmtree(tmp, ignore_errors=True)
                     raise RuntimeEnvSetupError(
                         "pip runtime_env setup timed out") from None
-                os.replace(tmp, venv_dir)
+                self._commit(tmp, venv_dir)
             self._touch(venv_dir)
         self._prune()
-        return python_exe
+        import glob as _glob
+        sites = _glob.glob(os.path.join(
+            venv_dir, "lib", "python*", "site-packages"))
+        return python_exe, (sites[0] if sites else None)
 
     # -- cache plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _commit(tmp: str, dest: str) -> None:
+        """Publish a finished cache entry. The entry locks are
+        per-process; another daemon on this host may have won the same
+        key — losing the rename race just means the entry already exists
+        (content-addressed, so identical)."""
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            if os.path.isdir(dest):
+                shutil.rmtree(tmp, ignore_errors=True)
+            else:
+                raise
 
     def _entry_lock(self, key: str) -> threading.Lock:
         with self._lock:
@@ -211,9 +240,7 @@ class RuntimeEnvManager:
             entries = [
                 os.path.join(self.cache_root, e)
                 for e in os.listdir(self.cache_root)
-                if not e.endswith(tuple(
-                    f".tmp.{p}" for p in ()))  # tmp dirs carry pids
-                and ".tmp." not in e]
+                if ".tmp." not in e]       # in-flight builds carry pids
         except FileNotFoundError:
             return
         if len(entries) <= _MAX_CACHE_ENTRIES:

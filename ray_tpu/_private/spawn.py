@@ -49,11 +49,20 @@ def propagate_pythonpath(env: dict) -> dict:
     serializes module-level functions by reference, so the full sys.path
     (including the uninstalled checkout and the user's script dir) is
     propagated (reference: workers inherit the driver's load path /
-    working_dir runtime env, services.py)."""
+    working_dir runtime env, services.py).
+
+    Runtime-env paths (RAY_TPU_RUNTIME_ENV_PATHS: working_dir, py_modules,
+    pip-venv site-packages) go FIRST, right after the worker sitecustomize
+    — a runtime env must be able to shadow the parent's installed
+    packages, or pip:["pkg==2.0"] silently resolves to the base image's
+    pkg 1.0."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     worker_site = os.path.join(pkg_root, "ray_tpu", "_private", "worker_site")
-    entries = [worker_site, pkg_root] + [p for p in sys.path if p]
+    rt_paths = [p for p in env.get(
+        "RAY_TPU_RUNTIME_ENV_PATHS", "").split(os.pathsep) if p]
+    entries = [worker_site] + rt_paths + [pkg_root]
+    entries += [p for p in sys.path if p]
     pypath = env.get("PYTHONPATH", "")
     entries += [p for p in pypath.split(os.pathsep) if p]
     seen, uniq = set(), []
@@ -85,8 +94,17 @@ def setup_runtime_env(runtime_env: dict | None, env: dict):
     env overrides into `env`. Returns (env, python_exe, cwd); raises
     RuntimeEnvSetupError on failure."""
     from ray_tpu._private.runtime_env import get_manager, is_trivial
+    from ray_tpu.exceptions import RuntimeEnvSetupError
     if is_trivial(runtime_env):
         return env, None, None
-    overrides, cwd, python_exe = get_manager().setup(runtime_env)
+    try:
+        overrides, cwd, python_exe = get_manager().setup(runtime_env)
+    except RuntimeEnvSetupError:
+        raise
+    except Exception as e:
+        # cache races / fs errors must surface as setup failures, not
+        # escape the spawn thread and strand the task
+        raise RuntimeEnvSetupError(
+            f"runtime env setup failed: {e!r}") from e
     env.update(overrides)
     return env, python_exe, cwd

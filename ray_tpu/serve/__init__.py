@@ -21,9 +21,10 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request
+from ray_tpu.serve.replica import StreamingResponse
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
     "shutdown", "delete", "set_route", "get_deployment_handle",
-    "DeploymentHandle", "batch", "Request",
+    "DeploymentHandle", "batch", "Request", "StreamingResponse",
 ]

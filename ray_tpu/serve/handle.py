@@ -121,10 +121,41 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         """-> ObjectRef of the user callable's result."""
+        return self.remote_detailed(*args, **kwargs)[0]
+
+    def remote_detailed(self, *args, **kwargs):
+        """-> (ObjectRef, replica_handle). The replica identity lets a
+        caller continue a replica-side streaming session (the proxy's
+        chunk drain) against the replica that holds the generator."""
         replica = self._pick_replica()
         ref = replica.handle_request.remote(args, kwargs)
         self._record(replica._actor_id, ref)
-        return ref
+        return ref, replica
+
+    def stream(self, *args, timeout: Optional[float] = 120.0, **kwargs):
+        """Python-side streaming consumption: yields chunks of a
+        generator/StreamingResponse deployment result."""
+        import ray_tpu
+        from ray_tpu.serve.replica import STREAM_MARKER
+        ref, replica = self.remote_detailed(*args, **kwargs)
+        result = ray_tpu.get(ref, timeout=timeout)
+        if not (isinstance(result, dict) and STREAM_MARKER in result):
+            yield result
+            return
+        sid = result[STREAM_MARKER]
+        try:
+            while True:
+                chunks, done = ray_tpu.get(
+                    replica.next_chunks.remote(sid), timeout=timeout)
+                yield from chunks
+                if done:
+                    return
+        except GeneratorExit:
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
+            raise
 
     def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
         """Synchronous convenience: remote + get."""

@@ -1,26 +1,31 @@
-"""HTTP ingress.
+"""HTTP ingress — async data plane with response streaming.
 
 Counterpart of the reference's `HTTPProxy`
-(`serve/_private/http_proxy.py:189`, actor wrapper :858). The reference
-rides uvicorn/ASGI; this image has no HTTP framework, so the proxy actor
-runs a stdlib ThreadingHTTPServer on a background thread and forwards
-requests through DeploymentHandles (the same proxy→replica actor-call
-data plane).
+(`serve/_private/http_proxy.py:189`, uvicorn/ASGI + actor wrapper :858,
+streaming `replica.py:249`): an aiohttp server runs on a dedicated event
+loop inside the proxy actor; request handling never blocks the loop —
+replica picks/submits run on a small executor and ObjectRef results are
+awaited via futures. Streaming deployments (generators /
+StreamingResponse) are transferred replica→proxy in chunk batches and
+written through an HTTP chunked response, so a slow client doesn't hold a
+replica thread and the first byte leaves before the generator finishes.
 
 Request mapping: the deployment callable receives a `Request` with
 method/path/query/headers/body; `json()` parses the body. Responses:
-bytes/str passed through; any other object is JSON-encoded.
+bytes/str passed through; StreamingResponse/generators stream chunked;
+any other object is JSON-encoded.
 """
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import json
 import threading
-import urllib.parse
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.replica import STREAM_MARKER
 
 
 @dataclass
@@ -37,40 +42,54 @@ class Request:
 
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from aiohttp import web
+
         self.host, self.port = host, port
         self._routes: dict = {}           # prefix -> (deployment, app)
         self._handles: dict = {}
-        proxy = self
+        # picks/submits touch blocking plumbing (non-blocking wait() for
+        # load probes, socket sends): keep them off the event loop
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="serve-proxy")
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        app = web.Application(client_max_size=1 << 28)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._app = app
+        self._boot_error: BaseException | None = None
 
-            def log_message(self, *a):     # quiet
-                pass
+        def run():
+            asyncio.set_event_loop(self._loop)
+            try:
+                runner = web.AppRunner(app, access_log=None)
+                self._loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, host, port)
+                self._loop.run_until_complete(site.start())
+                for s in site._server.sockets:
+                    self.port = s.getsockname()[1]   # resolves port=0
+                    break
+                self._runner = runner
+            except BaseException as e:
+                self._boot_error = e
+                return
+            finally:
+                self._started.set()
+            self._loop.run_forever()
 
-            def _dispatch(self):
-                try:
-                    proxy._serve_one(self)
-                except BrokenPipeError:
-                    pass
-                except Exception as e:     # 500 with the error text
-                    try:
-                        body = str(e).encode()
-                        self.send_response(500)
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    except Exception:
-                        pass
-
-            do_GET = do_POST = do_PUT = do_DELETE = _dispatch
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._server.server_port     # resolves port=0
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="serve-http")
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-http")
         self._thread.start()
+        if not self._started.wait(30):
+            raise RuntimeError("HTTP proxy failed to start within 30s")
+        if self._boot_error is not None:
+            # bind failures must raise at construction (a silently dead
+            # proxy reporting the requested port helps nobody)
+            raise RuntimeError(
+                f"HTTP proxy failed to bind {host}:{port}: "
+                f"{self._boot_error}")
+
+    # -- actor control surface (unchanged vs the stdlib proxy) ------------
 
     def ready(self) -> dict:
         return {"host": self.host, "port": self.port}
@@ -83,6 +102,15 @@ class HTTPProxy:
     def get_routes(self) -> dict:
         return dict(self._routes)
 
+    def stop(self) -> bool:
+        async def _shutdown():
+            await self._runner.cleanup()
+            self._loop.stop()
+        asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+        return True
+
+    # -- request path -----------------------------------------------------
+
     def _match(self, path: str):
         best = None
         for prefix, target in self._routes.items():
@@ -93,39 +121,83 @@ class HTTPProxy:
                     best = (prefix, target)
         return best
 
-    def _serve_one(self, handler) -> None:
-        parsed = urllib.parse.urlsplit(handler.path)
-        match = self._match(parsed.path)
+    async def _handle(self, request):
+        from aiohttp import web
+
+        match = self._match(request.path)
         if match is None:
-            handler.send_response(404)
-            handler.send_header("Content-Length", "0")
-            handler.end_headers()
-            return
-        _, (dep, app) = match
-        key = (dep, app)
+            return web.Response(status=404)
+        _, (dep, app_name) = match
+        key = (dep, app_name)
         if key not in self._handles:
-            self._handles[key] = DeploymentHandle(dep, app)
-        length = int(handler.headers.get("Content-Length") or 0)
+            self._handles[key] = DeploymentHandle(dep, app_name)
+        handle = self._handles[key]
+        body = await request.read()
         req = Request(
-            method=handler.command,
-            path=parsed.path,
-            query=dict(urllib.parse.parse_qsl(parsed.query)),
-            headers=dict(handler.headers.items()),
-            body=handler.rfile.read(length) if length else b"")
-        result = self._handles[key].call(req, timeout=120)
+            method=request.method,
+            path=request.path,
+            query=dict(request.query),
+            headers=dict(request.headers),
+            body=body)
+        loop = asyncio.get_event_loop()
+        try:
+            ref, replica = await loop.run_in_executor(
+                self._pool, handle.remote_detailed, req)
+            result = await self._aget(ref)
+        except Exception as e:
+            return web.Response(status=500, text=str(e))
+        if isinstance(result, dict) and STREAM_MARKER in result:
+            return await self._stream_out(request, replica, result)
         if isinstance(result, bytes):
             body, ctype = result, "application/octet-stream"
         elif isinstance(result, str):
             body, ctype = result.encode(), "text/plain"
         else:
             body, ctype = json.dumps(result).encode(), "application/json"
-        handler.send_response(200)
-        handler.send_header("Content-Type", ctype)
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+        return web.Response(status=200, body=body, content_type=ctype)
 
-    def stop(self) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        return True
+    async def _stream_out(self, request, replica, marker: dict):
+        """Drain a replica-side generator into a chunked HTTP response
+        (reference: streaming replica responses, replica.py:249)."""
+        from aiohttp import web
+
+        stream_id = marker[STREAM_MARKER]
+        resp = web.StreamResponse(
+            status=marker.get("status", 200),
+            headers={"Content-Type": marker.get(
+                "content_type", "application/octet-stream")})
+        await resp.prepare(request)
+        try:
+            while True:
+                ref = replica.next_chunks.remote(stream_id)
+                chunks, done = await self._aget(ref)
+                for chunk in chunks:
+                    await resp.write(_to_bytes(chunk))
+                if done:
+                    break
+            await resp.write_eof()
+        except BaseException:
+            # client gone / chunk failure: release the replica-side
+            # generator rather than leaking it in Replica._streams
+            try:
+                replica.cancel_stream.remote(stream_id)
+            except Exception:
+                pass
+            raise
+        return resp
+
+    async def _aget(self, ref, timeout: float = 300.0):
+        """Await an ObjectRef on the proxy's bounded thread pool — NOT via
+        ref.future(), which spawns one OS thread per call."""
+        import ray_tpu
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            self._pool, lambda: ray_tpu.get(ref, timeout=timeout))
+
+
+def _to_bytes(chunk) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return json.dumps(chunk).encode()

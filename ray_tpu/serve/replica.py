@@ -10,8 +10,26 @@ a thread pool, worker_main.py max_concurrency).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+from types import GeneratorType
+
+STREAM_MARKER = "__serve_stream__"
+_STREAM_BATCH = 16          # chunks per proxy round-trip
+_STREAM_IDLE_TTL_S = 300.0  # undrained streams are reaped after this
+
+
+class StreamingResponse:
+    """Deployment return type for streamed HTTP bodies (reference:
+    serve's StreamingResponse over `replica.py:249` generator replies).
+    Wraps any iterable of bytes/str chunks."""
+
+    def __init__(self, content, content_type: str = "text/plain",
+                 status: int = 200):
+        self.content = content
+        self.content_type = content_type
+        self.status = status
 
 
 class Replica:
@@ -32,6 +50,11 @@ class Replica:
         self._total = 0
         self._lock = threading.Lock()
         self._started = time.time()
+        # stream_id -> [iterator, last_access_ts]; idle entries are reaped
+        # (a caller that got the marker but never drains would otherwise
+        # pin the generator + its closure for the replica's lifetime)
+        self._streams: dict[int, list] = {}
+        self._stream_ids = itertools.count(1)
 
     def ready(self) -> bool:
         return True
@@ -53,13 +76,45 @@ class Replica:
         with self._lock:
             self._inflight -= 1
 
+    def _maybe_stream(self, result):
+        """Generator / StreamingResponse results stay ON the replica; the
+        caller gets a marker and drains chunk batches via next_chunks
+        (reference: streaming replies, replica.py:249 — a generator can't
+        ride the object store)."""
+        if isinstance(result, StreamingResponse):
+            return {STREAM_MARKER: self._register_stream(
+                        iter(result.content)),
+                    "content_type": result.content_type,
+                    "status": result.status}
+        if isinstance(result, GeneratorType):
+            return {STREAM_MARKER: self._register_stream(result),
+                    "content_type": "application/octet-stream",
+                    "status": 200}
+        return result
+
+    def _register_stream(self, it) -> int:
+        sid = next(self._stream_ids)
+        now = time.time()
+        with self._lock:
+            stale = [s for s, (_, ts) in self._streams.items()
+                     if now - ts > _STREAM_IDLE_TTL_S]
+            for s in stale:
+                dead, _ = self._streams.pop(s)
+                if hasattr(dead, "close"):
+                    try:
+                        dead.close()
+                    except Exception:
+                        pass
+            self._streams[sid] = [it, now]
+        return sid
+
     def handle_request(self, args: tuple, kwargs: dict):
         """__call__ path (HTTP and plain handle calls)."""
         self._enter()
         try:
             target = (self.callable if self._is_function
                       else self.callable.__call__)
-            return target(*args, **kwargs)
+            return self._maybe_stream(target(*args, **kwargs))
         finally:
             self._exit()
 
@@ -67,9 +122,42 @@ class Replica:
         """handle.method.remote path (model composition)."""
         self._enter()
         try:
-            return getattr(self.callable, method)(*args, **kwargs)
+            return self._maybe_stream(
+                getattr(self.callable, method)(*args, **kwargs))
         finally:
             self._exit()
+
+    def next_chunks(self, stream_id: int, max_chunks: int = _STREAM_BATCH):
+        """Pull the next batch of chunks from a registered stream.
+        Returns (chunks, done); the stream is dropped when done."""
+        with self._lock:
+            entry = self._streams.get(stream_id)
+            if entry is not None:
+                entry[1] = time.time()
+        if entry is None:
+            return [], True
+        it = entry[0]
+        chunks = []
+        done = False
+        try:
+            for _ in range(max_chunks):
+                chunks.append(next(it))
+        except StopIteration:
+            done = True
+        if done:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+        return chunks, done
+
+    def cancel_stream(self, stream_id: int) -> bool:
+        with self._lock:
+            entry = self._streams.pop(stream_id, None)
+        if entry is not None and hasattr(entry[0], "close"):
+            try:
+                entry[0].close()
+            except Exception:
+                pass
+        return entry is not None
 
     def stats(self) -> dict:
         """Autoscaling signal (reference: autoscaling_metrics.py pulls

@@ -110,9 +110,14 @@ def test_pip_wheel_in_actor(ray_session, tmp_path):
     # cache hit: the same env resolves to the same venv without a rebuild
     from ray_tpu._private.runtime_env import get_manager
     mgr = get_manager()
-    exe1 = mgr._setup_pip([wheel])
-    exe2 = mgr._setup_pip([wheel])
+    exe1, site1 = mgr._setup_pip([wheel])
+    exe2, _ = mgr._setup_pip([wheel])
     assert exe1 == exe2 and os.path.exists(exe1)
+    assert site1 and os.path.isdir(site1)
+    # a REBUILT wheel at the same path must get a fresh venv
+    os.utime(wheel, (os.path.getmtime(wheel) + 5,) * 2)
+    exe3, _ = mgr._setup_pip([wheel])
+    assert exe3 != exe1
 
 
 def test_bad_pip_env_fails_cleanly(ray_session):
