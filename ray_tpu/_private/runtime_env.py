@@ -168,7 +168,9 @@ class RuntimeEnvManager:
         for p in sorted(packages):
             if os.path.exists(p):
                 st = os.stat(p)
-                key_parts.append(f"{p}:{st.st_size}:{int(st.st_mtime)}")
+                # nanosecond mtime: a rebuild within the same second with
+                # identical size must still invalidate the cached venv
+                key_parts.append(f"{p}:{st.st_size}:{st.st_mtime_ns}")
             else:
                 key_parts.append(p)
         key = "pip_" + hashlib.sha1(

@@ -147,6 +147,9 @@ class DeploymentHandle:
             while True:
                 chunks, done = ray_tpu.get(
                     replica.next_chunks.remote(sid), timeout=timeout)
+                if chunks is None:
+                    raise RuntimeError(
+                        f"stream {sid} expired on the replica (idle TTL)")
                 yield from chunks
                 if done:
                     return

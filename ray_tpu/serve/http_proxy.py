@@ -48,9 +48,14 @@ class HTTPProxy:
         self._routes: dict = {}           # prefix -> (deployment, app)
         self._handles: dict = {}
         # picks/submits touch blocking plumbing (non-blocking wait() for
-        # load probes, socket sends): keep them off the event loop
+        # load probes, socket sends): keep them off the event loop.
+        # Streaming drains get their OWN pool — a drain can legitimately
+        # block minutes between chunk batches, and sharing one capped pool
+        # would let 16 slow streams starve request admission entirely.
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="serve-proxy")
+            max_workers=32, thread_name_prefix="serve-proxy")
+        self._stream_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="serve-stream")
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
 
@@ -170,7 +175,14 @@ class HTTPProxy:
         try:
             while True:
                 ref = replica.next_chunks.remote(stream_id)
-                chunks, done = await self._aget(ref)
+                chunks, done = await self._aget(ref,
+                                                pool=self._stream_pool)
+                if chunks is None:
+                    # stream expired/unknown on the replica: abort the
+                    # connection mid-chunk (a clean EOF would present a
+                    # truncated body as a complete response)
+                    raise ConnectionError(
+                        f"stream {stream_id} expired on the replica")
                 for chunk in chunks:
                     await resp.write(_to_bytes(chunk))
                 if done:
@@ -186,13 +198,13 @@ class HTTPProxy:
             raise
         return resp
 
-    async def _aget(self, ref, timeout: float = 300.0):
-        """Await an ObjectRef on the proxy's bounded thread pool — NOT via
+    async def _aget(self, ref, timeout: float = 300.0, pool=None):
+        """Await an ObjectRef on a bounded thread pool — NOT via
         ref.future(), which spawns one OS thread per call."""
         import ray_tpu
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(
-            self._pool, lambda: ray_tpu.get(ref, timeout=timeout))
+            pool or self._pool, lambda: ray_tpu.get(ref, timeout=timeout))
 
 
 def _to_bytes(chunk) -> bytes:

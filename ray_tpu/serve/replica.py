@@ -129,13 +129,16 @@ class Replica:
 
     def next_chunks(self, stream_id: int, max_chunks: int = _STREAM_BATCH):
         """Pull the next batch of chunks from a registered stream.
-        Returns (chunks, done); the stream is dropped when done."""
+        Returns (chunks, done); the stream is dropped when done. An
+        unknown/TTL-reaped id returns (None, True) — consumers must treat
+        that as an ERROR, not a clean EOF, or a reaped stream looks like
+        a complete (truncated) response."""
         with self._lock:
             entry = self._streams.get(stream_id)
             if entry is not None:
                 entry[1] = time.time()
         if entry is None:
-            return [], True
+            return None, True
         it = entry[0]
         chunks = []
         done = False

@@ -57,6 +57,10 @@ class RunConfig:
         default_factory=CheckpointConfig)
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     verbose: int = 1
+    # Experiment callbacks (tune/loggers.py Json/CSV/TensorBoard logger
+    # callbacks, or any object with on_trial_start/result/complete/error
+    # and on_experiment_start/end hooks — reference: tune/callback.py).
+    callbacks: list = field(default_factory=list)
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -42,6 +42,12 @@ from ray_tpu.tune.trainable import (
 )
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.tpe import TPESearcher
+from ray_tpu.tune.loggers import (
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TensorBoardLoggerCallback,
+)
 
 __all__ = [
     # search space
@@ -50,6 +56,9 @@ __all__ = [
     "grid_search", "Domain", "Categorical",
     # searchers
     "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter",
+    "TPESearcher",
+    # loggers
+    "CSVLoggerCallback", "JsonLoggerCallback", "TensorBoardLoggerCallback",
     # schedulers
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",

@@ -1,0 +1,201 @@
+"""Per-trial logger callbacks: CSV, JSONL, and TensorBoard event files.
+
+Counterpart of the reference's `tune/logger/` (csv.py, json.py,
+tensorboardx.py) as controller callbacks. The TensorBoard writer encodes
+the tfrecord/Event-proto format by hand (this image vendors no tensorboard
+library): records are [len u64le][masked-crc32c(len) u32le][payload]
+[masked-crc32c(payload) u32le], and the Event/Summary protos only need
+three scalar fields each, so the wire format is ~40 lines.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import struct
+import time
+
+# ---------------------------------------------------------------------------
+# crc32c (software table; tfrecord framing requires the masked variant)
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire encoding for Event{wall_time, step, summary}
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _encode_value(tag: str, value: float) -> bytes:
+    # Summary.Value: 1 tag (string), 2 simple_value (float)
+    t = tag.encode()
+    return (_field(1, 2) + _varint(len(t)) + t
+            + _field(2, 5) + struct.pack("<f", float(value)))
+
+
+def encode_event(step: int, scalars: dict, wall_time: float | None = None
+                 ) -> bytes:
+    """Event: 1 wall_time (double), 2 step (int64), 5 summary (Summary);
+    Summary: repeated 1 value (Summary.Value)."""
+    summary = b""
+    for tag, val in scalars.items():
+        v = _encode_value(tag, val)
+        summary += _field(1, 2) + _varint(len(v)) + v
+    ev = (_field(1, 1) + struct.pack("<d", wall_time or time.time())
+          + _field(2, 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)
+          + _field(5, 2) + _varint(len(summary)) + summary)
+    return ev
+
+
+def write_record(f, payload: bytes) -> None:
+    header = struct.pack("<Q", len(payload))
+    f.write(header)
+    f.write(struct.pack("<I", _masked_crc(header)))
+    f.write(payload)
+    f.write(struct.pack("<I", _masked_crc(payload)))
+
+
+def read_records(path: str):
+    """Parse a tfevents file back into raw payloads (used by tests to
+    verify the framing + CRCs round-trip)."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return out
+            (n,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == _masked_crc(header), "corrupt length crc"
+            payload = f.read(n)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            assert pcrc == _masked_crc(payload), "corrupt payload crc"
+            out.append(payload)
+
+
+# ---------------------------------------------------------------------------
+# callbacks (duck-typed against tune_controller's _safe dispatch)
+# ---------------------------------------------------------------------------
+
+def _scalar_items(result: dict):
+    for k, v in result.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            yield k, float(v)
+
+
+class JsonLoggerCallback:
+    """result.json: one JSON line per reported result per trial
+    (reference: tune/logger/json.py)."""
+
+    def on_trial_result(self, trial, result):
+        with open(os.path.join(trial.local_dir, "result.json"), "a") as f:
+            f.write(json.dumps(
+                {k: v for k, v in result.items()
+                 if isinstance(v, (int, float, str, bool, list, dict,
+                                   type(None)))},
+                default=str) + "\n")
+
+
+class CSVLoggerCallback:
+    """progress.csv per trial; the header is the union of the first
+    result's scalar keys (reference: tune/logger/csv.py)."""
+
+    def __init__(self):
+        self._writers = {}
+
+    def on_trial_result(self, trial, result):
+        key = trial.trial_id
+        scalars = dict(_scalar_items(result))
+        if key not in self._writers:
+            path = os.path.join(trial.local_dir, "progress.csv")
+            f = open(path, "a", newline="")
+            w = csv.DictWriter(f, fieldnames=sorted(scalars))
+            if f.tell() == 0:
+                w.writeheader()
+            self._writers[key] = (f, w)
+        f, w = self._writers[key]
+        w.writerow({k: scalars.get(k) for k in w.fieldnames})
+        f.flush()
+
+    def on_experiment_end(self, trials):
+        for f, _ in self._writers.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._writers.clear()
+
+
+class TensorBoardLoggerCallback:
+    """events.out.tfevents.* per trial with every numeric result as a
+    scalar summary (reference: tune/logger/tensorboardx.py — but with a
+    built-in encoder instead of the tensorboardX dependency)."""
+
+    def __init__(self):
+        self._files = {}
+
+    def _file(self, trial):
+        key = trial.trial_id
+        if key not in self._files:
+            path = os.path.join(
+                trial.local_dir,
+                f"events.out.tfevents.{int(time.time())}.{key}")
+            f = open(path, "ab")
+            # file header event: wall_time only, step 0
+            write_record(f, encode_event(0, {}, wall_time=time.time()))
+            self._files[key] = f
+        return self._files[key]
+
+    def on_trial_result(self, trial, result):
+        step = int(result.get("training_iteration",
+                              result.get("step", 0)) or 0)
+        scalars = {f"ray_tpu/{k}": v for k, v in _scalar_items(result)}
+        if not scalars:
+            return
+        f = self._file(trial)
+        write_record(f, encode_event(step, scalars))
+        f.flush()
+
+    def on_experiment_end(self, trials):
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()

@@ -1,0 +1,220 @@
+"""Tree-structured Parzen Estimator searcher — native model-based HPO.
+
+Counterpart surface of the reference's model-based searcher wrappers
+(`tune/search/optuna/optuna_search.py`, hyperopt) — but implemented
+natively (the image vendors no HPO library), following Bergstra et al.
+2011: observations split into the best gamma-quantile ("good") and the
+rest ("bad"); each numeric dimension is modeled with Gaussian Parzen
+windows over the good/bad sets, candidates are drawn from the good
+density and ranked by the density ratio l(x)/g(x); categoricals use
+smoothed count ratios. Dimensions are treated independently (the standard
+TPE factorization).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+from ray_tpu.tune.search import (
+    Categorical,
+    Domain,
+    Float,
+    Function,
+    Integer,
+    Searcher,
+    _is_grid,
+    _walk,
+    _set_path,
+)
+
+
+class TPESearcher(Searcher):
+    """Suggest-based TPE over a param_space of sample domains.
+
+    Args:
+        param_space: dict of Domains (grid_search entries are treated as
+            categorical choices).
+        metric: result key to optimize.
+        mode: "min" or "max".
+        n_initial: random-exploration suggestions before the model engages.
+        gamma: fraction of observations modeled as "good".
+        n_candidates: candidates scored per suggestion.
+    """
+
+    # configs must be suggested lazily, AFTER earlier trials report
+    # (tuner.py defers suggest() to trial launch when this is set)
+    requires_results = True
+
+    def __init__(self, param_space: dict, metric: str, mode: str = "min",
+                 n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.param_space = param_space
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._dims = {}     # path tuple -> Domain (or Categorical for grid)
+        for path, dom in _walk(param_space):
+            if _is_grid(dom):
+                self._dims[path] = Categorical(dom["grid_search"])
+            elif isinstance(dom, Domain):
+                self._dims[path] = dom
+            # constant leaves pass through via the deepcopy in _unflatten
+        self._live: dict[str, dict] = {}       # trial_id -> flat config
+        self._history: list[tuple[dict, float]] = []   # (flat cfg, score)
+
+    # -- Searcher interface ----------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._history) < self.n_initial:
+            flat = {p: self._rand(d) for p, d in self._dims.items()}
+        else:
+            flat = {p: self._suggest_dim(p, d)
+                    for p, d in self._dims.items()}
+        self._live[trial_id] = flat
+        cfg = _unflatten(self.param_space, flat)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        flat = self._live.pop(trial_id, None)
+        if flat is None or error or not result:
+            return
+        val = result.get(self.metric)
+        if val is None:
+            return
+        score = float(val) if self.mode == "min" else -float(val)
+        self._history.append((flat, score))
+
+    # -- model ------------------------------------------------------------
+
+    def _rand(self, dom: Domain):
+        return dom.sample(self._rng)
+
+    def _split(self):
+        """Top sqrt-scaled slice is "good" (hyperopt's default_gamma:
+        ceil(gamma * sqrt(n)), capped) — a linear fraction lets the
+        model's own near-duplicate suggestions crowd the good set and the
+        search collapses onto its incumbent cluster."""
+        ordered = sorted(self._history, key=lambda t: t[1])
+        n_good = min(
+            max(2, int(math.ceil(self.gamma * math.sqrt(len(ordered))))),
+            25, len(ordered))
+        return ordered[:n_good], ordered[n_good:]
+
+    def _suggest_dim(self, path, dom: Domain):
+        if isinstance(dom, Function):
+            return self._rand(dom)      # opaque: cannot model
+        good, bad = self._split()
+        gvals = [cfg[path] for cfg, _ in good if path in cfg]
+        bvals = [cfg[path] for cfg, _ in bad if path in cfg]
+        if not gvals:
+            return self._rand(dom)
+        if isinstance(dom, Categorical):
+            return self._categorical(dom, gvals, bvals)
+        if isinstance(dom, (Float, Integer)):
+            return self._numeric(dom, gvals, bvals)
+        return self._rand(dom)
+
+    def _categorical(self, dom: Categorical, gvals, bvals):
+        cats = dom.categories
+        prior = 1.0 / max(len(cats), 1)
+
+        def probs(vals):
+            counts = {repr(c): prior for c in cats}
+            for v in vals:
+                counts[repr(v)] = counts.get(repr(v), prior) + 1.0
+            total = sum(counts.values())
+            return {k: v / total for k, v in counts.items()}
+
+        pg, pb = probs(gvals), probs(bvals)
+        # sample candidates from the good distribution, rank by ratio
+        keys = [repr(c) for c in cats]
+        weights = [pg[k] for k in keys]
+        best, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            k = self._rng.choices(range(len(cats)), weights=weights)[0]
+            ratio = pg[keys[k]] / max(pb[keys[k]], 1e-12)
+            if ratio > best_ratio:
+                best, best_ratio = cats[k], ratio
+        return best
+
+    def _numeric(self, dom, gvals, bvals):
+        log = bool(getattr(dom, "log", False))
+
+        def xform(v):
+            return math.log(v) if log else float(v)
+
+        lo, hi = xform(dom.lower), xform(dom.upper)
+        span = max(hi - lo, 1e-12)
+        sqrt2pi = math.sqrt(2 * math.pi)
+        prior = 1.0 / span
+
+        def model(vals):
+            """Adaptive Parzen (hyperopt-style): DEDUPED sorted points,
+            each with a bandwidth from its neighbor distances (extended
+            by the domain bounds). Dedup matters: repeated suggestions of
+            the incumbent would otherwise flood the good set with clones,
+            shrink a global bandwidth to zero, and collapse the search
+            onto one point."""
+            pts = sorted({round(xform(v), 12) for v in vals})
+            if not pts:
+                return [], []
+            bws = []
+            for i, p in enumerate(pts):
+                gaps = []
+                if i > 0:
+                    gaps.append(p - pts[i - 1])
+                if i + 1 < len(pts):
+                    gaps.append(pts[i + 1] - p)
+                # smallest neighbor gap = most local scale; lone points
+                # default to a quarter of the range
+                bw = min(gaps) if gaps else span / 4.0
+                bws.append(min(max(bw, span * 1e-3), span))
+            return pts, bws
+
+        gp, gbw = model(gvals)
+        bp, bbw = model(bvals)
+
+        def dens(x, pts, bws):
+            if not pts:
+                return prior
+            s = 0.0
+            for c, w in zip(pts, bws):
+                z = (x - c) / w
+                s += math.exp(-0.5 * z * z) / (w * sqrt2pi)
+            return (prior + s) / (len(pts) + 1)
+
+        best_x, best_ratio = None, -1.0
+        for i in range(self.n_candidates):
+            if i % 4 == 3 or not gp:
+                # prior-draw candidates keep exploring the full range
+                x = self._rng.uniform(lo, hi)
+            else:
+                j = self._rng.randrange(len(gp))
+                x = min(max(self._rng.gauss(gp[j], gbw[j]), lo), hi)
+            ratio = dens(x, gp, gbw) / max(dens(x, bp, bbw), 1e-300)
+            if ratio > best_ratio:
+                best_x, best_ratio = x, ratio
+        val = math.exp(best_x) if log else best_x
+        q = getattr(dom, "q", None)
+        if q:
+            val = round(val / q) * q
+        if isinstance(dom, Integer):
+            val = int(round(val))
+            val = min(max(val, dom.lower), dom.upper - 1)
+        else:
+            val = min(max(val, dom.lower), dom.upper)
+        return val
+
+
+def _unflatten(space: dict, flat: dict) -> dict:
+    import copy
+    out = copy.deepcopy(space)
+    for path, value in flat.items():
+        _set_path(out, path, value)
+    return out

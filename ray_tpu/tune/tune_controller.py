@@ -139,6 +139,26 @@ class TuneController:
             self._start_trial(trial)
 
     def _start_trial(self, trial: Trial) -> None:
+        from ray_tpu.tune.search import ConcurrencyLimiter
+        inner = (self.searcher.searcher
+                 if isinstance(self.searcher, ConcurrencyLimiter)
+                 else self.searcher)
+        if (not trial.config
+                and getattr(inner, "requires_results", False)):
+            # model-based searchers suggest lazily at launch, AFTER
+            # earlier trials reported — an upfront batch would be pure
+            # random exploration. The requires_results guard keeps this
+            # off upfront-generated searchers (whose iterator is already
+            # exhausted and would TERMINATE every trial).
+            cfg = self.searcher.suggest(trial.trial_id)
+            if cfg is None:
+                if isinstance(self.searcher, ConcurrencyLimiter):
+                    # at capacity, not exhausted: leave PENDING and retry
+                    # on a later scheduling pass
+                    return
+                trial.status = TERMINATED
+                return
+            trial.config = dict(cfg)
         actor_cls = ray_tpu.remote(**_actor_opts(trial.resources))(
             _TrialExecutor)
         trial.actor = actor_cls.remote(

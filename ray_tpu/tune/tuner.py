@@ -139,6 +139,13 @@ class Tuner:
                 if tc.max_concurrent_trials is None:
                     tc.max_concurrent_trials = searcher.max_concurrent
                 searcher = searcher.searcher
+            if getattr(searcher, "requires_results", False):
+                # model-based searcher: configs resolve lazily at launch
+                # (tune_controller._start_trial), so later suggestions see
+                # earlier results instead of being one upfront batch
+                return [Trial(new_trial_id(), {}, experiment_dir,
+                              resources)
+                        for _ in range(tc.num_samples)]
             trials = []
             tid = new_trial_id()
             total = tc.num_samples
@@ -180,6 +187,7 @@ class Tuner:
             checkpoint_frequency=ckpt_cfg.checkpoint_frequency,
             checkpoint_at_end=bool(ckpt_cfg.num_to_keep
                                    or ckpt_cfg.checkpoint_frequency),
+            callbacks=self.run_config.callbacks,
         )
         trials = controller.run()
         results = [
