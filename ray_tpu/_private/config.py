@@ -1,0 +1,89 @@
+"""Typed, documented, env-overridable runtime option table.
+
+Counterpart of the reference's RAY_CONFIG x-macro table
+(`src/ray/common/ray_config_def.h`, 204 entries + `ray_config.h:74`
+ReadEnv<T>("RAY_" + name)): every tunable is declared ONCE with its type,
+default, and doc; the environment override is `RAY_TPU_<NAME>`. The
+values in `constants.py` are all defined through this table, so the
+whole system shares one registry and `ray_tpu config list` (scripts/cli)
+can print it with current effective values.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: dict[type, Callable[[str], Any]] = {
+    int: int,
+    float: float,
+    str: str,
+    bool: _parse_bool,
+}
+
+
+@dataclass(frozen=True)
+class ConfigOption:
+    name: str            # env override: RAY_TPU_<name>
+    type: type
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return "RAY_TPU_" + self.name
+
+    def current(self) -> Any:
+        raw = os.environ.get(self.env_var)
+        if raw is None:
+            return self.default
+        try:
+            return _PARSERS[self.type](raw)
+        except (ValueError, KeyError):
+            raise ValueError(
+                f"invalid value {raw!r} for {self.env_var} "
+                f"(expected {self.type.__name__})") from None
+
+
+OPTIONS: dict[str, ConfigOption] = {}
+
+
+def define(name: str, type_: type, default: Any, doc: str) -> Any:
+    """Register an option and return its effective value (resolved once
+    at import, like the reference's static RayConfig instance)."""
+    if name in OPTIONS:
+        raise ValueError(f"config option {name} defined twice")
+    opt = ConfigOption(name, type_, default, doc)
+    OPTIONS[name] = opt
+    return opt.current()
+
+
+def get(name: str) -> Any:
+    """Re-resolve an option against the current environment (tests and
+    subprocess-facing code paths that must see fresh overrides)."""
+    return OPTIONS[name].current()
+
+
+def describe() -> list:
+    """Rows for `ray_tpu config list`: (name, type, default, current,
+    overridden, doc)."""
+    rows = []
+    for name in sorted(OPTIONS):
+        opt = OPTIONS[name]
+        cur = opt.current()
+        rows.append({
+            "name": name,
+            "env": opt.env_var,
+            "type": opt.type.__name__,
+            "default": opt.default,
+            "current": cur,
+            "overridden": cur != opt.default,
+            "doc": opt.doc,
+        })
+    return rows

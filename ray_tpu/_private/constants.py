@@ -1,47 +1,47 @@
 """Session-wide constants for the ray_tpu core runtime.
 
-Counterpart of the reference's `python/ray/_private/ray_constants.py` plus the
-native config table (`src/ray/common/ray_config_def.h`): every tunable is
-env-overridable with the ``RAY_TPU_`` prefix, mirroring the reference's
-``RAY_<name>`` convention (ray_config.h:74).
+Counterpart of the reference's `python/ray/_private/ray_constants.py` plus
+the native config table (`src/ray/common/ray_config_def.h`): every tunable
+is declared once in the typed option table (`_private/config.py` —
+name, type, default, doc) and env-overridable with the ``RAY_TPU_``
+prefix, mirroring the reference's ``RAY_<name>`` convention
+(ray_config.h:74). `ray_tpu config list` (scripts/cli.py) prints the
+table with effective values.
 """
 
 import os
 
+from ray_tpu._private.config import define
 
-def _env_int(name: str, default: int) -> int:
-    return int(os.environ.get("RAY_TPU_" + name, default))
-
-
-def _env_float(name: str, default: float) -> float:
-    return float(os.environ.get("RAY_TPU_" + name, default))
-
-
-def _env_str(name: str, default: str) -> str:
-    return os.environ.get("RAY_TPU_" + name, default)
-
-
-# Objects whose serialized envelope is at most this many bytes travel inline in
-# control messages; larger ones go to the shared-memory store (the reference
-# inlines <=100KB returns in the gRPC reply, core_worker.cc).
-INLINE_OBJECT_MAX_BYTES = _env_int("INLINE_OBJECT_MAX_BYTES", 100 * 1024)
+# Objects whose serialized envelope is at most this many bytes travel inline
+# in control messages; larger ones go to the shared-memory store (the
+# reference inlines <=100KB returns in the gRPC reply, core_worker.cc).
+INLINE_OBJECT_MAX_BYTES = define(
+    "INLINE_OBJECT_MAX_BYTES", int, 100 * 1024,
+    "Objects at most this many serialized bytes ride inline in control "
+    "messages instead of the shared-memory store.")
 
 # Where shared-memory object files live (tmpfs). The reference mounts plasma
 # over /dev/shm (plasma/store.h); we use one file per object under a session
 # directory, which keeps ownership trivially correct (driver unlinks on exit).
-SHM_ROOT = _env_str("SHM_ROOT", "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
+SHM_ROOT = define(
+    "SHM_ROOT", str, "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp",
+    "Root for session directories (object arena + sockets); tmpfs.")
 
 SESSION_PREFIX = "ray_tpu_session_"
 
-# Worker pool sizing: hard cap on generic (non-actor) worker processes.
-MAX_WORKERS_CAP = _env_int("MAX_WORKERS_CAP", 32)
+MAX_WORKERS_CAP = define(
+    "MAX_WORKERS_CAP", int, 32,
+    "Hard cap on generic (pool) worker processes per node.")
 
-# Seconds to wait for a spawned worker process to phone home before declaring
-# startup failure (reference: worker_register_timeout_seconds).
-WORKER_REGISTER_TIMEOUT_S = _env_float("WORKER_REGISTER_TIMEOUT_S", 60.0)
+WORKER_REGISTER_TIMEOUT_S = define(
+    "WORKER_REGISTER_TIMEOUT_S", float, 60.0,
+    "Seconds to wait for a spawned worker/daemon to phone home before "
+    "declaring startup failure (reference: "
+    "worker_register_timeout_seconds).")
 
-# Default resource requests (reference: task default num_cpus=1; actors hold 0
-# lifetime CPUs unless explicitly requested — ray_option_utils.py).
+# Default resource requests (reference: task default num_cpus=1; actors hold
+# 0 lifetime CPUs unless explicitly requested — ray_option_utils.py).
 DEFAULT_TASK_NUM_CPUS = 1.0
 DEFAULT_ACTOR_LIFETIME_CPUS = 0.0
 
@@ -52,41 +52,65 @@ BUFFER_ALIGNMENT = 64
 # Polling granularity for blocking waits.
 WAIT_POLL_S = 0.01
 
-# How many times a lost task-produced object may be rebuilt from lineage
-# before readers get ObjectLostError (reference: task max retries gate
-# reconstruction, object_recovery_manager.h:41 + task_manager.h:173).
-MAX_OBJECT_RECONSTRUCTIONS = _env_int("MAX_OBJECT_RECONSTRUCTIONS", 3)
-
-# Lineage table caps: specs of recent task-produced objects are kept for
-# reconstruction, bounded BOTH by entry count and by accumulated spec
-# bytes (function blobs + inline args — the reference's
-# RAY_max_lineage_bytes); oldest entries evict first and their objects
-# simply stop being reconstructable.
-MAX_LINEAGE_ENTRIES = _env_int("MAX_LINEAGE_ENTRIES", 100_000)
-MAX_LINEAGE_BYTES = _env_int("MAX_LINEAGE_BYTES", 256 * 1024 * 1024)
-
-# Object spilling (reference: LocalObjectManager + external_storage.py
-# FileSystemStorage): arena-overflow objects and proactively spilled
-# objects land under OBJECT_SPILL_ROOT on real disk — NOT tmpfs — so a
-# session's shm usage is bounded by the arena capacity. The store owner
-# spills sealed objects above SPILL_HIGH_WATER of arena capacity until
-# usage drops below SPILL_LOW_WATER.
-OBJECT_SPILL_ROOT = _env_str("OBJECT_SPILL_ROOT", "/tmp/ray_tpu_spill")
-SPILL_HIGH_WATER = _env_float("SPILL_HIGH_WATER", 0.80)
-SPILL_LOW_WATER = _env_float("SPILL_LOW_WATER", 0.50)
-
-# Memory monitor (reference: memory_monitor.h:52 + worker-killing
-# policies): when host memory usage exceeds the threshold fraction, the
-# newest worker running a retriable task is killed (and retried) instead
-# of letting the OS OOM-killer take down a daemon. 0 disables.
-MEMORY_MONITOR_THRESHOLD = _env_float("MEMORY_MONITOR_THRESHOLD", 0.95)
-MEMORY_MONITOR_INTERVAL_S = _env_float("MEMORY_MONITOR_INTERVAL_S", 1.0)
-
-# How many task submissions a single client may have in flight before
-# submit blocks (simple backpressure; reference has per-lease backlogs).
-MAX_INFLIGHT_SUBMISSIONS = _env_int("MAX_INFLIGHT_SUBMISSIONS", 100000)
+MAX_INFLIGHT_SUBMISSIONS = define(
+    "MAX_INFLIGHT_SUBMISSIONS", int, 100_000,
+    "How many task submissions a single client may have in flight before "
+    "submit blocks (reference has per-lease backlogs).")
 
 # Env var handed to workers that were allocated TPU chips, mirroring how the
 # reference sets CUDA_VISIBLE_DEVICES from the resource assignment
 # (_private/utils.py:342-355).
 TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+
+MAX_OBJECT_RECONSTRUCTIONS = define(
+    "MAX_OBJECT_RECONSTRUCTIONS", int, 3,
+    "How many times a lost task-produced object may be rebuilt from "
+    "lineage before readers get ObjectLostError (reference: task max "
+    "retries gate reconstruction, object_recovery_manager.h:41).")
+
+MAX_LINEAGE_ENTRIES = define(
+    "MAX_LINEAGE_ENTRIES", int, 100_000,
+    "Lineage table entry cap; oldest specs evict first and their objects "
+    "stop being reconstructable.")
+
+MAX_LINEAGE_BYTES = define(
+    "MAX_LINEAGE_BYTES", int, 256 * 1024 * 1024,
+    "Lineage table byte cap over retained specs (function blobs + inline "
+    "args) — the reference's RAY_max_lineage_bytes.")
+
+OBJECT_SPILL_ROOT = define(
+    "OBJECT_SPILL_ROOT", str, "/tmp/ray_tpu_spill",
+    "Real-disk root for arena-overflow and spilled objects (reference: "
+    "external_storage.py FileSystemStorage); bounds shm usage by the "
+    "arena capacity.")
+
+SPILL_HIGH_WATER = define(
+    "SPILL_HIGH_WATER", float, 0.80,
+    "Arena-usage fraction above which the store owner spills sealed "
+    "objects to disk (local_object_manager.h:110).")
+
+SPILL_LOW_WATER = define(
+    "SPILL_LOW_WATER", float, 0.50,
+    "Spill passes drain arena usage down to this fraction.")
+
+MEMORY_MONITOR_THRESHOLD = define(
+    "MEMORY_MONITOR_THRESHOLD", float, 0.95,
+    "Host/cgroup memory-usage fraction above which the newest retriable "
+    "worker is killed (memory_monitor.h:52); 0 disables.")
+
+MEMORY_MONITOR_INTERVAL_S = define(
+    "MEMORY_MONITOR_INTERVAL_S", float, 1.0,
+    "Memory monitor poll interval in seconds.")
+
+OBJECT_STORE_BYTES = define(
+    "OBJECT_STORE_BYTES", int, 512 * 1024 * 1024,
+    "Shared-memory arena capacity per node (plasma store size analog).")
+
+RUNTIME_ENV_CACHE = define(
+    "RUNTIME_ENV_CACHE", str, "/tmp/ray_tpu_runtime_envs",
+    "Content-addressed cache dir for materialized runtime environments "
+    "(working_dir copies, pip venvs; reference: uri_cache.py).")
+
+RUNTIME_ENV_CACHE_ENTRIES = define(
+    "RUNTIME_ENV_CACHE_ENTRIES", int, 20,
+    "LRU cap on cached runtime-env entries.")

@@ -17,8 +17,8 @@ import os
 from ray_tpu._private import native as _native
 
 def _default_capacity() -> int:
-    return int(os.environ.get(
-        "RAY_TPU_OBJECT_STORE_BYTES", str(512 * 1024 * 1024)))
+    from ray_tpu._private.constants import OBJECT_STORE_BYTES
+    return OBJECT_STORE_BYTES
 
 
 class _Lib:

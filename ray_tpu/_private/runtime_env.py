@@ -34,10 +34,10 @@ import time
 
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
-_CACHE_ROOT = os.environ.get("RAY_TPU_RUNTIME_ENV_CACHE",
-                             "/tmp/ray_tpu_runtime_envs")
-_MAX_CACHE_ENTRIES = int(os.environ.get(
-    "RAY_TPU_RUNTIME_ENV_CACHE_ENTRIES", "20"))
+from ray_tpu._private.constants import (
+    RUNTIME_ENV_CACHE as _CACHE_ROOT,
+    RUNTIME_ENV_CACHE_ENTRIES as _MAX_CACHE_ENTRIES,
+)
 
 _SETUP_KEYS = ("working_dir", "pip", "py_modules", "env_vars")
 

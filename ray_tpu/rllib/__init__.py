@@ -22,10 +22,12 @@ way `Algorithm(Trainable)` does in the reference
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rllib.algorithms import (
     A2C, A2CConfig, APPO, APPOConfig, Algorithm, AlgorithmConfig, BC,
-    BCConfig, CQL, CQLConfig, DQN, DQNConfig, IMPALA, IMPALAConfig, MARWIL,
-    MARWILConfig, PPO, PPOConfig, SAC, SACConfig, get_algorithm_class,
+    BCConfig, CQL, CQLConfig, DDPG, DDPGConfig, DQN, DQNConfig, IMPALA,
+    IMPALAConfig, MAPPOConfig, MARWIL, MARWILConfig, MultiAgentPPO, PPO,
+    PPOConfig, SAC, SACConfig, TD3, TD3Config, get_algorithm_class,
     register_algorithm)
 from ray_tpu.rllib.env.jax_env import make_env, register_env
+from ray_tpu.rllib.env.multi_agent import CoopMatch, MultiAgentJaxEnv
 
 __all__ = [
     "SampleBatch", "concat_samples",
@@ -34,4 +36,6 @@ __all__ = [
     "IMPALA", "IMPALAConfig", "make_env", "register_env",
     "A2C", "A2CConfig", "APPO", "APPOConfig", "SAC", "SACConfig",
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+    "DDPG", "DDPGConfig", "TD3", "TD3Config",
+    "MultiAgentPPO", "MAPPOConfig", "MultiAgentJaxEnv", "CoopMatch",
 ]

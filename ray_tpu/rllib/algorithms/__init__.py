@@ -9,10 +9,14 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.ddpg import (
+    DDPG, DDPGConfig, TD3, TD3Config)
+from ray_tpu.rllib.algorithms.ma_ppo import MAPPOConfig, MultiAgentPPO
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
            "IMPALA", "IMPALAConfig", "A2C", "A2CConfig",
            "APPO", "APPOConfig", "SAC", "SACConfig",
            "BC", "BCConfig", "MARWIL", "MARWILConfig",
-           "CQL", "CQLConfig"]
+           "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
+           "MultiAgentPPO", "MAPPOConfig"]

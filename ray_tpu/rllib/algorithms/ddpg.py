@@ -1,0 +1,292 @@
+"""DDPG and TD3 — deterministic-policy continuous control.
+
+Counterpart of the reference's `rllib/algorithms/ddpg/` and `td3.py`
+(ddpg_torch_policy build_ddpg_models/ddpg_actor_critic_loss): a
+deterministic actor with exploration noise, Q critic(s) with polyak
+targets; TD3 (`td3.py` configures DDPG with the three fixes from
+Fujimoto et al.) adds twin critics with a min target, target-policy
+smoothing noise, and delayed actor updates. Same TPU shape as sac.py:
+compiled vmap+scan rollout, host replay, K fused updates per dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.algorithms.off_policy import (
+    QNet, drain_episode_returns, scale_action, stack_replay_batches)
+from ray_tpu.rllib.env.jax_env import is_jax_env, make_env
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class _DetActor(nn.Module):
+    act_dim: int
+    hiddens: Tuple[int, ...] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return jnp.tanh(nn.Dense(self.act_dim)(x))   # [-1, 1]
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.buffer_size = 100_000
+        self.learning_starts = 1500
+        self.tau = 0.005
+        self.exploration_noise = 0.1      # sigma of action-space noise
+        self.twin_q = False               # TD3 fix #1
+        self.policy_delay = 1             # TD3 fix #2 (delayed actor)
+        self.target_noise = 0.0           # TD3 fix #3 (smoothing sigma)
+        self.target_noise_clip = 0.5
+        self.no_done_at_end = False
+        self.n_updates_per_iter = 32
+        self.rollout_fragment_length = 8
+        self.num_envs_per_worker = 16
+        self.model = {"fcnet_hiddens": (256, 256)}
+
+
+class TD3Config(DDPGConfig):
+    """DDPG + the three TD3 fixes enabled (reference: td3.py defaults)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2
+
+
+class DDPG(Algorithm):
+    _config_class = DDPGConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_jax_env(self.env):
+            raise ValueError("DDPG/TD3 require a JaxEnv (in-graph sampler)")
+        if not isinstance(self.env.action_space, Box):
+            raise ValueError("DDPG/TD3 require a Box action space")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._build()
+
+    def _build(self) -> None:
+        cfg = self.algo_config
+        obs_dim = int(np.prod(self.env.observation_space.shape))
+        self._act_dim = int(np.prod(self.env.action_space.shape))
+        self._act_low = jnp.asarray(self.env.action_space.low)
+        self._act_high = jnp.asarray(self.env.action_space.high)
+        hiddens = tuple(cfg.model.get("fcnet_hiddens", (256, 256)))
+        self.actor = _DetActor(self._act_dim, hiddens)
+        self.q1 = QNet(hiddens)
+        self.q2 = QNet(hiddens)
+        dummy_o = jnp.zeros((1, obs_dim))
+        dummy_a = jnp.zeros((1, self._act_dim))
+        k1, k2, k3 = jax.random.split(self.next_key(), 3)
+        self.params = {
+            "actor": self.actor.init(k1, dummy_o),
+            "q1": self.q1.init(k2, dummy_o, dummy_a),
+            "q2": self.q2.init(k3, dummy_o, dummy_a),
+        }
+        self.target = jax.tree.map(jnp.copy, self.params)
+        # SEPARATE optimizers for actor and critics: TD3's delayed policy
+        # update must freeze the actor's params AND its Adam moments on
+        # skip steps (a zero gradient through a shared Adam still moves
+        # the actor via stale momentum)
+        self.critic_opt = optax.adam(cfg.lr)
+        self.actor_opt = optax.adam(cfg.lr)
+        self.opt_state = {
+            "critic": self.critic_opt.init(
+                {"q1": self.params["q1"], "q2": self.params["q2"]}),
+            "actor": self.actor_opt.init(self.params["actor"]),
+        }
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._steps_sampled = 0
+        self._updates_done = 0
+        keys = jax.random.split(self.next_key(), cfg.num_envs_per_worker)
+        state, obs = jax.vmap(self.env.reset)(keys)
+        self._carry = {"env_state": state, "obs": obs,
+                       "ep_ret": jnp.zeros(cfg.num_envs_per_worker)}
+        self._sample_fn = jax.jit(self._sample_impl)
+        self._update_many_fn = jax.jit(self._update_many)
+        self._ep_returns: list = []
+
+    def _scale(self, act_tanh):
+        return scale_action(self._act_low, self._act_high, act_tanh)
+
+    # -- compiled rollout --------------------------------------------------
+
+    def _sample_impl(self, params, carry, key):
+        cfg = self.algo_config
+
+        def one_step(carry, step_key):
+            k_noise, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            act = self.actor.apply(params["actor"], obs)
+            noise = cfg.exploration_noise * jax.random.normal(
+                k_noise, act.shape)
+            act = jnp.clip(act + noise, -1.0, 1.0)
+            env_keys = jax.random.split(k_env, cfg.num_envs_per_worker)
+            state, next_obs, reward, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], self._scale(act), env_keys)
+            ep_ret = carry["ep_ret"] + reward
+            out = {sb.OBS: obs, sb.ACTIONS: act, sb.REWARDS: reward,
+                   sb.NEXT_OBS: next_obs, sb.DONES: done,
+                   "episode_return": jnp.where(done, ep_ret, jnp.nan)}
+            new_carry = {"env_state": state, "obs": next_obs,
+                         "ep_ret": jnp.where(done, 0.0, ep_ret)}
+            return new_carry, out
+
+        keys = jax.random.split(key, cfg.rollout_fragment_length)
+        return jax.lax.scan(one_step, carry, keys)
+
+    # -- fused update ------------------------------------------------------
+
+    def _one_update(self, params, target, opt_state, batch, key,
+                    update_idx):
+        cfg = self.algo_config
+
+        def critic_loss_fn(cp):
+            act_t = self.actor.apply(target["actor"], batch[sb.NEXT_OBS])
+            if cfg.target_noise > 0:
+                noise = jnp.clip(
+                    cfg.target_noise * jax.random.normal(key, act_t.shape),
+                    -cfg.target_noise_clip, cfg.target_noise_clip)
+                act_t = jnp.clip(act_t + noise, -1.0, 1.0)
+            tq1 = self.q1.apply(target["q1"], batch[sb.NEXT_OBS], act_t)
+            if cfg.twin_q:
+                tq2 = self.q2.apply(target["q2"], batch[sb.NEXT_OBS], act_t)
+                tq = jnp.minimum(tq1, tq2)
+            else:
+                tq = tq1
+            if cfg.no_done_at_end:
+                nonterm = jnp.ones_like(batch[sb.REWARDS])
+            else:
+                nonterm = 1.0 - batch[sb.DONES].astype(jnp.float32)
+            y = jax.lax.stop_gradient(
+                batch[sb.REWARDS] + cfg.gamma * nonterm * tq)
+            q1 = self.q1.apply(cp["q1"], batch[sb.OBS], batch[sb.ACTIONS])
+            loss = jnp.mean((q1 - y) ** 2)
+            if cfg.twin_q:
+                q2 = self.q2.apply(cp["q2"], batch[sb.OBS],
+                                   batch[sb.ACTIONS])
+                loss = loss + jnp.mean((q2 - y) ** 2)
+            return loss
+
+        cparams = {"q1": params["q1"], "q2": params["q2"]}
+        critic_loss, cgrads = jax.value_and_grad(critic_loss_fn)(cparams)
+        cupd, copt = self.critic_opt.update(
+            cgrads, opt_state["critic"], cparams)
+        cparams = optax.apply_updates(cparams, cupd)
+
+        def actor_loss_fn(ap):
+            act = self.actor.apply(ap, batch[sb.OBS])
+            q = self.q1.apply(jax.lax.stop_gradient(cparams["q1"]),
+                              batch[sb.OBS], act)
+            return -jnp.mean(q)
+
+        def do_actor(ap_opt):
+            ap, aopt = ap_opt
+            _, agrads = jax.value_and_grad(actor_loss_fn)(ap)
+            aupd, aopt = self.actor_opt.update(agrads, aopt, ap)
+            return optax.apply_updates(ap, aupd), aopt
+
+        # TD3 fix #2: on skip steps BOTH the actor params and its
+        # optimizer state pass through untouched
+        aparams, aopt = jax.lax.cond(
+            update_idx % cfg.policy_delay == 0,
+            do_actor, lambda x: x,
+            (params["actor"], opt_state["actor"]))
+
+        params = {"actor": aparams, "q1": cparams["q1"],
+                  "q2": cparams["q2"]}
+        opt_state = {"critic": copt, "actor": aopt}
+        target = jax.tree.map(
+            lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, target, params)
+        return params, target, opt_state, critic_loss
+
+    def _update_many(self, params, target, opt_state, batches, key,
+                     start_idx):
+        keys = jax.random.split(key, batches[sb.REWARDS].shape[0])
+        idxs = start_idx + jnp.arange(batches[sb.REWARDS].shape[0])
+
+        def one(state, xs):
+            params, target, opt_state = state
+            batch, k, i = xs
+            params, target, opt_state, loss = self._one_update(
+                params, target, opt_state, batch, k, i)
+            return (params, target, opt_state), loss
+
+        (params, target, opt_state), losses = jax.lax.scan(
+            one, (params, target, opt_state), (batches, keys, idxs))
+        return params, target, opt_state, losses
+
+    # ----------------------------------------------------------------------
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        self._carry, traj = self._sample_fn(
+            self.params, self._carry, self.next_key())
+        host = {k: np.asarray(v) for k, v in traj.items()}
+        flat = drain_episode_returns(host, self._ep_returns)
+        self.buffer.add_batch(flat)
+        self._steps_sampled += len(flat[sb.REWARDS])
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            batches = stack_replay_batches(
+                self.buffer, cfg.n_updates_per_iter, cfg.train_batch_size)
+            (self.params, self.target, self.opt_state,
+             loss_v) = self._update_many_fn(
+                self.params, self.target, self.opt_state, batches,
+                self.next_key(), jnp.asarray(self._updates_done))
+            self._updates_done += cfg.n_updates_per_iter
+            losses = np.asarray(loss_v).tolist()
+        return {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "num_env_steps_sampled": self._steps_sampled,
+            "buffer_size": len(self.buffer),
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        obs = jnp.asarray(obs)[None]
+        act = self.actor.apply(self.params["actor"], obs)
+        if explore:
+            act = jnp.clip(
+                act + self.algo_config.exploration_noise
+                * jax.random.normal(self.next_key(), act.shape),
+                -1.0, 1.0)
+        return np.asarray(self._scale(act))[0]
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "target": self.target,
+                "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target = state["target"]
+        self.opt_state = state["opt_state"]
+
+
+class TD3(DDPG):
+    _config_class = TD3Config
+
+
+register_algorithm("DDPG", DDPG)
+register_algorithm("TD3", TD3)

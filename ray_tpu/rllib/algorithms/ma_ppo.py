@@ -1,0 +1,273 @@
+"""Multi-agent PPO: per-policy modules + policy mapping over a fixed
+agent set.
+
+Counterpart of the reference's multi-agent stack
+(`rllib/env/multi_agent_env.py` + `policy/policy_map.py` + the
+policies/policy_mapping_fn config surface of algorithm_config.py). The
+TPU-native shape keeps everything in one compiled program: the policy
+mapping is resolved at TRACE time (the agent set is fixed), so the
+rollout scan applies each agent's policy network inline, GAE runs per
+agent, and the per-policy SGD loops over concatenated agent batches —
+one XLA program per iteration, no per-agent Python dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, register_algorithm)
+from ray_tpu.rllib.algorithms.ppo import PPOConfig, _gae_scan, _ppo_loss
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.env.jax_env import make_env
+from ray_tpu.rllib.env.multi_agent import is_multi_agent_env
+
+
+class MAPPOConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MultiAgentPPO)
+        self.policies: dict = {}           # pid -> None (spaces from env)
+        self.policy_mapping_fn = None      # (agent_id) -> pid
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None):
+        """Reference: AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...)."""
+        if policies is not None:
+            self.policies = (dict.fromkeys(policies)
+                             if not isinstance(policies, dict)
+                             else dict(policies))
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    _config_class = MAPPOConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_multi_agent_env(self.env):
+            raise ValueError("MultiAgentPPO requires a MultiAgentJaxEnv")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.agent_ids = tuple(self.env.agent_ids)
+        if not cfg.policies:
+            cfg.policies = {"default_policy": None}
+        mapping = cfg.policy_mapping_fn or (
+            lambda aid: next(iter(cfg.policies)))
+        # resolved ONCE — the mapping is static for the compiled program
+        self._agent_policy = {aid: mapping(aid) for aid in self.agent_ids}
+        unknown = set(self._agent_policy.values()) - set(cfg.policies)
+        if unknown:
+            raise ValueError(
+                f"policy_mapping_fn returned unknown policies {unknown}")
+        self.modules = {}
+        self.params = {}
+        for pid in cfg.policies:
+            # spaces come from any agent mapped to this policy
+            aid = next(
+                (a for a, p in self._agent_policy.items() if p == pid),
+                None)
+            if aid is None:
+                raise ValueError(
+                    f"policy {pid!r} has no agent mapped to it "
+                    f"(mapping: {self._agent_policy}); drop it from "
+                    "`policies` or fix policy_mapping_fn")
+            mod = RLModule(self.env.observation_space(aid),
+                           self.env.action_space(aid), dict(cfg.model))
+            self.modules[pid] = mod
+            self.params[pid] = mod.init(self.next_key())
+        chain = []
+        if cfg.grad_clip:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.adam(cfg.lr))
+        self.optimizer = optax.chain(*chain)
+        # one optimizer STATE per policy: a shared Adam state over the
+        # whole dict would keep moving policy B from its stale momentum
+        # while policy A trains (zero grad != no Adam update)
+        self.opt_state = {pid: self.optimizer.init(self.params[pid])
+                          for pid in cfg.policies}
+        keys = jax.random.split(self.next_key(), cfg.num_envs_per_worker)
+        state, obs = jax.vmap(self.env.reset)(keys)
+        self._carry = {"env_state": state, "obs": obs,
+                       "ep_ret": {aid: jnp.zeros(cfg.num_envs_per_worker)
+                                  for aid in self.agent_ids}}
+        self._train_fn = jax.jit(self._fused_iteration)
+        self._ep_returns: list = []
+
+    # -- compiled rollout + per-policy SGD ---------------------------------
+
+    def _unroll(self, params, carry, key):
+        cfg = self.algo_config
+
+        def one_step(carry, step_key):
+            k_act, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            actions, logps, values = {}, {}, {}
+            akeys = jax.random.split(k_act, len(self.agent_ids))
+            for i, aid in enumerate(self.agent_ids):
+                pid = self._agent_policy[aid]
+                dist, value = self.modules[pid].forward(params[pid],
+                                                        obs[aid])
+                act = dist.sample(akeys[i])
+                actions[aid] = act
+                logps[aid] = dist.logp(act)
+                values[aid] = value
+            env_keys = jax.random.split(k_env, cfg.num_envs_per_worker)
+            state, next_obs, rewards, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], actions, env_keys)
+            ep_ret = {aid: carry["ep_ret"][aid] + rewards[aid]
+                      for aid in self.agent_ids}
+            out = {
+                "obs": obs, "actions": actions, "logps": logps,
+                "values": values, "rewards": rewards, "done": done,
+                "episode_return": {
+                    aid: jnp.where(done, ep_ret[aid], jnp.nan)
+                    for aid in self.agent_ids},
+            }
+            new_carry = {
+                "env_state": state, "obs": next_obs,
+                "ep_ret": {aid: jnp.where(done, 0.0, ep_ret[aid])
+                           for aid in self.agent_ids}}
+            return new_carry, out
+
+        keys = jax.random.split(key, cfg.rollout_fragment_length)
+        carry, traj = jax.lax.scan(one_step, carry, keys)
+        # bootstrap values at the final obs, per agent
+        last_values = {}
+        for aid in self.agent_ids:
+            pid = self._agent_policy[aid]
+            _, v = self.modules[pid].forward(params[pid],
+                                             carry["obs"][aid])
+            last_values[aid] = v
+        return carry, traj, last_values
+
+    def _fused_iteration(self, params, opt_state, carry, key):
+        cfg = self.algo_config
+        k_sample, k_sgd = jax.random.split(key)
+        carry, traj, last_values = self._unroll(params, carry, k_sample)
+        # per-agent GAE, then group flattened batches by policy
+        per_policy: dict[str, list] = {pid: [] for pid in cfg.policies}
+        for aid in self.agent_ids:
+            pid = self._agent_policy[aid]
+            advs = _gae_scan(traj["rewards"][aid], traj["values"][aid],
+                             traj["done"], last_values[aid],
+                             cfg.gamma, cfg.lambda_)
+            targets = advs + traj["values"][aid]
+            flat = {
+                sb.OBS: traj["obs"][aid].reshape(
+                    (-1,) + traj["obs"][aid].shape[2:]),
+                sb.ACTIONS: traj["actions"][aid].reshape(
+                    (-1,) + traj["actions"][aid].shape[2:]),
+                sb.ACTION_LOGP: traj["logps"][aid].reshape(-1),
+                sb.ADVANTAGES: advs.reshape(-1),
+                sb.VALUE_TARGETS: targets.reshape(-1),
+            }
+            per_policy[pid].append(flat)
+        stats_by_policy = {}
+        params = dict(params)
+        opt_state = dict(opt_state)
+        for pid, parts in per_policy.items():
+            if not parts:
+                continue
+            batch = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+            params[pid], opt_state[pid], stats = self._sgd_policy(
+                pid, params[pid], opt_state[pid], batch, k_sgd)
+            stats_by_policy[pid] = stats
+        ep = {aid: traj["episode_return"][aid] for aid in self.agent_ids}
+        return params, opt_state, carry, stats_by_policy, ep
+
+    def _sgd_policy(self, pid, params, opt_state, flat, key):
+        """Minibatch SGD on ONE policy's params with its OWN optimizer
+        state — other policies are structurally untouched."""
+        cfg = self.algo_config
+        n = flat[sb.ADVANTAGES].shape[0]
+        mb = min(cfg.sgd_minibatch_size, n)
+        num_mb = max(n // mb, 1)
+        adv = flat[sb.ADVANTAGES]
+        flat = dict(flat)
+        flat[sb.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        loss_fn = functools.partial(
+            _ppo_loss, self.modules[pid],
+            clip_param=cfg.clip_param, vf_clip_param=cfg.vf_clip_param,
+            vf_loss_coeff=cfg.vf_loss_coeff,
+            entropy_coeff=cfg.entropy_coeff)
+
+        def one_minibatch(state, batch):
+            params, opt_state = state
+            (_, stats), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch), has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), stats
+
+        def one_epoch(state, epoch_key):
+            perm = jax.random.permutation(epoch_key, n)
+            shuffled = jax.tree.map(
+                lambda v: v[perm][:num_mb * mb].reshape(
+                    (num_mb, mb) + v.shape[1:]), flat)
+            state, stats = jax.lax.scan(one_minibatch, state, shuffled)
+            return state, jax.tree.map(jnp.mean, stats)
+
+        epoch_keys = jax.random.split(key, cfg.num_sgd_iter)
+        (params, opt_state), stats = jax.lax.scan(
+            one_epoch, (params, opt_state), epoch_keys)
+        return params, opt_state, jax.tree.map(jnp.mean, stats)
+
+    # ----------------------------------------------------------------------
+
+    def training_step(self) -> dict:
+        self.params, self.opt_state, self._carry, stats, ep = \
+            self._train_fn(self.params, self.opt_state, self._carry,
+                           self.next_key())
+        # mean finished-episode return per agent, then summed over agents
+        # (the reference reports episode_reward_mean as the episode's
+        # TOTAL reward across agents)
+        totals = []
+        for aid in self.agent_ids:
+            rets = np.asarray(ep[aid]).ravel()
+            rets = rets[~np.isnan(rets)]
+            if rets.size:
+                totals.append(rets.mean())
+        if totals:
+            self._ep_returns.append(float(np.sum(totals)))
+            self._ep_returns = self._ep_returns[-50:]
+        metrics = {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+        }
+        for pid, s in stats.items():
+            for k, v in s.items():
+                metrics[f"{pid}/{k}"] = float(np.asarray(v))
+        return metrics
+
+    def compute_actions(self, obs_dict: dict, explore: bool = False):
+        """Per-agent greedy/sampled actions for serving/eval."""
+        out = {}
+        for aid, obs in obs_dict.items():
+            pid = self._agent_policy[aid]
+            dist, _ = self.modules[pid].forward(
+                self.params[pid], jnp.asarray(obs)[None])
+            act = (dist.sample(self.next_key()) if explore
+                   else dist.deterministic())
+            out[aid] = np.asarray(act)[0]
+        return out
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("MultiAgentPPO", MultiAgentPPO)

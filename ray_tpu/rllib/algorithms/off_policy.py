@@ -1,0 +1,52 @@
+"""Shared off-policy plumbing for SAC / DDPG / TD3 (and future family
+members): the critic network, tanh-action scaling, replay-batch stacking
+for fused K-update scans, and episode-return bookkeeping. One
+implementation — these were identical in each algorithm and drift in one
+copy would silently skew the others."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class QNet(nn.Module):
+    """Q(s, a) critic MLP (reference: ddpg/sac torch models)."""
+    hiddens: Tuple[int, ...] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+def scale_action(low, high, act_tanh):
+    """[-1, 1] policy output -> env action bounds."""
+    return low + (act_tanh + 1.0) * 0.5 * (high - low)
+
+
+def stack_replay_batches(buffer, k: int, batch_size: int) -> dict:
+    """Sample k*batch_size transitions and reshape to [k, B, ...] so the
+    learner scans K fused updates in one dispatch."""
+    flat = buffer.sample(k * batch_size)
+    return {
+        name: jnp.asarray(v).reshape((k, batch_size) + v.shape[1:])
+        for name, v in flat.items() if name != "batch_indexes"}
+
+
+def drain_episode_returns(traj_host: dict, ep_returns: list,
+                          cap: int = 100) -> dict:
+    """Pop per-step `episode_return` (NaN = unfinished) from a host-side
+    trajectory, fold finished returns into the rolling window, and return
+    the remaining fields flattened to [T*B, ...]."""
+    rets = traj_host.pop("episode_return").ravel()
+    fin = ~np.isnan(rets)
+    ep_returns.extend(rets[fin].tolist())
+    del ep_returns[:-cap]
+    return {k: v.reshape((-1,) + v.shape[2:])
+            for k, v in traj_host.items()}

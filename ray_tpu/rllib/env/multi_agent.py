@@ -1,0 +1,100 @@
+"""Multi-agent environments — fixed agent sets as pytree dicts.
+
+Counterpart of the reference's `rllib/env/multi_agent_env.py`
+(MultiAgentEnv: per-agent obs/reward dicts, "__all__" done). TPU-native
+difference: the agent set is FIXED and known at trace time, so per-agent
+dicts are just pytree structure — `jax.vmap` still vectorizes over
+environments and `lax.scan` compiles the unroll, exactly like JaxEnv.
+Agents entering/leaving mid-episode (the reference supports ragged agent
+sets) is out of scope v1: ragged membership means dynamic shapes, which
+is the wrong trade on TPU — mask agents out instead.
+
+Contract:
+    state, obs = env.reset(key)                  # obs: {agent_id: array}
+    state, obs, rewards, done, info = env.step(state, actions, key)
+        # actions/rewards: {agent_id: array}; done: scalar — all agents
+        # terminate together (mask per-agent activity inside the env)
+Auto-reset on done, like JaxEnv.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env.jax_env import register_env
+from ray_tpu.rllib.env.spaces import Box, Discrete, Space
+
+
+class MultiAgentJaxEnv:
+    agent_ids: Tuple[str, ...] = ()
+
+    def observation_space(self, agent_id: str) -> Space:
+        raise NotImplementedError
+
+    def action_space(self, agent_id: str) -> Space:
+        raise NotImplementedError
+
+    def reset(self, key):
+        raise NotImplementedError
+
+    def step(self, state, actions: Dict[str, jnp.ndarray], key):
+        raise NotImplementedError
+
+
+def is_multi_agent_env(env) -> bool:
+    return isinstance(env, MultiAgentJaxEnv)
+
+
+class CoopMatch(MultiAgentJaxEnv):
+    """Cooperative token-matching with a SHARED reward: each agent
+    observes a one-hot token and must pick the matching action, but every
+    agent receives the MEAN correctness — the classic shared-reward
+    credit-assignment setup (reference's multi-agent learning tests use
+    cooperative toys the same way). Optimal per-agent episode return =
+    episode_len."""
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.n_agents = int(cfg.get("n_agents", 2))
+        self.n_tokens = int(cfg.get("n_tokens", 3))
+        self.episode_len = int(cfg.get("episode_len", 8))
+        self.agent_ids = tuple(f"agent_{i}" for i in range(self.n_agents))
+
+    def observation_space(self, agent_id: str) -> Space:
+        return Box(0.0, 1.0, (self.n_tokens,))
+
+    def action_space(self, agent_id: str) -> Space:
+        return Discrete(self.n_tokens)
+
+    def _tokens_to_obs(self, tokens):
+        return {aid: jax.nn.one_hot(tokens[i], self.n_tokens)
+                for i, aid in enumerate(self.agent_ids)}
+
+    def reset(self, key):
+        tokens = jax.random.randint(key, (self.n_agents,), 0, self.n_tokens)
+        state = {"tokens": tokens, "t": jnp.asarray(0, jnp.int32)}
+        return state, self._tokens_to_obs(tokens)
+
+    def step(self, state, actions, key):
+        acts = jnp.stack([actions[aid] for aid in self.agent_ids])
+        correct = (acts == state["tokens"]).astype(jnp.float32)
+        shared = jnp.mean(correct)
+        rewards = {aid: shared for aid in self.agent_ids}
+        t = state["t"] + 1
+        done = t >= self.episode_len
+        k_next, k_reset = jax.random.split(key)
+        next_tokens = jax.random.randint(
+            k_next, (self.n_agents,), 0, self.n_tokens)
+        reset_state, _ = self.reset(k_reset)
+        new_state = {
+            "tokens": jnp.where(done, reset_state["tokens"], next_tokens),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        return (new_state, self._tokens_to_obs(new_state["tokens"]),
+                rewards, done, {})
+
+
+register_env("CoopMatch", lambda cfg: CoopMatch(cfg))

@@ -1,0 +1,124 @@
+"""`rllib train` CLI + tuned-example regression runner.
+
+Counterpart of the reference's `rllib/train.py` / `rllib/scripts.py`
+(`rllib train -f tuned_examples/ppo/cartpole-ppo.yaml`) and
+`rllib/tests/run_regression_tests.py`: tuned YAMLs carry reward-threshold
+stop criteria and double as learning regressions — the CI oracle for "the
+algorithm still learns" (SURVEY.md §4.2).
+
+Usage:
+    python -m ray_tpu.rllib.train --algo PPO --env CartPole-v1 \
+        --stop-reward 450 --stop-iters 60
+    python -m ray_tpu.rllib.train -f tuned_examples/cartpole-ppo.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+TUNED_EXAMPLES_DIR = os.path.join(os.path.dirname(__file__),
+                                  "tuned_examples")
+
+
+def run_experiment(algo_name: str, env: str, config: dict | None = None,
+                   stop: dict | None = None, verbose: bool = True) -> dict:
+    """Build an algorithm and train until a stop criterion hits.
+    Returns {"passed", "best_reward", "iterations", "time_s"} — passed is
+    True iff the reward threshold (when given) was reached."""
+    from ray_tpu.rllib.algorithms import get_algorithm_class
+
+    cls = get_algorithm_class(algo_name)
+    cfg = cls.get_default_config()
+    cfg.env = env
+    cfg.update_from_dict(dict(config or {}))
+    algo = cfg.build()
+    stop = dict(stop or {})
+    reward_target = stop.get("episode_reward_mean")
+    max_iters = int(stop.get("training_iteration", 100))
+    best = float("-inf")
+    t0 = time.time()
+    i = 0
+    for i in range(1, max_iters + 1):
+        result = algo.train()
+        rew = result.get("episode_reward_mean", float("nan"))
+        if rew == rew:
+            best = max(best, rew)
+        if verbose and (i % 5 == 0 or i == 1):
+            print(f"iter {i:4d} reward_mean="
+                  f"{rew if rew == rew else float('nan'):9.2f} "
+                  f"best={best:9.2f}")
+        if reward_target is not None and best >= reward_target:
+            break
+    return {
+        "passed": reward_target is None or best >= reward_target,
+        "best_reward": best,
+        "iterations": i,
+        "time_s": time.time() - t0,
+        "algo": algo_name,
+        "env": env,
+    }
+
+
+def run_tuned_example(path: str, verbose: bool = True) -> dict:
+    """Run one tuned-example YAML (reference format: {name: {run, env,
+    stop, config}}) and return the run_experiment result."""
+    import yaml
+
+    if not os.path.exists(path):
+        # resolve bare names / relative paths against the shipped dir so
+        # `-f tuned_examples/cartpole-ppo.yaml` and `-f cartpole-ppo.yaml`
+        # work from anywhere
+        fallback = os.path.join(TUNED_EXAMPLES_DIR, os.path.basename(path))
+        if os.path.exists(fallback):
+            path = fallback
+    with open(path) as f:
+        spec = yaml.safe_load(f)
+    name, body = next(iter(spec.items()))
+    if verbose:
+        print(f"== tuned example {name} ({body['run']} on {body['env']})")
+    out = run_experiment(body["run"], body["env"],
+                         config=body.get("config"),
+                         stop=body.get("stop"), verbose=verbose)
+    out["name"] = name
+    return out
+
+
+def list_tuned_examples() -> list:
+    return sorted(
+        os.path.join(TUNED_EXAMPLES_DIR, f)
+        for f in os.listdir(TUNED_EXAMPLES_DIR)
+        if f.endswith((".yaml", ".yml")))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="rllib train",
+        description="Train an RL algorithm (reference: rllib train CLI)")
+    parser.add_argument("-f", "--file", help="tuned-example YAML")
+    parser.add_argument("--algo", "--run", dest="algo", help="algorithm id")
+    parser.add_argument("--env", help="registered env id")
+    parser.add_argument("--stop-reward", type=float, default=None)
+    parser.add_argument("--stop-iters", type=int, default=100)
+    parser.add_argument("--config", default="{}",
+                        help="JSON dict of config overrides")
+    args = parser.parse_args(argv)
+
+    if args.file:
+        result = run_tuned_example(args.file)
+    else:
+        if not args.algo or not args.env:
+            parser.error("--algo and --env are required without -f")
+        stop = {"training_iteration": args.stop_iters}
+        if args.stop_reward is not None:
+            stop["episode_reward_mean"] = args.stop_reward
+        result = run_experiment(args.algo, args.env,
+                                config=json.loads(args.config), stop=stop)
+    print(json.dumps(result))
+    return 0 if result["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -101,6 +101,24 @@ def cmd_job(args):
         _print(c.control("job_list"))
 
 
+def cmd_config(args):
+    """`ray_tpu config list`: print the typed option table with effective
+    values (reference: the RAY_CONFIG table, ray_config_def.h)."""
+    from ray_tpu._private import constants  # noqa: F401  (registers opts)
+    from ray_tpu._private.config import describe
+    rows = describe()
+    if getattr(args, "json", False):
+        import json
+        print(json.dumps(rows, indent=2))
+        return
+    width = max(len(r["env"]) for r in rows)
+    for r in rows:
+        mark = "*" if r["overridden"] else " "
+        print(f"{mark} {r['env']:<{width}}  {r['type']:<6} "
+              f"current={r['current']!r} default={r['default']!r}")
+        print(f"  {' ' * width}  {r['doc']}")
+
+
 def cmd_microbenchmark(args):
     """Core-runtime throughput suite (reference: ray_perf.py:93)."""
     import ray_tpu
@@ -191,6 +209,11 @@ def main(argv=None):
     mb = sub.add_parser("microbenchmark")
     mb.add_argument("--num-cpus", type=int, default=4)
     mb.set_defaults(fn=cmd_microbenchmark)
+
+    cp = sub.add_parser("config")
+    cp.add_argument("config_cmd", choices=["list"])
+    cp.add_argument("--json", action="store_true")
+    cp.set_defaults(fn=cmd_config)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -229,3 +229,32 @@ def test_object_ref_future(ray_session):
         return 7
 
     assert v.remote().future().result(timeout=30) == 7
+
+
+def test_config_table():
+    """Typed option table: every RAY_TPU_ knob is declared once with
+    type/default/doc, env overrides parse per type, and the CLI renderer
+    sees them (reference: ray_config_def.h + ReadEnv)."""
+    import os
+
+    from ray_tpu._private import constants  # noqa: F401  (registers opts)
+    from ray_tpu._private.config import OPTIONS, describe, get
+
+    assert len(OPTIONS) >= 15
+    rows = describe()
+    assert all(r["doc"] for r in rows)
+    assert get("SPILL_HIGH_WATER") == constants.SPILL_HIGH_WATER
+    os.environ["RAY_TPU_SPILL_HIGH_WATER"] = "0.66"
+    try:
+        assert get("SPILL_HIGH_WATER") == 0.66
+        assert any(r["name"] == "SPILL_HIGH_WATER" and r["overridden"]
+                   for r in describe())
+    finally:
+        del os.environ["RAY_TPU_SPILL_HIGH_WATER"]
+    os.environ["RAY_TPU_MAX_WORKERS_CAP"] = "notanint"
+    try:
+        import pytest
+        with pytest.raises(ValueError):
+            get("MAX_WORKERS_CAP")
+    finally:
+        del os.environ["RAY_TPU_MAX_WORKERS_CAP"]
