@@ -47,16 +47,32 @@ def init(num_cpus: int | None = None,
          num_tpus: int | None = None,
          resources: dict | None = None,
          *,
+         address: str | None = None,
          ignore_reinit_error: bool = False,
          namespace: str | None = None,
          logging_level: str = "INFO",
          dashboard_port: int | None = None,
          **kwargs):
-    """Start a local ray_tpu session (driver mode).
+    """Start a session (driver mode), or — with `address` — connect this
+    process as a SECOND driver to an existing session (the reference's Ray
+    Client, `util/client/worker.py:81`: `ray.init("ray://...")`).
 
-    Single-host today; the NodeServer keeps every interface process-shaped so
-    the same API fronts a multi-host deployment later (see node.py docstring).
+    `address` accepts "auto" (newest live session on this host), a session
+    directory, or its node.sock path. Client drivers get the full
+    get/put/remote/actor API over the worker protocol; shutdown() just
+    disconnects them — the session stays up.
     """
+    if address is not None:
+        dropped = [name for name, v in (
+            ("num_cpus", num_cpus), ("num_tpus", num_tpus),
+            ("resources", resources), ("namespace", namespace),
+            ("dashboard_port", dashboard_port)) if v is not None]
+        if dropped or kwargs:
+            raise ValueError(
+                f"init(address=...) joins an EXISTING session; "
+                f"{dropped + sorted(kwargs)} cannot be configured from a "
+                "client driver")
+        return _connect_client(address, ignore_reinit_error)
     if _worker.is_initialized():
         if ignore_reinit_error:
             return _worker.get_client()
@@ -92,6 +108,44 @@ def init(num_cpus: int | None = None,
     return client
 
 
+def _connect_client(address: str, ignore_reinit_error: bool = False):
+    """Join an existing session as a remote driver: register on the head's
+    socket with an attach-class worker id (never dispatched to) and run
+    the full worker protocol — get/put/submit/actors all work."""
+    import threading
+    import uuid
+
+    if _worker.is_initialized():
+        if ignore_reinit_error:
+            return _worker.get_client()
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(pass ignore_reinit_error=True to allow)")
+    if address == "auto":
+        from ray_tpu._private.attach import find_sessions
+        sessions = find_sessions(constants.SHM_ROOT)
+        if not sessions:
+            raise ConnectionError(
+                f"no live ray_tpu session found under {constants.SHM_ROOT}")
+        session_dir = sessions[0]
+    elif address.endswith("node.sock"):
+        session_dir = os.path.dirname(address)
+    else:
+        session_dir = address
+    sock = os.path.join(session_dir, "node.sock")
+    if not os.path.exists(sock):
+        raise ConnectionError(f"no session socket at {sock}")
+    with open(os.path.join(session_dir, "authkey"), "rb") as f:
+        authkey = f.read()
+    from ray_tpu._private import protocol
+    from ray_tpu._private.worker_main import WorkerRuntime
+    wid = f"attach_client_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+    rt = WorkerRuntime(sock, wid, authkey, exit_on_disconnect=False)
+    rt.send(protocol.RegisterWorker(wid, os.getpid()))
+    threading.Thread(target=rt.reader_loop, daemon=True,
+                     name="ray_tpu-client-reader").start()
+    return _worker.connect_worker_mode(rt)
+
+
 def _gc_stale_sessions():
     """Remove session dirs whose driver process is gone (crash leftovers)."""
     import shutil
@@ -124,6 +178,14 @@ def shutdown():
     client = _worker.get_client()
     if client.mode == "driver":
         client.node.shutdown()
+    elif getattr(client, "rt", None) is not None and \
+            client.rt.worker_id.startswith("attach_client_"):
+        # remote driver: just drop the connection; the session stays up
+        client.rt.shutdown = True      # stops the ref-flush loop too
+        try:
+            client.rt.conn.close()
+        except OSError:
+            pass
     _worker.disconnect()
 
 

@@ -17,8 +17,11 @@ import os
 from ray_tpu._private import native as _native
 
 def _default_capacity() -> int:
-    from ray_tpu._private.constants import OBJECT_STORE_BYTES
-    return OBJECT_STORE_BYTES
+    # re-resolved per open (not the import-time constant): arena creation
+    # happens after process start, and tests/operators set the override in
+    # an already-running process
+    from ray_tpu._private import config, constants  # noqa: F401
+    return config.get("OBJECT_STORE_BYTES")
 
 
 class _Lib:

@@ -1,0 +1,219 @@
+"""TFRecord framing + tf.train.Example proto codec, dependency-free.
+
+Shared by the Data tfrecord datasource (`data/datasource.py` — reference:
+`data/datasource/tfrecords_datasource.py` reads Example records into
+columns) and the Tune TensorBoard logger (event files use the same
+record framing). The image vendors neither tensorflow nor crc32c, so the
+framing ([len u64le][masked-crc32c(len)][payload][masked-crc32c(payload)])
+and the three-field Example/Features/Feature protos are encoded by hand —
+the schema is tiny and frozen.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# crc32c (software table) + tfrecord masking
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def write_record(f, payload: bytes) -> None:
+    header = struct.pack("<Q", len(payload))
+    f.write(header)
+    f.write(struct.pack("<I", masked_crc(header)))
+    f.write(payload)
+    f.write(struct.pack("<I", masked_crc(payload)))
+
+
+def read_records(path: str) -> list:
+    """Payloads of a tfrecord file; both CRCs verified per record."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return out
+            (n,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != masked_crc(header):
+                raise ValueError(f"{path}: corrupt record length crc")
+            payload = f.read(n)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if pcrc != masked_crc(payload):
+                raise ValueError(f"{path}: corrupt record payload crc")
+            out.append(payload)
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire helpers
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a proto message.
+    Length-delimited values come back as bytes; varints as int; 32/64-bit
+    as raw bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        num, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield num, wire, val
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example codec
+#
+# Example{1: Features}; Features{1: map<string, Feature>} (map entry:
+# 1 key, 2 value); Feature{oneof: 1 BytesList, 2 FloatList, 3 Int64List};
+# BytesList{repeated 1 bytes}; FloatList{repeated packed 1 float};
+# Int64List{repeated packed 1 int64}.
+# ---------------------------------------------------------------------------
+
+def _encode_feature(values) -> bytes:
+    arr = np.asarray(values)
+    if arr.dtype.kind in ("S", "U", "O"):
+        payload = b""
+        for v in np.atleast_1d(arr):
+            b = v if isinstance(v, bytes) else str(v).encode()
+            payload += _field(1, 2) + _varint(len(b)) + b
+        return _field(1, 2) + _varint(len(payload)) + payload
+    if arr.dtype.kind == "f":
+        packed = np.atleast_1d(arr).astype("<f4").tobytes()
+        body = _field(1, 2) + _varint(len(packed)) + packed
+        return _field(2, 2) + _varint(len(body)) + body
+    if arr.dtype.kind in ("i", "u", "b"):
+        packed = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                          for v in np.atleast_1d(arr))
+        body = _field(1, 2) + _varint(len(packed)) + packed
+        return _field(3, 2) + _varint(len(body)) + body
+    raise TypeError(f"cannot encode feature dtype {arr.dtype}")
+
+
+def encode_example(features: dict) -> bytes:
+    """{name: scalar|list|ndarray of bytes/str/float/int} -> Example."""
+    fmap = b""
+    for name, values in features.items():
+        key = name.encode()
+        feat = _encode_feature(values)
+        entry = (_field(1, 2) + _varint(len(key)) + key
+                 + _field(2, 2) + _varint(len(feat)) + feat)
+        fmap += _field(1, 2) + _varint(len(entry)) + entry
+    return _field(1, 2) + _varint(len(fmap)) + fmap
+
+
+def _decode_feature(buf: bytes):
+    for num, _wire, val in _iter_fields(buf):
+        if num == 1:        # BytesList
+            return [v for n2, _, v in _iter_fields(val) if n2 == 1]
+        if num == 2:        # FloatList (packed or repeated f32)
+            out = []
+            for n2, w2, v in _iter_fields(val):
+                if n2 != 1:
+                    continue
+                if w2 == 2:
+                    out.extend(np.frombuffer(v, "<f4").tolist())
+                else:
+                    out.append(struct.unpack("<f", v)[0])
+            return out
+        if num == 3:        # Int64List (packed varints or repeated)
+            out = []
+            for n2, w2, v in _iter_fields(val):
+                if n2 != 1:
+                    continue
+                if w2 == 2:
+                    pos = 0
+                    while pos < len(v):
+                        iv, pos = _read_varint(v, pos)
+                        if iv >= 1 << 63:
+                            iv -= 1 << 64
+                        out.append(iv)
+                else:
+                    if v >= 1 << 63:
+                        v -= 1 << 64
+                    out.append(v)
+            return out
+    return []
+
+
+def decode_example(payload: bytes) -> dict:
+    """Example bytes -> {name: list of python values}."""
+    out = {}
+    for num, _w, features_buf in _iter_fields(payload):
+        if num != 1:
+            continue
+        for n2, _w2, entry in _iter_fields(features_buf):
+            if n2 != 1:
+                continue
+            key = None
+            feat = b""
+            for n3, _w3, v in _iter_fields(entry):
+                if n3 == 1:
+                    key = v.decode()
+                elif n3 == 2:
+                    feat = v
+            if key is not None:
+                out[key] = _decode_feature(feat)
+    return out

@@ -27,10 +27,17 @@ from ray_tpu.exceptions import RayTpuError, TaskError
 
 
 class WorkerRuntime:
-    """Per-worker state + the client channel back to the node server."""
+    """Per-worker state + the client channel back to the node server.
 
-    def __init__(self, address: str, worker_id: str, authkey: bytes):
+    `exit_on_disconnect` is True for real pool/actor workers (their whole
+    purpose dies with the session) and False for client drivers embedded
+    in a USER process (ray_tpu.init(address=...)) — killing the user's
+    script on disconnect would be hostile."""
+
+    def __init__(self, address: str, worker_id: str, authkey: bytes,
+                 exit_on_disconnect: bool = True):
         self.worker_id = worker_id
+        self.exit_on_disconnect = exit_on_disconnect
         self.conn = connection.Client(address, family="AF_UNIX",
                                       authkey=authkey)
         session_dir = os.path.dirname(address)
@@ -84,7 +91,12 @@ class WorkerRuntime:
             try:
                 msg = self.conn.recv()
             except (EOFError, OSError):
-                os._exit(0)
+                if self.exit_on_disconnect:
+                    os._exit(0)
+                self.shutdown = True
+                with self._reply_cv:
+                    self._reply_cv.notify_all()
+                return
             if isinstance(msg, protocol.PushTask):
                 self.task_queue.put(msg)
             elif isinstance(msg, protocol.FreeObject):

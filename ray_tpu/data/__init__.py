@@ -28,9 +28,11 @@ from ray_tpu.data.read_api import (
     read_csv,
     read_datasource,
     read_json,
+    read_images,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 
 __all__ = [
@@ -40,5 +42,6 @@ __all__ = [
     "from_arrow", "from_huggingface", "from_items", "from_numpy",
     "from_pandas", "from_torch", "range", "range_tensor",
     "read_binary_files", "read_csv", "read_datasource", "read_json",
-    "read_numpy", "read_parquet", "read_text",
+    "read_images", "read_numpy", "read_parquet", "read_text",
+    "read_tfrecords",
 ]

@@ -297,8 +297,16 @@ def _limit_stream(inputs, n: int):
 # ---------------------------------------------------------------------------
 
 def execute_plan(plan: plan_mod.ExecutionPlan):
-    """Generator of (ref, meta) driving the fused stage pipeline."""
+    """Generator of (ref, meta) driving the fused stage pipeline. Records
+    per-stage stats onto the plan (Dataset.stats())."""
     from ray_tpu.data._internal import allops
+    from ray_tpu.data._internal.stats import PlanStats, timed_stage
+
+    stats = PlanStats()
+    plan.last_stats = stats
+
+    def timed(stream, name):
+        return timed_stage(stream, stats.new_op(name), stats)
 
     ops = plan.ops
     stream = None
@@ -308,33 +316,37 @@ def execute_plan(plan: plan_mod.ExecutionPlan):
         if isinstance(op, (plan_mod.Read, plan_mod.InputData)):
             # Fuse any directly following map ops into the source stage.
             stages, j = _collect_stages(ops, i + 1)
+            fused = "+".join(o.name for o in ops[i:j])
             if isinstance(op, plan_mod.InputData):
                 if stages:
                     map_op = ops[i + 1]
-                    stream = _dispatch_map(iter(op.blocks), stages, map_op)
+                    stream = timed(_dispatch_map(iter(op.blocks), stages,
+                                                 map_op), fused)
                 else:
-                    stream = iter(op.blocks)
+                    stream = timed(iter(op.blocks), fused)
             else:
                 map_op = ops[i + 1] if stages else None
-                stream = _dispatch_map(iter(op.read_tasks), stages, map_op)
+                stream = timed(_dispatch_map(iter(op.read_tasks), stages,
+                                             map_op), fused)
             i = j
         elif isinstance(op, plan_mod.MapOp):
             stages, j = _collect_stages(ops, i)
-            stream = _dispatch_map(stream, stages, op)
+            fused = "+".join(o.name for o in ops[i:j])
+            stream = timed(_dispatch_map(stream, stages, op), fused)
             i = j
         elif isinstance(op, plan_mod.AllToAll):
-            stream = iter(allops.run(op, list(stream)))
+            stream = timed(iter(allops.run(op, list(stream))), op.name)
             i += 1
         elif isinstance(op, plan_mod.Limit):
-            stream = _limit_stream(stream, op.n)
+            stream = timed(_limit_stream(stream, op.n), op.name)
             i += 1
         elif isinstance(op, plan_mod.Union):
             streams = [stream] + [p.stream() for p in op.others]
-            stream = itertools.chain(*streams)
+            stream = timed(itertools.chain(*streams), op.name)
             i += 1
         elif isinstance(op, plan_mod.Zip):
-            stream = iter(allops.zip_streams(list(stream),
-                                             list(op.other.stream())))
+            stream = timed(iter(allops.zip_streams(
+                list(stream), list(op.other.stream()))), op.name)
             i += 1
         else:
             raise ValueError(f"unknown op {op}")

@@ -106,6 +106,7 @@ class ExecutionPlan:
     def __init__(self, ops: list[LogicalOp]):
         self.ops = list(ops)
         self._cached_blocks = None   # list[(ref, BlockMetadata)] once run
+        self.last_stats = None       # PlanStats of the latest execution
 
     def with_op(self, op: LogicalOp) -> "ExecutionPlan":
         return ExecutionPlan(self.ops + [op])

@@ -1,0 +1,65 @@
+"""Per-operator execution statistics (reference: `data/_internal/stats.py`
+DatasetStats): each pipeline stage records blocks/rows/bytes produced and
+the wall time spent blocked in its generator. Times are INCLUSIVE of
+upstream pull time (pull-driven pipeline — the same caveat the
+reference's streaming timings carry); the summary orders stages so the
+deltas are readable."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OpStats:
+    name: str
+    blocks: int = 0
+    rows: int = 0
+    bytes: int = 0
+    wall_s: float = 0.0
+
+
+@dataclass
+class PlanStats:
+    ops: list = field(default_factory=list)
+    started: float = field(default_factory=time.perf_counter)
+    finished: float | None = None
+
+    def new_op(self, name: str) -> OpStats:
+        op = OpStats(name)
+        self.ops.append(op)
+        return op
+
+    def summary(self) -> str:
+        if not self.ops:
+            return "Dataset not executed yet"
+        total = ((self.finished or time.perf_counter()) - self.started)
+        lines = [f"Dataset execution: {total:.3f}s total "
+                 "(stage times include upstream pull)"]
+        for op in self.ops:
+            mb = op.bytes / (1024 * 1024)
+            lines.append(
+                f"  {op.name}: {op.wall_s:.3f}s, {op.blocks} blocks, "
+                f"{op.rows} rows, {mb:.2f} MiB")
+        return "\n".join(lines)
+
+
+def timed_stage(stream, op: OpStats, stats: PlanStats):
+    """Wrap a stage's (ref, meta) generator with accounting."""
+    def gen():
+        it = iter(stream)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                ref, meta = next(it)
+            except StopIteration:
+                op.wall_s += time.perf_counter() - t0
+                stats.finished = time.perf_counter()
+                return
+            op.wall_s += time.perf_counter() - t0
+            op.blocks += 1
+            op.rows += getattr(meta, "num_rows", 0) or 0
+            op.bytes += getattr(meta, "size_bytes", 0) or 0
+            yield ref, meta
+    return gen()

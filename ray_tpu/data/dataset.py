@@ -298,6 +298,10 @@ class Dataset:
         from ray_tpu.data.datasource import write_json_block
         self._write(write_json_block, path, **kwargs)
 
+    def write_tfrecords(self, path: str, **kwargs):
+        from ray_tpu.data.datasource import write_tfrecords_block
+        self._write(write_tfrecords_block, path, **kwargs)
+
     def write_numpy(self, path: str, *, column: str = "data", **kwargs):
         from ray_tpu.data.datasource import write_numpy_block
         self._write(write_numpy_block, path, column=column, **kwargs)
@@ -305,6 +309,12 @@ class Dataset:
     # -- misc -----------------------------------------------------------
 
     def stats(self) -> str:
+        """Per-operator execution report of the latest run (reference:
+        `_internal/stats.py` DatasetStats summary); falls back to the
+        logical plan when the dataset hasn't executed yet."""
+        if getattr(self._plan, "last_stats", None) is not None:
+            return (self._plan.describe() + "\n"
+                    + self._plan.last_stats.summary())
         return self._plan.describe()
 
     def __repr__(self):

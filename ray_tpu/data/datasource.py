@@ -129,6 +129,70 @@ class BinaryDatasource(FileBasedDatasource):
                 "path": np.asarray(names, dtype=object)}
 
 
+class ImageDatasource(FileBasedDatasource):
+    """Decoded images as HWC uint8 arrays (reference:
+    `data/datasource/image_datasource.py`): columns `image` (object array
+    of ndarrays, or a dense [N,H,W,C] block when `size=` forces uniform
+    shapes) and `path`."""
+
+    def _read_files(self, files):
+        from PIL import Image
+        size = self._kwargs.get("size")          # (H, W) resize
+        mode = self._kwargs.get("mode", "RGB")
+        imgs, names = [], []
+        for f in files:
+            with Image.open(f) as im:
+                im = im.convert(mode)
+                if size is not None:
+                    im = im.resize((size[1], size[0]))
+                imgs.append(np.asarray(im))
+            names.append(f)
+        if size is not None:
+            col = np.stack(imgs)
+        else:
+            col = np.empty(len(imgs), dtype=object)
+            for i, im in enumerate(imgs):
+                col[i] = im
+        return {"image": col, "path": np.asarray(names, dtype=object)}
+
+
+class TFRecordDatasource(FileBasedDatasource):
+    """tf.train.Example records decoded into columns (reference:
+    `data/datasource/tfrecords_datasource.py`) via the built-in proto
+    codec (_private/tfrecord.py — no tensorflow in the image).
+    Single-element features unwrap to scalars, like the reference."""
+
+    def _read_files(self, files):
+        from ray_tpu._private.tfrecord import decode_example, read_records
+        rows = []
+        for f in files:
+            for payload in read_records(f):
+                ex = decode_example(payload)
+                rows.append({
+                    k: (v[0] if len(v) == 1 else v)
+                    for k, v in ex.items()})
+        cols: dict = {}
+        # union of feature keys across ALL records — a sparse feature in
+        # later records must not be silently dropped
+        keys: dict = {}
+        for r in rows:
+            for k in r:
+                keys[k] = True
+        for k in keys:
+            vals = [r.get(k) for r in rows]
+            try:
+                cols[k] = np.asarray(vals)
+                if cols[k].dtype.kind == "O" and not isinstance(
+                        vals[0], (bytes, str, list)):
+                    raise ValueError
+            except ValueError:
+                arr = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    arr[i] = v
+                cols[k] = arr
+        return cols
+
+
 class RangeDatasource(Datasource):
     def __init__(self, n: int, tensor_shape=None):
         self._n = n
@@ -183,6 +247,20 @@ def write_json_block(block, path_dir, block_idx, **kwargs):
     df = BlockAccessor.for_block(block).to_pandas()
     df.to_json(os.path.join(path_dir, f"part-{block_idx:05d}.json"),
                orient="records", lines=True)
+
+
+def write_tfrecords_block(block, path_dir, block_idx, **kwargs):
+    """One Example per row; numeric columns become float/int64 lists,
+    bytes/str become bytes lists (reference: write_tfrecords)."""
+    from ray_tpu._private.tfrecord import encode_example, write_record
+    from ray_tpu.data.block import BlockAccessor
+    acc = BlockAccessor.for_block(block)
+    os.makedirs(path_dir, exist_ok=True)
+    path = os.path.join(path_dir, f"part-{block_idx:05d}.tfrecords")
+    with open(path, "wb") as f:
+        for row in acc.iter_rows():
+            write_record(f, encode_example(dict(row)))
+    return path
 
 
 def write_numpy_block(block, path_dir, block_idx, column="data", **kwargs):

@@ -72,6 +72,20 @@ def read_binary_files(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
                             parallelism)
 
 
+def read_images(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Decoded images (columns: image, path); `size=(H, W)` resizes to a
+    dense batchable block (reference: read_api.py:612 read_images)."""
+    return _from_datasource(dsrc.ImageDatasource(paths, **kwargs),
+                            parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """tf.train.Example records as columns (reference: read_tfrecords),
+    decoded by the built-in proto codec — no tensorflow needed."""
+    return _from_datasource(dsrc.TFRecordDatasource(paths, **kwargs),
+                            parallelism)
+
+
 def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
     return _from_datasource(dsrc.RangeDatasource(n), parallelism)
 

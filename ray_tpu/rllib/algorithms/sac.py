@@ -21,6 +21,9 @@ import optax
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithms.algorithm import (
     Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.algorithms.off_policy import (
+    QNet as _QNet, drain_episode_returns, scale_action,
+    stack_replay_batches)
 from ray_tpu.rllib.env.jax_env import is_jax_env, make_env
 from ray_tpu.rllib.env.spaces import Box
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
@@ -41,17 +44,6 @@ class _SquashedActor(nn.Module):
         log_std = jnp.clip(nn.Dense(self.act_dim)(x),
                            _LOG_STD_MIN, _LOG_STD_MAX)
         return mean, log_std
-
-
-class _QNet(nn.Module):
-    hiddens: Tuple[int, ...] = (256, 256)
-
-    @nn.compact
-    def __call__(self, obs, act):
-        x = jnp.concatenate([obs, act], axis=-1)
-        for h in self.hiddens:
-            x = nn.relu(nn.Dense(h)(x))
-        return nn.Dense(1)(x)[..., 0]
 
 
 def _sample_squashed(mean, log_std, key):
@@ -154,8 +146,7 @@ class SAC(Algorithm):
 
     def _scale_action(self, act_tanh):
         """[-1,1] -> env bounds."""
-        return self._act_low + (act_tanh + 1.0) * 0.5 * \
-            (self._act_high - self._act_low)
+        return scale_action(self._act_low, self._act_high, act_tanh)
 
     # -- compiled rollout ----------------------------------------------------
 
@@ -260,12 +251,8 @@ class SAC(Algorithm):
         return params, target_q, opt_state, losses, alphas
 
     def _sample_update_batches(self, k: int):
-        cfg = self.algo_config
-        flat = self.buffer.sample(k * cfg.train_batch_size)
-        return {
-            name: jnp.asarray(v).reshape(
-                (k, cfg.train_batch_size) + v.shape[1:])
-            for name, v in flat.items() if name != "batch_indexes"}
+        return stack_replay_batches(self.buffer, k,
+                                    self.algo_config.train_batch_size)
 
     # ------------------------------------------------------------------------
 
@@ -274,11 +261,7 @@ class SAC(Algorithm):
         self._carry, traj = self._sample_fn(
             self.params, self._carry, self.next_key())
         host = {k: np.asarray(v) for k, v in traj.items()}
-        rets = host.pop("episode_return").ravel()
-        fin = ~np.isnan(rets)
-        self._ep_returns.extend(rets[fin].tolist())
-        self._ep_returns = self._ep_returns[-100:]
-        flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in host.items()}
+        flat = drain_episode_returns(host, self._ep_returns)
         self.buffer.add_batch(flat)
         self._steps_sampled += len(flat[sb.REWARDS])
 

@@ -16,29 +16,12 @@ import os
 import struct
 import time
 
-# ---------------------------------------------------------------------------
-# crc32c (software table; tfrecord framing requires the masked variant)
-# ---------------------------------------------------------------------------
-
-_CRC_TABLE = []
-for _i in range(256):
-    _c = _i
-    for _ in range(8):
-        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
-    _CRC_TABLE.append(_c)
-
-
-def _crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = _crc32c(data)
-    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
-
+# Record framing (shared with the Data tfrecord codec): re-exported so
+# existing imports of write_record/read_records keep working.
+from ray_tpu._private.tfrecord import (  # noqa: F401
+    read_records,
+    write_record,
+)
 
 # ---------------------------------------------------------------------------
 # minimal protobuf wire encoding for Event{wall_time, step, summary}
@@ -79,32 +62,6 @@ def encode_event(step: int, scalars: dict, wall_time: float | None = None
           + _field(2, 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)
           + _field(5, 2) + _varint(len(summary)) + summary)
     return ev
-
-
-def write_record(f, payload: bytes) -> None:
-    header = struct.pack("<Q", len(payload))
-    f.write(header)
-    f.write(struct.pack("<I", _masked_crc(header)))
-    f.write(payload)
-    f.write(struct.pack("<I", _masked_crc(payload)))
-
-
-def read_records(path: str):
-    """Parse a tfevents file back into raw payloads (used by tests to
-    verify the framing + CRCs round-trip)."""
-    out = []
-    with open(path, "rb") as f:
-        while True:
-            header = f.read(8)
-            if len(header) < 8:
-                return out
-            (n,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
-            assert hcrc == _masked_crc(header), "corrupt length crc"
-            payload = f.read(n)
-            (pcrc,) = struct.unpack("<I", f.read(4))
-            assert pcrc == _masked_crc(payload), "corrupt payload crc"
-            out.append(payload)
 
 
 # ---------------------------------------------------------------------------

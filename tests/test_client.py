@@ -1,0 +1,81 @@
+"""Remote-driver client mode (reference: Ray Client,
+`util/client/worker.py:81` — ray.init("ray://...")): a second process
+joins a live session with the full get/put/remote/actor API and leaves it
+running on disconnect."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_client_driver_full_api(ray_session):
+    @ray_tpu.remote
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    KV.options(name="client_kv", max_restarts=0).remote()
+
+    script = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import ray_tpu
+
+        client = ray_tpu.init(address="auto")
+        assert client.mode == "worker"
+
+        # tasks
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+        assert ray_tpu.get(double.remote(21), timeout=120) == 42
+
+        # objects (big enough for the shm path)
+        ref = ray_tpu.put(np.arange(300000, dtype=np.int32))
+        assert int(ray_tpu.get(ref, timeout=60).sum()) == \\
+            int(np.arange(300000).sum())
+
+        # named actor created by the PRIMARY driver
+        h = ray_tpu.get_actor("client_kv")
+        assert ray_tpu.get(h.put.remote("x", 7), timeout=60)
+        assert ray_tpu.get(h.get.remote("x"), timeout=60) == 7
+
+        # actors created BY the client
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+            def inc(self):
+                self.n += 1
+                return self.n
+        c = Counter.remote()
+        assert ray_tpu.get(c.inc.remote(), timeout=120) == 1
+
+        # cluster state visible
+        assert ray_tpu.cluster_resources().get("CPU", 0) > 0
+        ray_tpu.shutdown()      # disconnect; session must survive
+        print("CLIENT-OK")
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "CLIENT-OK" in r.stdout
+
+    # the session is still alive and the client's writes persisted
+    h = ray_tpu.get_actor("client_kv")
+    assert ray_tpu.get(h.get.remote("x"), timeout=60) == 7
+    ray_tpu.kill(h)
