@@ -48,7 +48,9 @@ def main():
         cfg = gpt.GPTConfig(vocab_size=50304, d_model=1024, n_layers=12,
                             n_heads=16, d_ff=4096, max_seq_len=1024,
                             attn_impl="flash")
-        batch_size, steps, warmup = 8, 20, 3
+        # Batch swept on v5e: 8 -> 55.2k tok/s (0.468 MFU), 16 -> 58.4k
+        # (0.495), 32 -> 58.5k (plateau; remat required above 8 anyway).
+        batch_size, steps, warmup = 16, 20, 3
     else:   # CPU smoke mode so the benchmark is runnable anywhere
         cfg = gpt.small()
         batch_size, steps, warmup = 4, 5, 1

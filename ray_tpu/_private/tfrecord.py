@@ -27,11 +27,23 @@ for _i in range(256):
     _CRC_TABLE.append(_c)
 
 
-def crc32c(data: bytes) -> int:
+def _crc32c_py(data: bytes) -> int:
     crc = 0xFFFFFFFF
+    table = _CRC_TABLE
     for b in data:
-        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ b) & 0xFF]
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
     return crc ^ 0xFFFFFFFF
+
+
+try:
+    # the C extension is ~1000x the pure-python loop — essential once the
+    # codec sits on the Data read/write hot path, not just tiny tfevents
+    import google_crc32c as _gcrc
+
+    def crc32c(data: bytes) -> int:
+        return _gcrc.value(bytes(data))
+except ImportError:             # pragma: no cover - image always has it
+    crc32c = _crc32c_py
 
 
 def masked_crc(data: bytes) -> int:
@@ -47,22 +59,29 @@ def write_record(f, payload: bytes) -> None:
     f.write(struct.pack("<I", masked_crc(payload)))
 
 
-def read_records(path: str) -> list:
-    """Payloads of a tfrecord file; both CRCs verified per record."""
+def read_records(path: str, verify: bool = True) -> list:
+    """Payloads of a tfrecord file. `verify` checks both CRCs per record;
+    truncation (writer crash, partial copy) raises ValueError, never a
+    bare struct.error."""
     out = []
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
-            if len(header) < 8:
+            if not header:
                 return out
+            if len(header) < 8:
+                raise ValueError(f"{path}: truncated record header")
             (n,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
-            if hcrc != masked_crc(header):
-                raise ValueError(f"{path}: corrupt record length crc")
+            hcrc_raw = f.read(4)
             payload = f.read(n)
-            (pcrc,) = struct.unpack("<I", f.read(4))
-            if pcrc != masked_crc(payload):
-                raise ValueError(f"{path}: corrupt record payload crc")
+            pcrc_raw = f.read(4)
+            if len(hcrc_raw) < 4 or len(payload) < n or len(pcrc_raw) < 4:
+                raise ValueError(f"{path}: truncated record")
+            if verify:
+                if struct.unpack("<I", hcrc_raw)[0] != masked_crc(header):
+                    raise ValueError(f"{path}: corrupt record length crc")
+                if struct.unpack("<I", pcrc_raw)[0] != masked_crc(payload):
+                    raise ValueError(f"{path}: corrupt record payload crc")
             out.append(payload)
 
 

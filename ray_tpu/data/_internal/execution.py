@@ -335,7 +335,11 @@ def execute_plan(plan: plan_mod.ExecutionPlan):
             stream = timed(_dispatch_map(stream, stages, op), fused)
             i = j
         elif isinstance(op, plan_mod.AllToAll):
-            stream = timed(iter(allops.run(op, list(stream))), op.name)
+            # materialize INSIDE a generator so the timed wrapper charges
+            # the barrier's compute to this op, not ~0s
+            def _run_barrier(_op=op, _up=stream):
+                yield from allops.run(_op, list(_up))
+            stream = timed(_run_barrier(), op.name)
             i += 1
         elif isinstance(op, plan_mod.Limit):
             stream = timed(_limit_stream(stream, op.n), op.name)
@@ -345,8 +349,10 @@ def execute_plan(plan: plan_mod.ExecutionPlan):
             stream = timed(itertools.chain(*streams), op.name)
             i += 1
         elif isinstance(op, plan_mod.Zip):
-            stream = timed(iter(allops.zip_streams(
-                list(stream), list(op.other.stream()))), op.name)
+            def _run_zip(_op=op, _up=stream):
+                yield from allops.zip_streams(
+                    list(_up), list(_op.other.stream()))
+            stream = timed(_run_zip(), op.name)
             i += 1
         else:
             raise ValueError(f"unknown op {op}")

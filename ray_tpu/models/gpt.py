@@ -44,6 +44,11 @@ class GPTConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     attn_impl: str = "auto"        # auto | ring | flash | xla
+    # Output dtype of the block einsums. MXU accumulation is f32 either
+    # way; materializing f32 OUTPUTS doubles activation HBM writes, so
+    # "activation" (= cfg.dtype, bf16) is the fast path. The logits
+    # matmul always emits f32 (softmax stability).
+    matmul_out: str = "activation"  # activation | float32
 
     @property
     def head_dim(self) -> int:
@@ -145,32 +150,33 @@ def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
     """One transformer block. x: [B, T, D] activations in cfg.dtype;
     lp: this layer's param slice (f32, cast here)."""
     adt = cfg.activation_dtype()
+    pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
     b, t, d = x.shape
     nh, hd = cfg.n_heads, cfg.head_dim
 
     h = _rms_norm(x, lp["ln1_scale"].astype(adt))
     q = jnp.einsum("btd,dh->bth", h, lp["wq"].astype(adt),
-                   preferred_element_type=jnp.float32).astype(adt)
+                   preferred_element_type=pet).astype(adt)
     k = jnp.einsum("btd,dh->bth", h, lp["wk"].astype(adt),
-                   preferred_element_type=jnp.float32).astype(adt)
+                   preferred_element_type=pet).astype(adt)
     v = jnp.einsum("btd,dh->bth", h, lp["wv"].astype(adt),
-                   preferred_element_type=jnp.float32).astype(adt)
+                   preferred_element_type=pet).astype(adt)
     q = q.reshape(b, t, nh, hd)
     k = k.reshape(b, t, nh, hd)
     v = v.reshape(b, t, nh, hd)
     att = _attention(q, k, v, cfg, mesh).reshape(b, t, nh * hd)
     att = jnp.einsum("bth,hd->btd", att, lp["wo"].astype(adt),
-                     preferred_element_type=jnp.float32).astype(adt)
+                     preferred_element_type=pet).astype(adt)
     x = x + att
 
     h = _rms_norm(x, lp["ln2_scale"].astype(adt))
     up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(adt),
-                    preferred_element_type=jnp.float32).astype(adt)
+                    preferred_element_type=pet).astype(adt)
     gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(adt),
-                      preferred_element_type=jnp.float32).astype(adt)
+                      preferred_element_type=pet).astype(adt)
     ff = jax.nn.silu(gate) * up
     down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(adt),
-                      preferred_element_type=jnp.float32).astype(adt)
+                      preferred_element_type=pet).astype(adt)
     return x + down
 
 
