@@ -1,0 +1,66 @@
+"""Address plumbing for the control/data channels.
+
+Channels ride `multiprocessing.connection` with HMAC authkey handshakes;
+this module lets every channel be EITHER a UNIX socket (same-host: workers
+to their daemon, single-host sessions) or TCP ("host:port" — daemons and
+client drivers joining a head across machines, peer-to-peer object pulls
+between hosts). The reference splits the same way: UDS to the local
+raylet, gRPC over TCP for everything cross-host.
+"""
+
+from __future__ import annotations
+
+import socket
+from multiprocessing import connection
+
+
+def is_tcp(address) -> bool:
+    if isinstance(address, tuple):
+        return True
+    return (isinstance(address, str) and ":" in address
+            and not address.startswith("/"))
+
+
+def parse(address):
+    """'host:port' -> (host, port); path/tuple passes through."""
+    if isinstance(address, tuple) or not is_tcp(address):
+        return address
+    host, _, port = address.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def fmt(address) -> str:
+    if isinstance(address, tuple):
+        return f"{address[0]}:{address[1]}"
+    return address
+
+
+def client(address, authkey: bytes):
+    addr = parse(address)
+    family = "AF_INET" if isinstance(addr, tuple) else "AF_UNIX"
+    return connection.Client(addr, family=family, authkey=authkey)
+
+
+def listener(address, authkey: bytes):
+    addr = parse(address)
+    family = "AF_INET" if isinstance(addr, tuple) else "AF_UNIX"
+    return connection.Listener(addr, family=family, authkey=authkey)
+
+
+def advertise_host() -> str:
+    """The address other machines should dial for listeners bound on
+    0.0.0.0 (reference: node_ip_address detection in services.py)."""
+    import os
+    override = os.environ.get("RAY_TPU_NODE_IP")
+    if override:
+        return override
+    try:
+        # a UDP "connection" to a public address picks the outbound iface
+        # without sending anything
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        host = s.getsockname()[0]
+        s.close()
+        return host
+    except OSError:
+        return "127.0.0.1"
