@@ -120,22 +120,35 @@ def _connect_client(address: str, ignore_reinit_error: bool = False):
             return _worker.get_client()
         raise RuntimeError("ray_tpu.init() called twice "
                            "(pass ignore_reinit_error=True to allow)")
-    if address == "auto":
-        from ray_tpu._private.attach import find_sessions
-        sessions = find_sessions(constants.SHM_ROOT)
-        if not sessions:
+    from ray_tpu._private import netaddr
+    if netaddr.is_tcp(address):
+        # cross-machine driver: dial the head's TCP listener; the secret
+        # comes from RAY_TPU_AUTHKEY (hex), like the reference's
+        # redis-password handoff for remote `ray.init(address=...)`
+        key = os.environ.get("RAY_TPU_AUTHKEY")
+        if not key:
             raise ConnectionError(
-                f"no live ray_tpu session found under {constants.SHM_ROOT}")
-        session_dir = sessions[0]
-    elif address.endswith("node.sock"):
-        session_dir = os.path.dirname(address)
+                "joining a remote head over TCP requires RAY_TPU_AUTHKEY "
+                "(hex of the session authkey file)")
+        sock, authkey = address, bytes.fromhex(key)
     else:
-        session_dir = address
-    sock = os.path.join(session_dir, "node.sock")
-    if not os.path.exists(sock):
-        raise ConnectionError(f"no session socket at {sock}")
-    with open(os.path.join(session_dir, "authkey"), "rb") as f:
-        authkey = f.read()
+        if address == "auto":
+            from ray_tpu._private.attach import find_sessions
+            sessions = find_sessions(constants.SHM_ROOT)
+            if not sessions:
+                raise ConnectionError(
+                    f"no live ray_tpu session found under "
+                    f"{constants.SHM_ROOT}")
+            session_dir = sessions[0]
+        elif address.endswith("node.sock"):
+            session_dir = os.path.dirname(address)
+        else:
+            session_dir = address
+        sock = os.path.join(session_dir, "node.sock")
+        if not os.path.exists(sock):
+            raise ConnectionError(f"no session socket at {sock}")
+        with open(os.path.join(session_dir, "authkey"), "rb") as f:
+            authkey = f.read()
     from ray_tpu._private import protocol
     from ray_tpu._private.worker_main import WorkerRuntime
     wid = f"attach_client_{os.getpid()}_{uuid.uuid4().hex[:6]}"

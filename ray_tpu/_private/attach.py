@@ -39,13 +39,26 @@ def find_sessions(root: str = "/dev/shm") -> list[str]:
 class AttachClient:
     """Control-channel client for an existing session."""
 
-    def __init__(self, session_dir: str):
+    def __init__(self, session_dir: str, authkey: bytes | None = None):
+        from ray_tpu._private import netaddr
         self.session_dir = session_dir
-        with open(os.path.join(session_dir, "authkey"), "rb") as f:
-            authkey = f.read()
-        self._conn = connection.Client(
-            os.path.join(session_dir, "node.sock"),
-            family="AF_UNIX", authkey=authkey)
+        if netaddr.is_tcp(session_dir):
+            # remote head over TCP ("host:port"); secret from the caller
+            # or RAY_TPU_AUTHKEY (hex)
+            if authkey is None:
+                key = os.environ.get("RAY_TPU_AUTHKEY")
+                if not key:
+                    raise ConnectionError(
+                        "attaching over TCP requires RAY_TPU_AUTHKEY")
+                authkey = bytes.fromhex(key)
+            self._conn = netaddr.client(session_dir, authkey)
+        else:
+            if authkey is None:
+                with open(os.path.join(session_dir, "authkey"), "rb") as f:
+                    authkey = f.read()
+            self._conn = connection.Client(
+                os.path.join(session_dir, "node.sock"),
+                family="AF_UNIX", authkey=authkey)
         # unique per client, not per process: two AttachClients in one
         # process must not collide on the server's worker table
         import uuid
@@ -67,7 +80,8 @@ class AttachClient:
                     self._replies[-1] = None   # poison: connection gone
                     self._have.notify_all()
                 return
-            if isinstance(msg, protocol.ActorCallReply):
+            if isinstance(msg, (protocol.ActorCallReply,
+                                protocol.ErrorReply)):
                 with self._have:
                     self._replies[msg.req_id] = msg
                     self._have.notify_all()

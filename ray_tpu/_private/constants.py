@@ -114,3 +114,54 @@ RUNTIME_ENV_CACHE = define(
 RUNTIME_ENV_CACHE_ENTRIES = define(
     "RUNTIME_ENV_CACHE_ENTRIES", int, 20,
     "LRU cap on cached runtime-env entries.")
+
+# --- transport (reference: gRPC-over-TCP for every cross-host edge,
+# src/ray/rpc/grpc_server.h; UDS only worker<->local raylet) ---
+
+TRANSPORT = define(
+    "TRANSPORT", str, "uds",
+    "Cluster transport for daemon/client<->head and peer pulls: 'uds' "
+    "(single machine) or 'tcp' (cluster spans machines). Workers always "
+    "ride UDS to their local daemon, like the reference. Read at init() "
+    "time via config.get, so tests can flip it per-session.")
+
+HEAD_PORT = define(
+    "HEAD_PORT", int, 0,
+    "TCP port for the head listener when TRANSPORT=tcp (0 = ephemeral). "
+    "Reference: --port on `ray start --head` (scripts.py:537).")
+
+HEAD_BIND_HOST = define(
+    "HEAD_BIND_HOST", str, "0.0.0.0",
+    "Bind host for the head's TCP listener.")
+
+NODE_IP = define(
+    "NODE_IP", str, "",
+    "Advertised IP of this machine for cross-host dials; empty = "
+    "autodetect via the outbound interface (reference: "
+    "node_ip_address detection, services.py:1353).")
+
+DAEMON_RECONNECT_GRACE_S = define(
+    "DAEMON_RECONNECT_GRACE_S", float, 60.0,
+    "How long a HostDaemon keeps retrying the head channel after it "
+    "closes (head crash/restart) before giving up and dying "
+    "(reference: raylets ride out GCS restarts, "
+    "gcs_rpc_server_reconnect_timeout_s). 0 disables reconnect.")
+
+HEAD_SNAPSHOT_INTERVAL_S = define(
+    "HEAD_SNAPSHOT_INTERVAL_S", float, 1.0,
+    "Standalone-head metadata snapshot period (named actors, KV, jobs, "
+    "placement groups -> session_dir/head_state.pkl; reference: "
+    "Redis-backed GCS persistence, redis_store_client.h:33).")
+
+AUTOSCALER_UPDATE_INTERVAL_S = define(
+    "AUTOSCALER_UPDATE_INTERVAL_S", float, 1.0,
+    "Head monitor tick: refresh LoadMetrics from cluster state and run "
+    "StandardAutoscaler.update (reference: monitor.py:371 loop, "
+    "AUTOSCALER_UPDATE_INTERVAL_S=5).")
+
+PG_AUTOSCALE_WAIT_S = define(
+    "PG_AUTOSCALE_WAIT_S", float, 60.0,
+    "With an autoscaler attached, how long placement-group creation "
+    "waits for capacity (the gang rides the demand queue) before "
+    "raising PlacementGroupError (reference: PENDING placement groups "
+    "feed autoscaler demand).")

@@ -29,7 +29,7 @@ import time
 from dataclasses import dataclass, field, replace
 from multiprocessing import connection
 
-from ray_tpu._private import constants, ids, protocol, spawn
+from ray_tpu._private import constants, ids, netaddr, protocol, spawn
 from ray_tpu._private.object_store import Descriptor, ObjectStore
 from ray_tpu._private.pull_plane import PullClient, serve_pull
 from ray_tpu.exceptions import ObjectLostError, RuntimeEnvSetupError
@@ -63,11 +63,26 @@ class HostDaemon:
     def __init__(self, head_address: str, node_id: str, resources: dict,
                  num_tpu_chips: int):
         self.node_id = node_id
+        self.head_address = head_address
+        self.resources = dict(resources)
+        self.num_tpu_chips = num_tpu_chips
         self.authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
-        session_dir = os.path.dirname(head_address)
-        self.node_dir = os.path.join(session_dir, "nodes", node_id)
+        tcp = netaddr.is_tcp(head_address)
+        if not tcp:
+            # same-machine session: node dir lives under the head's
+            # session dir so shutdown/GC can sweep it
+            session_dir = os.path.dirname(head_address)
+            self.node_dir = os.path.join(session_dir, "nodes", node_id)
+        else:
+            # cross-machine join: no shared filesystem with the head —
+            # this host owns its node dir (spawner may pin it via env for
+            # same-host TCP test tiers)
+            self.node_dir = os.environ.get("RAY_TPU_NODE_DIR") or \
+                os.path.join(constants.SHM_ROOT, "ray_tpu_node_" + node_id)
         os.makedirs(self.node_dir, exist_ok=True)
         self.store = ObjectStore(self.node_dir)
+        # workers always connect over UDS to their local daemon (reference
+        # keeps worker<->raylet on UDS too); only peer/head edges go TCP
         self.address = os.path.join(self.node_dir, "node.sock")
 
         self.lock = threading.RLock()
@@ -88,17 +103,34 @@ class HostDaemon:
         self._ctl_cv = threading.Condition()
         self._shutdown = False
 
+        if os.path.exists(self.address):
+            # leftover socket of a dead daemon that reused this node dir
+            os.unlink(self.address)
         self._listener = connection.Listener(
             family="AF_UNIX", address=self.address, authkey=self.authkey)
-        self._head = connection.Client(head_address, family="AF_UNIX",
-                                       authkey=self.authkey)
+        self._head = netaddr.client(head_address, self.authkey)
         self._head_lock = threading.Lock()
+        if tcp:
+            # peer pulls dial us over TCP; bind an ephemeral port on the
+            # interface that routes to the head and advertise host:port
+            host = netaddr.local_endpoint_host(self._head) or \
+                netaddr.advertise_host()
+            self._peer_listener = netaddr.listener((host, 0), self.authkey)
+            self.advertised_address = netaddr.bound_address(
+                self._peer_listener)
+        else:
+            self._peer_listener = None
+            self.advertised_address = self.address
         self._head_send(protocol.RegisterNode(
             node_id=node_id, pid=os.getpid(), resources=resources,
-            num_tpu_chips=num_tpu_chips, address=self.address))
+            num_tpu_chips=num_tpu_chips, address=self.advertised_address))
 
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="daemon-accept").start()
+        if self._peer_listener is not None:
+            threading.Thread(
+                target=self._accept_loop, args=(self._peer_listener,),
+                daemon=True, name="daemon-peer-accept").start()
         if self.store.arena_stats() is not None:
             threading.Thread(target=self._spill_loop, daemon=True,
                              name="daemon-spill").start()
@@ -115,17 +147,71 @@ class HostDaemon:
                 pass
 
     def head_loop(self):
-        """Main thread: serve the head channel until it closes."""
+        """Main thread: serve the head channel until it closes. A closed
+        channel means the head died or restarted: ride it out by
+        reconnect-and-reregister within the grace window (reference:
+        raylets survive GCS restarts, node_manager.proto:358
+        NotifyGCSRestart), else die."""
         while not self._shutdown:
             try:
                 msg = self._head.recv()
             except (EOFError, OSError):
+                if self._reconnect_head():
+                    continue
                 break
             try:
                 self._handle_head(msg)
             except Exception:
                 logger.exception("error handling %r from head", type(msg))
         self._die()
+
+    def _reconnect_head(self) -> bool:
+        from ray_tpu._private import config
+        grace = config.get("DAEMON_RECONNECT_GRACE_S")
+        if grace <= 0:
+            return False
+        logger.warning("head channel closed; trying to reconnect for %ss",
+                       grace)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and not self._shutdown:
+            time.sleep(1.0)
+            try:
+                conn = netaddr.client(self.head_address, self.authkey)
+            except Exception:
+                continue
+            with self._head_lock:
+                self._head = conn
+            # fail every request proxied before the crash: the restarted
+            # head has no record of those req ids, so waiting is forever
+            with self.lock:
+                proxied, self._proxy = self._proxy, {}
+                live_actors = {aid: {} for aid, w in self.actors.items()
+                               if w.alive}
+                objects = {oid: self._tag(d)
+                           for oid, d in self._objs.items()}
+            with self._ctl_cv:
+                for box in self._ctl.values():
+                    box["error"] = "head restarted"
+                    box["done"] = True
+                self._ctl.clear()
+                self._ctl_cv.notify_all()
+            for kind, w, wreq, task_id in proxied.values():
+                if kind == "get":
+                    w.send(protocol.GetReply(
+                        wreq, {}, error="ObjectLostError: head restarted "
+                        "while this get() was in flight"))
+                else:
+                    w.send(protocol.ErrorReply(wreq, "head restarted"))
+            self._head_send(protocol.RegisterNode(
+                node_id=self.node_id, pid=os.getpid(),
+                resources=self.resources, num_tpu_chips=self.num_tpu_chips,
+                address=self.advertised_address, actors=live_actors,
+                objects=objects))
+            logger.warning("re-registered with restarted head "
+                           "(%d actors, %d objects)",
+                           len(live_actors), len(objects))
+            return True
+        return False
 
     def _handle_head(self, msg):
         if isinstance(msg, protocol.LeaseTask):
@@ -138,7 +224,8 @@ class HostDaemon:
         elif isinstance(msg, protocol.PullChunk):
             self._pull_client.on_chunk(msg)
         elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
-                              protocol.SubmitReply, protocol.ActorCallReply)):
+                              protocol.SubmitReply, protocol.ActorCallReply,
+                              protocol.ErrorReply)):
             self._route_reply(msg)
         elif isinstance(msg, protocol.FreeObjectNode):
             self._free_local(msg.object_id)
@@ -155,10 +242,11 @@ class HostDaemon:
         else:
             logger.warning("unknown head message %r", type(msg))
 
-    def _accept_loop(self):
+    def _accept_loop(self, listener=None):
+        listener = listener or self._listener
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except Exception:
                 if self._shutdown:
                     return
@@ -290,6 +378,12 @@ class HostDaemon:
         if entry is None:
             return
         kind, w, wreq, task_id = entry
+        if isinstance(msg, protocol.ErrorReply):
+            if kind == "get":
+                w.send(protocol.GetReply(wreq, {}, error=msg.error))
+            else:
+                w.send(protocol.ErrorReply(wreq, msg.error))
+            return
         if kind == "get":
             def _finish():
                 if msg.timed_out or msg.error is not None:
@@ -536,8 +630,7 @@ class HostDaemon:
                 raise ObjectLostError(f"no address for node {node_id}")
             with self.lock:
                 self.peer_addrs[node_id] = addr
-        conn = connection.Client(addr, family="AF_UNIX",
-                                 authkey=self.authkey)
+        conn = netaddr.client(addr, self.authkey)
         send = protocol.SafeConn(conn)
         send(protocol.RegisterPeer(self.node_id))
 
@@ -638,10 +731,13 @@ class HostDaemon:
             workers = list(self.workers.values())
         for w in workers:
             w.send(protocol.KillWorker())
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        for lst in (self._listener, self._peer_listener):
+            if lst is None:
+                continue
+            try:
+                lst.close()
+            except OSError:
+                pass
         deadline = time.monotonic() + 2.0
         for w in workers:
             if w.proc is None:
@@ -655,6 +751,12 @@ class HostDaemon:
                 pass
         self.store.purge_spill()
         self.store.close()
+        if os.environ.get("RAY_TPU_NODE_DIR") is None and \
+                os.path.basename(os.path.dirname(self.node_dir)) != "nodes":
+            # we created this node dir ourselves (cross-machine TCP join):
+            # nobody else will sweep it
+            import shutil
+            shutil.rmtree(self.node_dir, ignore_errors=True)
         os._exit(0)
 
 

@@ -1,0 +1,100 @@
+"""Standalone head process: `python -m ray_tpu._private.head_main`.
+
+Counterpart of the reference's GCS server binary (gcs_server.h:78, spawned
+by `ray start --head`, scripts.py:537): the cluster control store runs in
+its OWN process, so driver exit doesn't kill the cluster, and a SIGKILLed
+head can restart into the same session dir — daemons reconnect-and-
+reregister (daemon.py _reconnect_head), detached named actors re-attach,
+and persisted jobs are re-adopted (job_submission.py JobManager._recover).
+
+Operators normally reach this through `ray_tpu start --head`; drivers then
+join with `ray_tpu.init(address=...)`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ray_tpu-head")
+    ap.add_argument("--session-dir", default=None,
+                    help="session directory; restarting into an existing "
+                    "one restores cluster metadata (head_state.pkl)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="TCP listen port (enables the TCP tier; required "
+                    "for daemons on other machines)")
+    ap.add_argument("--bind-host", default=None)
+    ap.add_argument("--num-cpus", type=int, default=None)
+    ap.add_argument("--num-tpus", type=int, default=None)
+    ap.add_argument("--resources", default="{}",
+                    help="extra resources as JSON, e.g. '{\"red\": 2}'")
+    args = ap.parse_args(argv)
+
+    # Config is env-driven; translate flags before importing the node.
+    if args.port is not None:
+        os.environ["RAY_TPU_TRANSPORT"] = "tcp"
+        os.environ["RAY_TPU_HEAD_PORT"] = str(args.port)
+    if args.bind_host is not None:
+        os.environ["RAY_TPU_HEAD_BIND_HOST"] = args.bind_host
+
+    import ray_tpu
+    from ray_tpu._private import constants, ids
+    from ray_tpu._private.node import NodeServer
+
+    num_cpus = args.num_cpus if args.num_cpus is not None \
+        else (os.cpu_count() or 1)
+    num_tpus = args.num_tpus if args.num_tpus is not None \
+        else ray_tpu._detect_tpu_chips()
+    total = {"CPU": float(num_cpus)}
+    if num_tpus:
+        total["TPU"] = float(num_tpus)
+    for k, v in json.loads(args.resources).items():
+        total[str(k)] = float(v)
+
+    session_dir = args.session_dir or os.path.join(
+        constants.SHM_ROOT, constants.SESSION_PREFIX + ids.new_node_id())
+    os.makedirs(session_dir, exist_ok=True)
+
+    node = NodeServer(total, session_dir, num_tpu_chips=int(num_tpus or 0),
+                      standalone=True)
+
+    def _term(signum, frame):
+        node.shutdown()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    print(f"ray_tpu head up: session={session_dir}", flush=True)
+    if node.tcp_address:
+        print(f"address: {node.tcp_address}", flush=True)
+        print(f"join:    ray_tpu start --address {node.tcp_address}",
+              flush=True)
+    print(f"drive:   ray_tpu.init(address={session_dir!r})", flush=True)
+
+    if os.environ.get("RAY_TPU_HEAD_DETACHED") == "1":
+        # The spawning CLI exits after the banner, closing our pipe; all
+        # later output must go to a real file or it's lost to EPIPE
+        # (reference: per-process log files under the session dir).
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        fd = os.open(os.path.join(log_dir, "head.log"),
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        os.close(fd)
+
+    while not node._shutdown:
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

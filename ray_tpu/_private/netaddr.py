@@ -47,11 +47,42 @@ def listener(address, authkey: bytes):
     return connection.Listener(addr, family=family, authkey=authkey)
 
 
+def bound_address(listener) -> str:
+    """'host:port' (or path) a peer should dial for this listener; resolves
+    ephemeral ports and 0.0.0.0 binds to the advertised host."""
+    addr = listener.address
+    if isinstance(addr, tuple):
+        host, port = addr
+        if host in ("0.0.0.0", ""):
+            host = advertise_host()
+        return f"{host}:{port}"
+    return addr
+
+
+def local_endpoint_host(conn) -> str | None:
+    """The local IP of an established TCP connection — exactly the
+    interface that routes to the remote side, so it's the right host for
+    this machine to advertise back to it."""
+    import os
+    try:
+        fd = os.dup(conn.fileno())
+        s = socket.socket(fileno=fd)
+        try:
+            name = s.getsockname()
+        finally:
+            s.close()
+        if isinstance(name, tuple):
+            return name[0]
+    except OSError:
+        pass
+    return None
+
+
 def advertise_host() -> str:
     """The address other machines should dial for listeners bound on
     0.0.0.0 (reference: node_ip_address detection in services.py)."""
-    import os
-    override = os.environ.get("RAY_TPU_NODE_IP")
+    from ray_tpu._private import config
+    override = config.get("NODE_IP")
     if override:
         return override
     try:

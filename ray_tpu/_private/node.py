@@ -35,7 +35,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field, replace
 from multiprocessing import connection
 
-from ray_tpu._private import constants, ids, protocol
+from ray_tpu._private import config, constants, ids, netaddr, protocol
 from ray_tpu._private.object_store import Descriptor, ObjectStore
 from ray_tpu._private.serialization import dumps
 from ray_tpu.exceptions import (
@@ -108,6 +108,9 @@ class _WorkerConn:
     # resources temporarily released while the worker blocks in get()
     released: dict = field(default_factory=dict)
     alive: bool = True
+    # True for conns accepted on the TCP listener from another machine:
+    # they can't mmap this host's store, so get/put payloads ride inline
+    remote: bool = False
 
     def send(self, msg) -> bool:
         # conn is None between spawn and registration
@@ -172,6 +175,8 @@ class _RemoteNode:
     worker_id: str = ""
     current: object = None
     released: dict = field(default_factory=dict)
+    # daemons localize via the pull plane, never inline (see _WorkerConn)
+    remote: bool = False
 
     def send(self, msg) -> bool:
         return protocol.safe_send(self.conn, self.send_lock, msg)
@@ -180,8 +185,10 @@ class _RemoteNode:
 class NodeServer:
     """One per session; lives in the driver process."""
 
-    def __init__(self, resources: dict, session_dir: str, num_tpu_chips: int):
+    def __init__(self, resources: dict, session_dir: str, num_tpu_chips: int,
+                 standalone: bool = False):
         self.session_dir = session_dir
+        self.standalone = standalone
         self.node_id = ids.new_node_id()
         self.store = ObjectStore(session_dir)
         self.total_resources = dict(resources)
@@ -259,36 +266,265 @@ class NodeServer:
         with open(os.path.join(session_dir, "driver.pid"), "w") as f:
             f.write(str(os.getpid()))
 
-        self._authkey = os.urandom(16)
-        # Persisted (0600) so external processes — the CLI, job drivers —
-        # can attach to this session (reference: Redis password / GCS
-        # address in the session dir).
+        # Reuse an existing session authkey (standalone head restarting
+        # into its old session dir: daemons and clients still hold the old
+        # key), else mint one. Persisted (0600) so external processes —
+        # the CLI, job drivers — can attach to this session (reference:
+        # Redis password / GCS address in the session dir).
         keypath = os.path.join(session_dir, "authkey")
-        fd = os.open(keypath, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-        with os.fdopen(fd, "wb") as f:
-            f.write(self._authkey)
+        if standalone and os.path.exists(keypath):
+            with open(keypath, "rb") as f:
+                self._authkey = f.read()
+        else:
+            self._authkey = os.urandom(16)
+            fd = os.open(keypath,
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(self._authkey)
         self._address = os.path.join(session_dir, "node.sock")
+        if standalone and os.path.exists(self._address):
+            # leftover socket from the previous head incarnation
+            os.unlink(self._address)
+        if standalone:
+            self._restore_state()
         self._listener = connection.Listener(
             family="AF_UNIX", address=self._address, authkey=self._authkey)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-accept", daemon=True)
         self._accept_thread.start()
+        # TCP tier: daemons and client drivers on OTHER machines dial this
+        # listener (reference: gRPC-over-TCP everywhere cross-host,
+        # src/ray/rpc/grpc_server.h; UDS stays for same-host workers).
+        self.tcp_address = None
+        self._tcp_listener = None
+        if config.get("TRANSPORT") == "tcp" or config.get("HEAD_PORT"):
+            bind = (config.get("HEAD_BIND_HOST"), config.get("HEAD_PORT"))
+            self._tcp_listener = netaddr.listener(bind, self._authkey)
+            self.tcp_address = netaddr.bound_address(self._tcp_listener)
+            # published for operators/other machines (reference: GCS
+            # address in the session files, services.py:1353)
+            with open(os.path.join(session_dir, "head_address"), "w") as f:
+                f.write(self.tcp_address)
+            threading.Thread(
+                target=self._accept_loop, args=(self._tcp_listener, True),
+                name="ray_tpu-tcp-accept", daemon=True).start()
         if self.store.arena_stats() is not None:
             threading.Thread(target=self._spill_loop,
                              name="ray_tpu-spill", daemon=True).start()
         from ray_tpu._private.memory_monitor import MemoryMonitor
         self._memory_monitor = MemoryMonitor(self)
         self._memory_monitor.start()
+        if standalone:
+            threading.Thread(target=self._snapshot_loop,
+                             name="ray_tpu-gcs-snapshot",
+                             daemon=True).start()
         atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    # autoscaler monitor (reference: autoscaler/_private/monitor.py:126 —
+    # the head-side Monitor reads cluster load every tick,
+    # update_load_metrics :249, and drives StandardAutoscaler.update)
+    # ------------------------------------------------------------------
+
+    def attach_autoscaler(self, config: dict, provider=None) -> dict:
+        """Close the loop: demand flows head -> LoadMetrics ->
+        StandardAutoscaler -> NodeProvider -> real HostDaemons."""
+        from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+        from ray_tpu.autoscaler.load_metrics import LoadMetrics
+        from ray_tpu.autoscaler.node_provider import LocalDaemonNodeProvider
+        with self.lock:
+            if getattr(self, "_autoscaler", None) is not None:
+                raise RuntimeError("autoscaler already attached")
+            self._load_metrics = LoadMetrics()
+            self._pending_gangs: list = []
+            self._autoscaler = StandardAutoscaler(
+                provider or LocalDaemonNodeProvider(self), config,
+                self._load_metrics)
+            self._autoscaler_err: str | None = None
+            self._autoscaler_ts: float = 0.0
+        threading.Thread(target=self._monitor_loop,
+                         name="ray_tpu-autoscaler", daemon=True).start()
+        return {"ok": True}
+
+    def _monitor_loop(self):
+        period = config.get("AUTOSCALER_UPDATE_INTERVAL_S")
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self._update_load_metrics()
+                self._autoscaler.update()
+                self._autoscaler_err = None
+            except Exception as e:
+                logger.exception("autoscaler update failed")
+                self._autoscaler_err = repr(e)
+            self._autoscaler_ts = time.time()
+            # capacity may have arrived for a waiting placement group
+            with self.cv:
+                self.cv.notify_all()
+
+    def _update_load_metrics(self):
+        lm = self._load_metrics
+        with self.lock:
+            actor_nodes = {a.node for a in self.actors.values()
+                           if not a.dead and a.ready}
+            head_busy = any(w.current is not None
+                            for w in self.workers.values())
+            lm.update_node("head", self.total_resources, self.available,
+                           busy=head_busy or None in actor_nodes)
+            for nid, n in list(self.nodes.items()):
+                if not n.alive:
+                    lm.remove_node(nid)
+                    continue
+                pg_here = any(
+                    nid in pg.bundle_nodes
+                    for pg in self.placement_groups.values())
+                lm.update_node(nid, n.total, n.available,
+                               busy=bool(n.inflight)
+                               or nid in actor_nodes or pg_here)
+            # unplaced actor creations sit in self.pending too, so one
+            # pass covers both task and actor demand
+            demands = [dict(t.spec.resources) for t in self.pending
+                       if not t.deps and not t.cancelled]
+            gangs = [[dict(b) for b in g] for g in self._pending_gangs]
+            lm.set_demands(demands, gangs)
+
+    def autoscaler_status(self) -> dict:
+        a = getattr(self, "_autoscaler", None)
+        if a is None:
+            return {"enabled": False}
+        with self.lock:
+            pending = len([t for t in self.pending if not t.deps])
+            gangs = len(self._pending_gangs)
+        return {
+            "enabled": True,
+            "workers_by_type": a._workers_by_type(),
+            "max_workers": a.config["max_workers"],
+            "pending_demands": pending,
+            "pending_gangs": gangs,
+            "infeasible_gangs": len(a.infeasible_gangs),
+            "last_update_ts": self._autoscaler_ts,
+            "last_error": self._autoscaler_err,
+        }
+
+    # ------------------------------------------------------------------
+    # metadata persistence (standalone head only; reference: Redis-backed
+    # GCS store, store_client/redis_store_client.h:33 — daemons and
+    # detached actors survive a head restart, test_gcs_fault_tolerance.py)
+    # ------------------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.session_dir, "head_state.pkl")
+
+    def _snapshot_loop(self):
+        import pickle
+        period = config.get("HEAD_SNAPSHOT_INTERVAL_S")
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                state = self._snapshot_state()
+                tmp = self._snapshot_path() + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(state, f)
+                os.replace(tmp, self._snapshot_path())
+            except Exception:
+                logger.exception("head snapshot failed")
+
+    def _snapshot_state(self) -> dict:
+        """Cluster METADATA only (no object payloads): what a restarted
+        head needs to re-attach daemons and detached actors."""
+        with self.lock:
+            actors = {}
+            for aid, a in self.actors.items():
+                if a.dead:
+                    continue
+                actors[aid] = {
+                    "creation_spec": a.creation_spec,
+                    "max_concurrency": a.max_concurrency,
+                    "max_restarts": a.max_restarts,
+                    "restarts_used": a.restarts_used,
+                    "max_task_retries": a.max_task_retries,
+                    "name": a.name,
+                    "resources": dict(a.resources),
+                    "tpu_chips": list(a.tpu_chips),
+                    "method_meta": a.method_meta,
+                    "node": a.node,
+                }
+            pgs = {pid: {"bundles": pg.bundles, "strategy": pg.strategy,
+                         "available": pg.available,
+                         "bundle_nodes": pg.bundle_nodes}
+                   for pid, pg in self.placement_groups.items()}
+            return {
+                "named_actors": dict(self.named_actors),
+                "actors": actors,
+                "kv": dict(self.kv),
+                "placement_groups": pgs,
+            }
+
+    def _restore_state(self):
+        import pickle
+        path = self._snapshot_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:
+            logger.exception("head snapshot unreadable; starting fresh")
+            return
+        for aid, d in state.get("actors", {}).items():
+            a = _ActorState(
+                actor_id=aid, creation_spec=d["creation_spec"],
+                max_concurrency=d["max_concurrency"],
+                max_restarts=d["max_restarts"],
+                restarts_used=d["restarts_used"],
+                max_task_retries=d["max_task_retries"],
+                name=d["name"], resources=d["resources"],
+                tpu_chips=d["tpu_chips"], method_meta=d["method_meta"],
+                node=d["node"])
+            if d["node"] is None:
+                # head-local actor processes died with the head
+                a.dead = True
+                a.death_cause = "head restarted (actor lived on the head)"
+            else:
+                # awaiting its daemon's re-registration
+                a.ready = False
+            self.actors[aid] = a
+        for a in self.actors.values():
+            if not a.dead:
+                continue
+            # the normal death path credits a PG actor's resources back to
+            # its bundle (_release_actor_resources); the snapshot carries
+            # the debit, so mirror that credit here or the slot leaks
+            pg_state = state.get("placement_groups", {}).get(
+                a.creation_spec.placement_group_id or "")
+            if pg_state is not None and pg_state["available"]:
+                _add(pg_state["available"][0], a.resources)
+        self.named_actors.update(state.get("named_actors", {}))
+        self.kv.update(state.get("kv", {}))
+        for pid, d in state.get("placement_groups", {}).items():
+            self.placement_groups[pid] = _PlacementGroup(
+                pg_id=pid, bundles=d["bundles"], strategy=d["strategy"],
+                available=d["available"], bundle_nodes=d["bundle_nodes"])
+            # bundles reserved on the head itself are re-held now;
+            # daemon-side bundles are re-held at re-registration
+            for b, nid in zip(d["bundles"], d["bundle_nodes"]):
+                if nid is None:
+                    _sub(self.available, b)
+        logger.warning(
+            "restored head state: %d actors (%d named), %d kv keys, "
+            "%d placement groups",
+            len(self.actors), len(self.named_actors), len(self.kv),
+            len(self.placement_groups))
 
     # ------------------------------------------------------------------
     # connection plumbing
     # ------------------------------------------------------------------
 
-    def _accept_loop(self):
+    def _accept_loop(self, listener=None, remote=False):
+        listener = listener or self._listener
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except Exception:
                 # One bad handshake (EOF mid-connect, wrong authkey ->
                 # AuthenticationError) must not kill the accept loop; only
@@ -297,10 +533,10 @@ class NodeServer:
                     return
                 time.sleep(0.05)
                 continue
-            threading.Thread(target=self._serve_conn, args=(conn,),
+            threading.Thread(target=self._serve_conn, args=(conn, remote),
                              daemon=True).start()
 
-    def _serve_conn(self, conn):
+    def _serve_conn(self, conn, remote=False):
         try:
             reg = conn.recv()
         except (EOFError, OSError):
@@ -323,6 +559,7 @@ class NodeServer:
                 self.workers[reg.worker_id] = w
             else:
                 w.conn = conn
+            w.remote = remote
             w.alive = True
             self.cv.notify_all()
         self._reader_loop(w)
@@ -350,7 +587,18 @@ class NodeServer:
             # can't free the object in that window (idempotent with the
             # explicit hold; cleared by the worker's eventual release)
             self.ref_hold(msg.object_id, w.worker_id)
-            self.register_object(msg.object_id, msg.desc,
+            desc = msg.desc
+            if (desc.inline is not None
+                    and len(desc.inline) > constants.INLINE_OBJECT_MAX_BYTES):
+                # oversized inline put from a cross-machine client: land the
+                # bytes in the head's store so they don't ride every
+                # subsequent control message
+                desc = self.store.put_serialized(msg.object_id, desc.inline)
+                # the head's store owns the bytes now, so the free path
+                # must delete them here, not at the putting client
+                self.register_object(msg.object_id, desc, origin="driver")
+                return
+            self.register_object(msg.object_id, desc,
                                  origin=w.worker_id)
         elif isinstance(msg, protocol.GetRequest):
             threading.Thread(
@@ -366,13 +614,29 @@ class NodeServer:
                 w.send(protocol.SubmitReply(msg.req_id, ok=False,
                                             error=repr(e)))
         elif isinstance(msg, protocol.ActorCallRequest):
+            self._dispatch_control(w, msg)
+        else:
+            logger.warning("unknown message %r", type(msg))
+
+    # Control verbs that may block for a long time (autoscaler-waiting
+    # placement groups) must not run inline on a connection's reader
+    # thread: that would stall every other message on the channel —
+    # including, on a node channel, the TaskDone that frees the very
+    # capacity being waited for.
+    _BLOCKING_CONTROL = frozenset({"create_pg"})
+
+    def _dispatch_control(self, w, msg: protocol.ActorCallRequest):
+        def run():
             try:
                 result = self._control(msg.method, msg.payload, w)
                 w.send(protocol.ActorCallReply(msg.req_id, result=result))
             except Exception as e:
                 w.send(protocol.ActorCallReply(msg.req_id, error=repr(e)))
+        if msg.method in self._BLOCKING_CONTROL:
+            threading.Thread(target=run, daemon=True,
+                             name=f"ctl-{msg.method}").start()
         else:
-            logger.warning("unknown message %r", type(msg))
+            run()
 
     # ------------------------------------------------------------------
     # node channels (head <-> HostDaemon; the GCS side of the split)
@@ -386,8 +650,51 @@ class NodeServer:
             free_tpu_chips=list(range(reg.num_tpu_chips)),
             worker_id="node:" + reg.node_id)
         with self.lock:
+            old = self.nodes.get(reg.node_id)
+            if old is not None:
+                node.proc = old.proc
             self.nodes[reg.node_id] = node
+            # RE-registration after a head restart: re-attach the actors
+            # still alive on that daemon and re-hold their resources +
+            # any placement-group bundles reserved there (reference:
+            # NotifyGCSRestart resource resync). Only actors the head
+            # still maps to THIS node re-attach — if the head stayed up
+            # and already restarted an actor elsewhere (the channel blip
+            # case), the daemon's copy is stale and gets killed below,
+            # never a split-brain rebind.
+            stale_actors = []
+            for aid in (reg.actors or {}):
+                a = self.actors.get(aid)
+                if a is not None and not a.dead and a.node == reg.node_id:
+                    a.ready = True
+                    a.pending_restart = False
+                    if not a.creation_spec.placement_group_id:
+                        # PG actors were debited from pg.available, which
+                        # the snapshot preserved; the bundle re-debit
+                        # below covers node.available for them
+                        _sub(node.available, a.resources)
+                    for chip in a.tpu_chips:
+                        if chip in node.free_tpu_chips:
+                            node.free_tpu_chips.remove(chip)
+                else:
+                    stale_actors.append(aid)
+            for pg in self.placement_groups.values():
+                for b, nid in zip(pg.bundles, pg.bundle_nodes):
+                    if nid == reg.node_id:
+                        _sub(node.available, b)
             self.cv.notify_all()
+        for aid in stale_actors:
+            node.send(protocol.KillActorOnNode(aid))
+        # rebuild the object directory from the daemon's surviving store;
+        # refcount state died with the old head, so these are pinned
+        # (escaped) rather than risking a premature free
+        for oid, desc in (reg.objects or {}).items():
+            with self.lock:
+                known = oid in self.directory
+            if not known:
+                self.ref_escape(oid)
+                self.register_object(oid, desc,
+                                     origin="node:" + reg.node_id)
         logger.info("node %s registered: %s", reg.node_id, reg.resources)
         self._schedule()
         while True:
@@ -447,11 +754,7 @@ class NodeServer:
                 node.send(protocol.SubmitReply(msg.req_id, ok=False,
                                                error=repr(e)))
         elif isinstance(msg, protocol.ActorCallRequest):
-            try:
-                result = self._control(msg.method, msg.payload, node)
-                node.send(protocol.ActorCallReply(msg.req_id, result=result))
-            except Exception as e:
-                node.send(protocol.ActorCallReply(msg.req_id, error=repr(e)))
+            self._dispatch_control(node, msg)
         else:
             logger.warning("unknown node message %r", type(msg))
 
@@ -516,6 +819,10 @@ class NodeServer:
         if method == "kill_node":
             p = payload or {}
             return self.kill_node(p["node_id"], force=p.get("force", True))
+        if method == "attach_autoscaler":
+            return self.attach_autoscaler(payload or {})
+        if method == "autoscaler_status":
+            return self.autoscaler_status()
         if method == "create_pg":
             return self.create_placement_group(**payload)
         if method == "remove_pg":
@@ -853,12 +1160,23 @@ class NodeServer:
             # need descriptors readable in the head's store.
             locs = self.get_locations(msg.object_ids, msg.timeout,
                                       localize=(w.kind != "node"))
+            if w.remote:
+                # cross-machine client: no shared memory with this host, so
+                # ship the serialized envelopes inside the reply itself
+                locs = {oid: (d if d.inline is not None else replace(
+                    d, inline=self.store.raw_bytes(d), arena=False,
+                    path=None)) for oid, d in locs.items()}
             reply = protocol.GetReply(msg.req_id, locs)
         except GetTimeoutError:
             reply = protocol.GetReply(msg.req_id, {}, timed_out=True)
-        except (ObjectFreedError, ObjectLostError) as e:
+        except (ObjectFreedError, ObjectLostError, OSError) as e:
+            # OSError: a path-backed object freed/moved between the
+            # directory read and raw_bytes for a remote client — must
+            # still answer or the client's get() hangs forever
+            name = type(e).__name__ if not isinstance(e, OSError) \
+                else "ObjectLostError"
             reply = protocol.GetReply(msg.req_id, {},
-                                      error=f"{type(e).__name__}: {e}")
+                                      error=f"{name}: {e}")
         with self.lock:
             if w.released:
                 _sub(self.available, w.released)  # may dip below zero briefly
@@ -1377,8 +1695,14 @@ class NodeServer:
             res["TPU"] = float(num_tpus)
         env = _spawn.propagate_pythonpath(dict(os.environ))
         env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        head_addr = self.tcp_address or self._address
+        if self.tcp_address is not None:
+            # same-host TCP tier: keep the node dir under the session dir
+            # so shutdown/GC sweeps it like the UDS tier
+            env["RAY_TPU_NODE_DIR"] = os.path.join(
+                self.session_dir, "nodes", node_id)
         cmd = [sys.executable, "-m", "ray_tpu._private.daemon",
-               self._address, node_id, _json.dumps(res), str(int(num_tpus))]
+               head_addr, node_id, _json.dumps(res), str(int(num_tpus))]
         proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
         with self.cv:
@@ -2372,24 +2696,55 @@ class NodeServer:
             assignment.append(pid)
         return out(assignment)
 
+    def _try_reserve_pg_locked(self, bundles, strategy):
+        """Assign + debit atomically (caller holds the lock); returns the
+        new pg_id or None if currently infeasible."""
+        assignment = self._assign_bundles(bundles, strategy)
+        if assignment is None:
+            return None
+        for b, nid in zip(bundles, assignment):
+            if nid is None:
+                _sub(self.available, b)
+            else:
+                _sub(self.nodes[nid].available, b)
+        pg_id = ids.new_placement_group_id()
+        self.placement_groups[pg_id] = _PlacementGroup(
+            pg_id, bundles, strategy, bundle_nodes=list(assignment))
+        return pg_id
+
     def create_placement_group(self, bundles, strategy="PACK", name=""):
         bundles = [dict(b) for b in bundles]
         with self.lock:
-            assignment = self._assign_bundles(bundles, strategy)
-            if assignment is None:
-                raise PlacementGroupError(
-                    f"infeasible placement group ({strategy}): "
-                    f"bundles {bundles}")
-            for b, nid in zip(bundles, assignment):
-                if nid is None:
-                    _sub(self.available, b)
-                else:
-                    _sub(self.nodes[nid].available, b)
-            pg_id = ids.new_placement_group_id()
-            self.placement_groups[pg_id] = _PlacementGroup(
-                pg_id, bundles, strategy,
-                bundle_nodes=list(assignment))
-        return pg_id
+            pg_id = self._try_reserve_pg_locked(bundles, strategy)
+        if pg_id is not None:
+            return pg_id
+        if getattr(self, "_autoscaler", None) is not None:
+            # With an autoscaler attached an infeasible group is DEMAND,
+            # not an error: park it on the gang queue (visible to
+            # LoadMetrics) and retry as capacity arrives (reference:
+            # PENDING placement groups feed the autoscaler). Reservation
+            # happens under the lock inside the loop, so a concurrent
+            # task debiting fresh capacity just sends us back to waiting
+            # instead of failing the group early.
+            deadline = time.monotonic() + config.get("PG_AUTOSCALE_WAIT_S")
+            with self.cv:
+                self._pending_gangs.append(bundles)
+            try:
+                while True:
+                    with self.cv:
+                        pg_id = self._try_reserve_pg_locked(bundles,
+                                                            strategy)
+                        if pg_id is not None:
+                            return pg_id
+                        rem = deadline - time.monotonic()
+                        if rem <= 0 or self._shutdown:
+                            break
+                        self.cv.wait(min(rem, 0.5))
+            finally:
+                with self.cv:
+                    self._pending_gangs.remove(bundles)
+        raise PlacementGroupError(
+            f"infeasible placement group ({strategy}): bundles {bundles}")
 
     def remove_placement_group(self, pg_id: str):
         with self.lock:
@@ -2431,10 +2786,13 @@ class NodeServer:
                         node.proc.kill()
                     except OSError:
                         pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        for lst in (self._listener, self._tcp_listener):
+            if lst is None:
+                continue
+            try:
+                lst.close()
+            except OSError:
+                pass
         deadline = time.monotonic() + 3.0
         for w in workers:
             if w.proc is None:

@@ -54,6 +54,16 @@ class Descriptor:
     node: str | None = None      # owning node id; None = head node
 
 
+def inline_descriptor(object_id: str, value) -> Descriptor:
+    """Serialize `value` fully inline regardless of size — the put path
+    for cross-machine client drivers that share no memory with the head
+    (the head re-materializes oversized inline puts into its own store)."""
+    size, meta, buffers = serialization.serialized_size(value)
+    out = bytearray(size)
+    n = serialization.write_envelope(memoryview(out), meta, buffers)
+    return Descriptor(object_id, n, inline=bytes(out[:n]))
+
+
 class ObjectStore:
     """Per-process handle to the session's shared object directory on tmpfs."""
 

@@ -206,6 +206,16 @@ class ActorCallReply:
     error: str | None = None
 
 
+@dataclass
+class ErrorReply:
+    """Type-agnostic failure reply for an in-flight request whose real
+    reply can never come (e.g. the head restarted and lost the req id).
+    Request issuers treat it as a terminal error regardless of which
+    reply type they expected."""
+    req_id: int
+    error: str
+
+
 # ---- multi-node control plane (head <-> per-host daemon) ------------------
 #
 # The head process keeps the cluster store + cluster scheduler (the
@@ -216,12 +226,18 @@ class ActorCallReply:
 
 @dataclass
 class RegisterNode:
-    """Daemon -> head: first message on the node channel."""
+    """Daemon -> head: first message on the node channel. On RE-register
+    (daemon reconnecting after a head restart — reference:
+    NotifyGCSRestart, node_manager.proto:358) `actors`/`objects` carry
+    the daemon's surviving state so the head can re-attach live actors
+    and rebuild its object directory."""
     node_id: str
     pid: int
     resources: dict
     num_tpu_chips: int = 0
     address: str = ""            # daemon's own listener, for peer pulls
+    actors: dict | None = None   # actor_id -> {} live on this node
+    objects: dict | None = None  # oid -> tagged Descriptor sealed here
 
 
 @dataclass

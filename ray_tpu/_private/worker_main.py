@@ -21,7 +21,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from multiprocessing import connection
 
-from ray_tpu._private import protocol, serialization
+from ray_tpu._private import netaddr, protocol, serialization
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import RayTpuError, TaskError
 
@@ -38,10 +38,16 @@ class WorkerRuntime:
                  exit_on_disconnect: bool = True):
         self.worker_id = worker_id
         self.exit_on_disconnect = exit_on_disconnect
-        self.conn = connection.Client(address, family="AF_UNIX",
-                                      authkey=authkey)
-        session_dir = os.path.dirname(address)
-        self.store = ObjectStore(session_dir)
+        self.conn = netaddr.client(address, authkey)
+        if netaddr.is_tcp(address):
+            # cross-machine client driver: no shared memory with the head —
+            # object payloads ride inline both ways (the head inlines
+            # GetReply locations for remote conns and re-materializes
+            # oversized inline puts into its own store)
+            self.store = None
+        else:
+            session_dir = os.path.dirname(address)
+            self.store = ObjectStore(session_dir)
         self.functions: dict[str, object] = {}
         self.actor_instance = None
         self.actor_id: str | None = None
@@ -84,7 +90,10 @@ class WorkerRuntime:
                 self._reply_cv.wait(1.0)
                 if self.shutdown:
                     raise RuntimeError("worker shutting down")
-            return self._replies.pop(req_id)
+            reply = self._replies.pop(req_id)
+        if isinstance(reply, protocol.ErrorReply):
+            raise RayTpuError(reply.error)
+        return reply
 
     def reader_loop(self):
         while True:
@@ -103,7 +112,8 @@ class WorkerRuntime:
                 # all refs gone cluster-wide: drop this process's owner pin
                 # so the arena block can actually be reclaimed
                 try:
-                    self.store.delete(msg.desc)
+                    if self.store is not None:
+                        self.store.delete(msg.desc)
                 except Exception:
                     pass
             elif isinstance(msg, protocol.KillWorker):
@@ -113,7 +123,8 @@ class WorkerRuntime:
                     self._reply_cv.notify_all()
             elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
                                   protocol.SubmitReply,
-                                  protocol.ActorCallReply)):
+                                  protocol.ActorCallReply,
+                                  protocol.ErrorReply)):
                 with self._reply_cv:
                     self._replies[msg.req_id] = msg
                     self._reply_cv.notify_all()
@@ -143,6 +154,12 @@ class WorkerRuntime:
         was handed out (the spiller swaps the directory entry first, so a
         fresh location always resolves)."""
         from ray_tpu.exceptions import ObjectLostError
+        if self.store is None:
+            if desc.inline is None:
+                raise ObjectLostError(
+                    f"object {oid} arrived without inline payload on a "
+                    "remote client connection")
+            return serialization.loads(desc.inline)
         for attempt in range(retries + 1):
             try:
                 return self.store.get(desc)
@@ -159,7 +176,11 @@ class WorkerRuntime:
     def put_object(self, value) -> str:
         from ray_tpu._private import ids
         oid = ids.new_object_id()
-        desc = self.store.put(oid, value)
+        if self.store is None:
+            from ray_tpu._private.object_store import inline_descriptor
+            desc = inline_descriptor(oid, value)
+        else:
+            desc = self.store.put(oid, value)
         self.send(protocol.PutRequest(oid, desc))
         return oid
 

@@ -42,6 +42,55 @@ class NodeProvider:
         return None
 
 
+class LocalDaemonNodeProvider(NodeProvider):
+    """Launches REAL HostDaemon processes on this machine — the e2e
+    provider behind the closed autoscaler loop (counterpart of the
+    reference's FakeMultiNodeProvider,
+    `_private/fake_multi_node/node_provider.py:237`, which spawns real
+    raylets locally so the autoscaler can be tested without a cloud).
+    Provider node ids ARE cluster node ids."""
+
+    def __init__(self, node_server):
+        self._node = node_server
+        self._lock = threading.Lock()
+        self._tags: dict[str, dict] = {}     # node_id -> tags
+
+    def _alive(self, node_id: str) -> bool:
+        n = self._node.nodes.get(node_id)
+        return n is not None and n.alive
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            return [nid for nid, tags in self._tags.items()
+                    if self._alive(nid)
+                    and all(tags.get(k) == v
+                            for k, v in tag_filters.items())]
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._tags.get(node_id, {}))
+
+    def create_node(self, node_config: dict, tags: Dict[str, str],
+                    count: int) -> None:
+        resources = dict(node_config.get("resources") or {"CPU": 1.0})
+        num_tpus = int(node_config.get("num_tpus", 0))
+        for _ in range(count):
+            nid = self._node.add_node(resources, num_tpus)
+            with self._lock:
+                self._tags[nid] = {**tags, TAG_NODE_STATUS: "up-to-date"}
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            self._tags.pop(node_id, None)
+        self._node.kill_node(node_id, force=False)   # graceful KillNode
+
+    def is_running(self, node_id: str) -> bool:
+        return self._alive(node_id)
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        return "127.0.0.1"
+
+
 class FakeNodeProvider(NodeProvider):
     """Instant in-memory nodes (optionally with a simulated startup delay)
     for autoscaler tests — the reference's fake-multinode trick."""

@@ -27,23 +27,127 @@ from typing import Dict, Optional
 class JobInfo:
     job_id: str
     entrypoint: str
-    status: str = "PENDING"   # PENDING RUNNING SUCCEEDED FAILED STOPPED
+    # PENDING (recorded, exec not attempted) -> STARTING (exec attempted,
+    # pid not yet durable) -> RUNNING -> SUCCEEDED | FAILED | STOPPED
+    status: str = "PENDING"
     submitted_ts: float = field(default_factory=time.time)
     finished_ts: Optional[float] = None
     returncode: Optional[int] = None
     metadata: dict = field(default_factory=dict)
+    pid: Optional[int] = None
+    # /proc start time of pid, so recovery can tell the job's process
+    # from an unrelated one that reused the pid
+    pid_start: Optional[int] = None
+    runtime_env: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return asdict(self)
 
 
+def _proc_start(pid: int) -> Optional[int]:
+    """Kernel start time (clock ticks since boot) of `pid`, or None if the
+    process is gone — the pid-reuse-proof identity (proc/<pid>/stat f22)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read().decode(errors="replace")
+        # field 2 (comm) may contain spaces/parens; fields after the
+        # closing paren are well-formed
+        return int(data.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 class JobManager:
+    """Job table is PERSISTED (one json per job under log_dir) so a
+    restarted standalone head re-adopts in-flight jobs: they run in their
+    own process groups (start_new_session) and record their exit status to
+    an .rc file, surviving a head crash (reference: the job table lives in
+    GCS and job drivers are independent processes, job_manager.py:508)."""
+
     def __init__(self, log_dir: str):
         self.log_dir = log_dir
         os.makedirs(log_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._jobs: Dict[str, JobInfo] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._recover()
+
+    # -- persistence ----------------------------------------------------
+
+    def _info_path(self, job_id: str) -> str:
+        return os.path.join(self.log_dir, f"{job_id}.json")
+
+    def _rc_path(self, job_id: str) -> str:
+        return os.path.join(self.log_dir, f"{job_id}.rc")
+
+    def _persist(self, info: JobInfo) -> None:
+        import json
+        tmp = self._info_path(info.job_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(info.to_dict(), f)
+        os.replace(tmp, self._info_path(info.job_id))
+
+    def _recover(self) -> None:
+        import glob as _glob
+        import json
+        for path in _glob.glob(os.path.join(self.log_dir, "*.json")):
+            try:
+                with open(path) as f:
+                    info = JobInfo(**json.load(f))
+            except (OSError, TypeError, ValueError):
+                continue
+            self._jobs[info.job_id] = info
+            if info.status == "PENDING":
+                # recorded but exec never ATTEMPTED (status flips to
+                # STARTING before Popen): safe to run now
+                self._exec(info)
+            elif info.status == "STARTING":
+                # head died inside the launch window — the process may or
+                # may not exist, and we have no durable pid. Re-running
+                # could double-execute a non-idempotent entrypoint, so
+                # fail it (unless its rc already landed).
+                rc = self._read_rc(info.job_id)
+                if rc is not None:
+                    self._finalize(info.job_id, rc)
+                else:
+                    with self._lock:
+                        info.status = "FAILED"
+                        info.finished_ts = time.time()
+                    self._persist(info)
+            elif info.status == "RUNNING":
+                self._adopt(info)
+
+    def _adopt(self, info: JobInfo) -> None:
+        """Re-watch a job that outlived the previous head incarnation."""
+        def alive() -> bool:
+            if info.pid is None:
+                return False
+            start = _proc_start(info.pid)
+            # start-time mismatch = the pid was recycled by another
+            # process; the job itself is gone
+            return start is not None and start == info.pid_start
+
+        def watch():
+            while True:
+                rc = self._read_rc(info.job_id)
+                if rc is not None:
+                    self._finalize(info.job_id, rc)
+                    return
+                if not alive():
+                    # process gone and no rc recorded: crashed
+                    self._finalize(info.job_id, None)
+                    return
+                time.sleep(0.5)
+        threading.Thread(target=watch, daemon=True).start()
+
+    def _read_rc(self, job_id: str) -> Optional[int]:
+        try:
+            with open(self._rc_path(job_id)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    # -- lifecycle ------------------------------------------------------
 
     def submit(self, entrypoint: str, *, job_id: str | None = None,
                runtime_env: dict | None = None,
@@ -52,42 +156,68 @@ class JobManager:
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"job {job_id!r} already exists")
-            info = JobInfo(job_id, entrypoint, metadata=metadata or {})
+            info = JobInfo(job_id, entrypoint, metadata=metadata or {},
+                           runtime_env=runtime_env or {})
             self._jobs[job_id] = info
+        self._persist(info)
+        self._exec(info)
+        return job_id
+
+    def _exec(self, info: JobInfo) -> None:
+        job_id, runtime_env = info.job_id, info.runtime_env
         env = dict(os.environ)
-        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+        for k, v in (runtime_env.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         env["RAY_TPU_JOB_ID"] = job_id
+        # durable launch intent BEFORE Popen: recovery must never re-exec
+        # a maybe-started job (exactly-once on the pessimistic side)
+        with self._lock:
+            info.status = "STARTING"
+        self._persist(info)
         log_path = self.log_path(job_id)
-        logf = open(log_path, "wb")
+        logf = open(log_path, "ab")
+        # subshell + rc file: the exit status survives a head restart
+        # (a restarted head is no longer the parent and cannot wait())
+        wrapped = (f"({info.entrypoint}); _rc=$?; "
+                   f"echo $_rc > {self._rc_path(job_id)}; exit $_rc")
         try:
             proc = subprocess.Popen(
-                entrypoint, shell=True, stdout=logf, stderr=subprocess.STDOUT,
+                wrapped, shell=True, stdout=logf, stderr=subprocess.STDOUT,
                 env=env, start_new_session=True,
-                cwd=(runtime_env or {}).get("working_dir") or None)
+                cwd=runtime_env.get("working_dir") or None)
         except OSError as e:
             logf.close()
             with self._lock:
                 info.status = "FAILED"
                 info.finished_ts = time.time()
+            self._persist(info)
             raise RuntimeError(f"failed to exec job: {e}") from e
         with self._lock:
             info.status = "RUNNING"
+            info.pid = proc.pid
+            info.pid_start = _proc_start(proc.pid)
             self._procs[job_id] = proc
+        self._persist(info)
         threading.Thread(target=self._wait, args=(job_id, proc, logf),
                          daemon=True).start()
-        return job_id
 
     def _wait(self, job_id: str, proc: subprocess.Popen, logf):
         rc = proc.wait()
         logf.close()
+        self._finalize(job_id, rc)
+
+    def _finalize(self, job_id: str, rc: Optional[int]):
         with self._lock:
             info = self._jobs[job_id]
-            if info.status != "STOPPED":
-                info.status = "SUCCEEDED" if rc == 0 else "FAILED"
+            if info.status not in ("STOPPED",):
+                if rc == 0:
+                    info.status = "SUCCEEDED"
+                else:
+                    info.status = "FAILED"
             info.returncode = rc
             info.finished_ts = time.time()
             self._procs.pop(job_id, None)
+        self._persist(info)
 
     def stop(self, job_id: str) -> bool:
         with self._lock:
@@ -95,12 +225,16 @@ class JobManager:
             info = self._jobs.get(job_id)
             if info is None:
                 raise ValueError(f"no job {job_id!r}")
-            if proc is None:
+            if info.status not in ("PENDING", "STARTING", "RUNNING"):
+                return False     # already finished; nothing to signal
+            pid = proc.pid if proc is not None else info.pid
+            if pid is None:
                 return False
             info.status = "STOPPED"
+        self._persist(info)
         try:
             # the job runs in its own process group (start_new_session)
-            os.killpg(proc.pid, 15)
+            os.killpg(pid, 15)
         except OSError:
             pass
         return True

@@ -46,6 +46,16 @@ def cmd_status(args):
         print(f"node {n['node_id']}: {usage}")
     alive = sum(1 for w in workers if w["alive"])
     print(f"workers: {alive} alive / {len(workers)} total")
+    a = c.control("autoscaler_status")
+    if a.get("enabled"):
+        print(f"autoscaler: {sum(a['workers_by_type'].values())}/"
+              f"{a['max_workers']} workers "
+              f"({', '.join(f'{k}: {v}' for k, v in sorted(a['workers_by_type'].items())) or 'none'}); "
+              f"pending demands: {a['pending_demands']}, "
+              f"pending gangs: {a['pending_gangs']}, "
+              f"infeasible: {a['infeasible_gangs']}"
+              + (f"; last error: {a['last_error']}"
+                 if a.get("last_error") else ""))
 
 
 def cmd_list(args):
@@ -99,6 +109,111 @@ def cmd_job(args):
         print(c.control("job_stop", args.job_id))
     elif args.job_cmd == "list":
         _print(c.control("job_list"))
+
+
+def cmd_start(args):
+    """`ray_tpu start --head` / `ray_tpu start --address host:port` —
+    cluster lifecycle (reference: scripts.py:537 `ray start`). The head
+    runs as its OWN process (gcs_server binary counterpart); additional
+    machines join by running a HostDaemon against the head's TCP
+    address."""
+    import os
+    import subprocess
+    import time as _time
+
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu._private.head_main"]
+        if args.port is not None:
+            cmd += ["--port", str(args.port)]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        if args.resources:
+            cmd += ["--resources", args.resources]
+        if args.session_dir:
+            cmd += ["--session-dir", args.session_dir]
+        if args.block:
+            os.execv(sys.executable, cmd)
+        import select
+        env = dict(os.environ)
+        env["RAY_TPU_HEAD_DETACHED"] = "1"   # head logs to session dir
+        proc = subprocess.Popen(cmd, start_new_session=True, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        # relay startup lines until the head reports ready; the deadline
+        # must hold even if the head prints nothing (select, not a
+        # blocking readline)
+        deadline = _time.time() + 60
+        ready = False
+        while not ready:
+            rem = deadline - _time.time()
+            if rem <= 0:
+                print("head startup timed out", file=sys.stderr)
+                proc.kill()
+                sys.exit(1)
+            r, _, _ = select.select([proc.stdout], [], [], min(rem, 1.0))
+            if not r:
+                continue
+            line = proc.stdout.readline()
+            if not line:          # EOF: the head died before readiness
+                print("head failed to start", file=sys.stderr)
+                sys.exit(1)
+            print(line, end="")
+            ready = line.startswith("drive:")
+        return
+
+    if not args.address:
+        print("start needs --head or --address HOST:PORT", file=sys.stderr)
+        sys.exit(1)
+    key = args.authkey or os.environ.get("RAY_TPU_AUTHKEY")
+    if not key:
+        print("joining a head needs the session authkey: --authkey HEX "
+              "or RAY_TPU_AUTHKEY", file=sys.stderr)
+        sys.exit(1)
+    import ray_tpu
+    from ray_tpu._private import ids, spawn
+    num_cpus = args.num_cpus if args.num_cpus is not None \
+        else (os.cpu_count() or 1)
+    num_tpus = args.num_tpus if args.num_tpus is not None \
+        else ray_tpu._detect_tpu_chips()
+    res = {"CPU": float(num_cpus)}
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    for k, v in json.loads(args.resources or "{}").items():
+        res[str(k)] = float(v)
+    node_id = ids.new_node_id()
+    env = spawn.propagate_pythonpath(dict(os.environ))
+    env["RAY_TPU_AUTHKEY"] = key
+    cmd = [sys.executable, "-m", "ray_tpu._private.daemon",
+           args.address, node_id, json.dumps(res), str(int(num_tpus or 0))]
+    if args.block:
+        os.environ["RAY_TPU_AUTHKEY"] = key
+        os.execve(sys.executable, cmd, env)
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+    print(f"node {node_id} joining {args.address} (pid {proc.pid})")
+
+
+def cmd_stop(args):
+    """`ray_tpu stop`: SIGTERM the head(s) of live sessions on this host
+    (reference: scripts.py:1001 `ray stop`). Daemons die when their head
+    channel closes (unless a restart follows within the reconnect
+    grace)."""
+    import os
+    import signal as _signal
+    from ray_tpu._private.attach import find_sessions
+    sessions = [args.session] if args.session else find_sessions()
+    if not sessions:
+        print("no live ray_tpu session found")
+        return
+    for d in sessions:
+        try:
+            with open(os.path.join(d, "driver.pid")) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, _signal.SIGTERM)
+            print(f"stopped head of {d} (pid {pid})")
+        except (OSError, ValueError) as e:
+            print(f"could not stop {d}: {e}", file=sys.stderr)
 
 
 def cmd_config(args):
@@ -178,6 +293,25 @@ def main(argv=None):
     p.add_argument("--session", default=None,
                    help="session dir (default: newest live session)")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="head HOST:PORT to join as a worker node")
+    sp.add_argument("--authkey", default=None,
+                    help="session authkey hex (or RAY_TPU_AUTHKEY)")
+    sp.add_argument("--port", type=int, default=None,
+                    help="head TCP port (enables cross-machine joins)")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--resources", default=None)
+    sp.add_argument("--session-dir", default=None)
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    sp.set_defaults(fn=cmd_start)
+
+    st = sub.add_parser("stop")
+    st.set_defaults(fn=cmd_stop)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

@@ -1,0 +1,170 @@
+"""Helper for test_head_restart.py — run as a subprocess in three modes:
+
+  orchestrate SESSION PORT   start head + daemon, run setup, SIGKILL the
+                             head, restart it, run check
+  setup SESSION              driver 1: named actor + kv + job
+  check SESSION JOB_ID       driver 2: assert everything survived
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ["PYTHONPATH"] = REPO + os.pathsep + \
+    os.environ.get("PYTHONPATH", "")
+
+
+def start_head(session, port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_main",
+         "--session-dir", session, "--port", str(port),
+         "--bind-host", "127.0.0.1", "--num-cpus", "2"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+
+def orchestrate(session, port):
+    head = daemon = None
+    try:
+        head = start_head(session, port)
+        deadline = time.time() + 60
+        addr_file = os.path.join(session, "head_address")
+        while not os.path.exists(addr_file):
+            assert time.time() < deadline, "head never came up"
+            assert head.poll() is None, "head died at startup"
+            time.sleep(0.2)
+        with open(os.path.join(session, "authkey"), "rb") as f:
+            authkey = f.read().hex()
+        with open(addr_file) as f:
+            head_addr = f.read().strip()
+
+        # join one worker machine (a daemon over TCP, as `ray_tpu start
+        # --address HOST:PORT` would)
+        denv = dict(os.environ)
+        denv["RAY_TPU_AUTHKEY"] = authkey
+        denv["RAY_TPU_DAEMON_RECONNECT_GRACE_S"] = "60"
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.daemon", head_addr,
+             "node_worker1", json.dumps({"CPU": 2.0, "side": 2.0}), "0"],
+            env=denv, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+        setup = subprocess.run(
+            [sys.executable, __file__, "setup", session],
+            capture_output=True, text=True, timeout=120)
+        sys.stderr.write(setup.stdout + setup.stderr)
+        assert setup.returncode == 0, "setup driver failed"
+        job_id = [ln.split()[1] for ln in setup.stdout.splitlines()
+                  if ln.startswith("JOB_ID")][0]
+
+        # SIGKILL the head mid-workload, then restart into the session
+        head.kill()
+        head.wait()
+        time.sleep(1.0)
+        head = start_head(session, port)
+
+        check = subprocess.run(
+            [sys.executable, __file__, "check", session, job_id],
+            capture_output=True, text=True, timeout=240)
+        sys.stderr.write(check.stdout + check.stderr)
+        assert check.returncode == 0, "post-restart driver failed"
+        assert "RESTART-OK" in check.stdout
+
+        head.terminate()
+        head.wait(timeout=30)
+        daemon.wait(timeout=30)
+        print("ALL-OK")
+    finally:
+        for p in (head, daemon):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+def setup(session):
+    import ray_tpu
+    ray_tpu.init(address=session)
+
+    @ray_tpu.remote(resources={"side": 1}, name="keeper")
+    class Keeper:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    k = Keeper.remote()
+    assert ray_tpu.get(k.bump.remote(), timeout=60) == 1
+    assert ray_tpu.get(k.bump.remote(), timeout=60) == 2
+
+    c = ray_tpu._worker.get_client()
+    c.control("kv_put", ("ns", "survives", b"yes"))
+
+    from ray_tpu.job_submission import JobSubmissionClient
+    jid = JobSubmissionClient().submit_job(
+        entrypoint="sleep 4; echo job-finished")
+    print("JOB_ID", jid)
+    time.sleep(2.5)   # let a head snapshot land
+
+
+def check(session, job_id):
+    import ray_tpu
+
+    deadline = time.time() + 60
+    while True:
+        try:
+            ray_tpu.init(address=session)
+            break
+        except (ConnectionError, OSError):
+            assert time.time() < deadline, "head never came back"
+            time.sleep(0.5)
+
+    # the daemon must re-register within its reconnect grace
+    c = ray_tpu._worker.get_client()
+    deadline = time.time() + 90
+    while True:
+        nodes = c.control("list_nodes")
+        if any(n["node_id"] == "node_worker1" and n["alive"]
+               for n in nodes):
+            break
+        assert time.time() < deadline, \
+            f"daemon never re-registered: {nodes}"
+        time.sleep(0.5)
+
+    # detached named actor kept its in-memory state (n == 2 -> bump == 3)
+    k = ray_tpu.get_actor("keeper")
+    deadline = time.time() + 60
+    while True:
+        try:
+            n = ray_tpu.get(k.bump.remote(), timeout=30)
+            break
+        except Exception:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.5)
+    assert n == 3, f"actor lost its state: bump() -> {n}"
+
+    assert c.control("kv_get", ("ns", "survives")) == b"yes"
+
+    from ray_tpu.job_submission import JobSubmissionClient
+    st = JobSubmissionClient().wait_until_finished(job_id, timeout=120)
+    assert st == "SUCCEEDED", st
+    logs = JobSubmissionClient().get_job_logs(job_id)
+    assert "job-finished" in logs, logs
+    print("RESTART-OK")
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    if mode == "orchestrate":
+        orchestrate(sys.argv[2], int(sys.argv[3]))
+    elif mode == "setup":
+        setup(sys.argv[2])
+    elif mode == "check":
+        check(sys.argv[2], sys.argv[3])
+    else:
+        raise SystemExit(f"unknown mode {mode}")

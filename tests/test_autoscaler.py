@@ -146,3 +146,78 @@ def test_gang_demand_launches_slice():
     scaler.update()
     assert provider.created_log == [("tpu_v5e_8", 1)]
     assert scaler.infeasible_gangs == []
+
+
+# ---------------------------------------------------------------------------
+# Closed loop e2e: demand flows head -> LoadMetrics -> StandardAutoscaler ->
+# LocalDaemonNodeProvider -> REAL HostDaemon processes (reference:
+# monitor.py:249 update_load_metrics + fake_multi_node/node_provider.py:237).
+# Runs in a subprocess with its own session so the shared fixture session
+# never sees autoscaled nodes.
+# ---------------------------------------------------------------------------
+
+_E2E = r"""
+import time
+import ray_tpu
+
+ray_tpu.init(num_cpus=1)
+c = ray_tpu._worker.get_client()
+c.control("attach_autoscaler", {
+    "max_workers": 3,
+    "idle_timeout_minutes": 3.0 / 60.0,      # 3s idle -> drain
+    "available_node_types": {
+        "cpu_worker": {
+            "resources": {"CPU": 2, "work": 2},
+            "node_config": {"resources": {"CPU": 2, "work": 2}},
+            "min_workers": 0, "max_workers": 3,
+        },
+    },
+})
+
+@ray_tpu.remote(resources={"work": 1})
+def f(i):
+    time.sleep(1.0)
+    return i
+
+# demand spike: the head has no 'work' resource at all, so these tasks are
+# only runnable on autoscaled nodes
+refs = [f.remote(i) for i in range(4)]
+out = ray_tpu.get(refs, timeout=180)
+assert sorted(out) == [0, 1, 2, 3]
+grown = [n for n in c.control("list_nodes")
+         if n["alive"] and not n.get("head")]
+assert len(grown) >= 1, "no nodes were launched"
+
+st = c.control("autoscaler_status")
+assert st["enabled"] and sum(st["workers_by_type"].values()) >= 1, st
+
+# an infeasible placement group becomes gang demand, not an error
+pg_id = c.control("create_pg",
+                  {"bundles": [{"work": 2.0}], "strategy": "STRICT_PACK"})
+assert pg_id
+assert c.control("remove_pg", pg_id) in (True, None)
+
+# idle timeout: all autoscaled nodes drain away
+deadline = time.time() + 90
+while True:
+    left = [n for n in c.control("list_nodes")
+            if n["alive"] and not n.get("head")]
+    if not left:
+        break
+    assert time.time() < deadline, f"nodes never drained: {left}"
+    time.sleep(1.0)
+print("AUTOSCALE-OK")
+ray_tpu.shutdown()
+"""
+
+
+def test_autoscaler_closed_loop_e2e():
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", _E2E], cwd=repo,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "AUTOSCALE-OK" in r.stdout

@@ -1,0 +1,38 @@
+"""Standalone head + head-restart survival.
+
+Counterpart of the reference's GCS fault tolerance
+(test_gcs_fault_tolerance.py over gcs_server.h:78 + Redis persistence
+redis_store_client.h:33 + NotifyGCSRestart node_manager.proto:358):
+the head runs as its OWN process (`ray_tpu._private.head_main`), gets
+SIGKILLed mid-workload, restarts into the same session dir, and then
+
+- the HostDaemon reconnects and re-registers (actors + objects intact),
+- a detached NAMED actor keeps its in-memory state across the restart,
+- a job submitted before the kill completes after it,
+- KV entries survive.
+
+Scenario lives in head_restart_helper.py (orchestrate/setup/check modes).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HELPER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "head_restart_helper.py")
+
+
+def test_head_restart_survival(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    session = str(tmp_path / "session")
+    os.makedirs(session, exist_ok=True)
+    r = subprocess.run(
+        [sys.executable, HELPER, "orchestrate", session, str(port)],
+        cwd=REPO, capture_output=True, text=True, timeout=480)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "ALL-OK" in r.stdout
