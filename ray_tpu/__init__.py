@@ -52,6 +52,7 @@ def init(num_cpus: int | None = None,
          namespace: str | None = None,
          logging_level: str = "INFO",
          dashboard_port: int | None = None,
+         log_to_driver: bool | None = None,
          **kwargs):
     """Start a session (driver mode), or — with `address` — connect this
     process as a SECOND driver to an existing session (the reference's Ray
@@ -72,7 +73,7 @@ def init(num_cpus: int | None = None,
                 f"init(address=...) joins an EXISTING session; "
                 f"{dropped + sorted(kwargs)} cannot be configured from a "
                 "client driver")
-        return _connect_client(address, ignore_reinit_error)
+        return _connect_client(address, ignore_reinit_error, log_to_driver)
     if _worker.is_initialized():
         if ignore_reinit_error:
             return _worker.get_client()
@@ -89,13 +90,20 @@ def init(num_cpus: int | None = None,
         total[k] = float(v)
 
     from ray_tpu._private.node import NodeServer
-    _gc_stale_sessions()
+    if constants.GC_STALE_SESSIONS:
+        _gc_stale_sessions()
     session_dir = os.path.join(
         constants.SHM_ROOT,
         constants.SESSION_PREFIX + ids.new_node_id())
     os.makedirs(session_dir, exist_ok=True)
     node = NodeServer(total, session_dir, num_tpu_chips=int(num_tpus or 0))
     client = _worker.connect_driver_mode(node)
+    if log_to_driver is None:
+        # jobs stream their cluster's logs by default (the job log file
+        # then carries worker output); interactive drivers opt in
+        log_to_driver = os.environ.get("RAY_TPU_LOG_TO_DRIVER") == "1"
+    if log_to_driver:
+        client.control("log_subscribe")
     if dashboard_port is not None:
         from ray_tpu.dashboard import start_dashboard
         try:
@@ -108,7 +116,8 @@ def init(num_cpus: int | None = None,
     return client
 
 
-def _connect_client(address: str, ignore_reinit_error: bool = False):
+def _connect_client(address: str, ignore_reinit_error: bool = False,
+                    log_to_driver: bool | None = None):
     """Join an existing session as a remote driver: register on the head's
     socket with an attach-class worker id (never dispatched to) and run
     the full worker protocol — get/put/submit/actors all work."""
@@ -156,7 +165,11 @@ def _connect_client(address: str, ignore_reinit_error: bool = False):
     rt.send(protocol.RegisterWorker(wid, os.getpid()))
     threading.Thread(target=rt.reader_loop, daemon=True,
                      name="ray_tpu-client-reader").start()
-    return _worker.connect_worker_mode(rt)
+    client = _worker.connect_worker_mode(rt)
+    if log_to_driver or (log_to_driver is None and
+                         os.environ.get("RAY_TPU_LOG_TO_DRIVER") == "1"):
+        client.control("log_subscribe")
+    return client
 
 
 def _gc_stale_sessions():

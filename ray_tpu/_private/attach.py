@@ -87,7 +87,11 @@ class AttachClient:
                     self._have.notify_all()
             # anything else (KillWorker on shutdown, pushes) is ignored
 
-    def control(self, method: str, payload=None, timeout: float = 30.0):
+    def control(self, method: str, payload=None,
+                timeout: float | None = None):
+        if timeout is None:
+            from ray_tpu._private.constants import ATTACH_CONTROL_TIMEOUT_S
+            timeout = ATTACH_CONTROL_TIMEOUT_S
         with self._lock:
             self._req += 1
             rid = self._req

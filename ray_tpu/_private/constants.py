@@ -40,17 +40,24 @@ WORKER_REGISTER_TIMEOUT_S = define(
     "declaring startup failure (reference: "
     "worker_register_timeout_seconds).")
 
-# Default resource requests (reference: task default num_cpus=1; actors hold
-# 0 lifetime CPUs unless explicitly requested — ray_option_utils.py).
-DEFAULT_TASK_NUM_CPUS = 1.0
-DEFAULT_ACTOR_LIFETIME_CPUS = 0.0
+DEFAULT_TASK_NUM_CPUS = define(
+    "DEFAULT_TASK_NUM_CPUS", float, 1.0,
+    "CPUs a task holds when @remote doesn't say (reference: tasks "
+    "default to num_cpus=1, ray_option_utils.py).")
 
-# Buffer alignment inside serialized envelopes so zero-copy numpy views are
-# 64-byte aligned (plasma aligns to 64 as well).
-BUFFER_ALIGNMENT = 64
+DEFAULT_ACTOR_LIFETIME_CPUS = define(
+    "DEFAULT_ACTOR_LIFETIME_CPUS", float, 0.0,
+    "CPUs an actor holds for its lifetime when @remote doesn't say "
+    "(reference: actors hold 0 lifetime CPUs by default).")
 
-# Polling granularity for blocking waits.
-WAIT_POLL_S = 0.01
+BUFFER_ALIGNMENT = define(
+    "BUFFER_ALIGNMENT", int, 64,
+    "Byte alignment of buffers inside serialized envelopes so zero-copy "
+    "numpy views land 64-byte aligned (plasma aligns to 64 too).")
+
+WAIT_POLL_S = define(
+    "WAIT_POLL_S", float, 0.01,
+    "Polling granularity for blocking waits in the client runtime.")
 
 MAX_INFLIGHT_SUBMISSIONS = define(
     "MAX_INFLIGHT_SUBMISSIONS", int, 100_000,
@@ -115,6 +122,11 @@ RUNTIME_ENV_CACHE_ENTRIES = define(
     "RUNTIME_ENV_CACHE_ENTRIES", int, 20,
     "LRU cap on cached runtime-env entries.")
 
+PUBSUB_RING_MESSAGES = define(
+    "PUBSUB_RING_MESSAGES", int, 1000,
+    "Per-channel cap on retained pubsub messages (long-poll publisher "
+    "ring, reference: publisher.h buffered channels).")
+
 # --- transport (reference: gRPC-over-TCP for every cross-host edge,
 # src/ray/rpc/grpc_server.h; UDS only worker<->local raylet) ---
 
@@ -159,9 +171,177 @@ AUTOSCALER_UPDATE_INTERVAL_S = define(
     "StandardAutoscaler.update (reference: monitor.py:371 loop, "
     "AUTOSCALER_UPDATE_INTERVAL_S=5).")
 
+WORKER_LOG_REDIRECT = define(
+    "WORKER_LOG_REDIRECT", bool, True,
+    "Write each worker/daemon process's stdout+stderr to its own file "
+    "under the session (node) logs dir instead of inheriting the "
+    "driver's terminal (reference: per-process files under the session "
+    "dir, log_monitor.py). Disable for raw interleaved output.")
+
+LOG_TAIL_INTERVAL_S = define(
+    "LOG_TAIL_INTERVAL_S", float, 0.5,
+    "How often the head/daemon LogTailer polls its local log files for "
+    "new lines (reference: LOG_NAME_UPDATE_INTERVAL_S).")
+
+LOG_RING_LINES = define(
+    "LOG_RING_LINES", int, 2000,
+    "Per-source cap on log lines the head retains for the dashboard "
+    "/api/logs endpoint and `ray_tpu logs`.")
+
 PG_AUTOSCALE_WAIT_S = define(
     "PG_AUTOSCALE_WAIT_S", float, 60.0,
     "With an autoscaler attached, how long placement-group creation "
     "waits for capacity (the gang rides the demand queue) before "
     "raising PlacementGroupError (reference: PENDING placement groups "
     "feed autoscaler demand).")
+
+# --- object data plane (object_manager.h chunking / pull admission) ---
+
+PULL_CHUNK_BYTES = define(
+    "PULL_CHUNK_BYTES", int, 8 << 20,
+    "Chunk size for node-to-node object pulls (reference: "
+    "object_manager_default_chunk_size; 8 MiB measured best for GiB-"
+    "scale broadcasts on the pickle-framed channel, see SCALE.json).")
+
+PULL_TIMEOUT_S = define(
+    "PULL_TIMEOUT_S", float, 120.0,
+    "Deadline for one chunked object pull before the reader declares "
+    "the object unavailable from that source.")
+
+PULL_RETRY_ATTEMPTS = define(
+    "PULL_RETRY_ATTEMPTS", int, 4,
+    "How many sources/attempts a head-side pull tries (promotion or "
+    "reconstruction can re-home the object between attempts).")
+
+OBJECT_REPLACEMENT_WAIT_S = define(
+    "OBJECT_REPLACEMENT_WAIT_S", float, 60.0,
+    "After an object's source died mid-pull, how long to wait for a "
+    "promoted copy or lineage reconstruction to re-register it.")
+
+FREED_REFS_CAP = define(
+    "FREED_REFS_CAP", int, 100_000,
+    "Bounded FIFO of freed object ids kept as tombstones so racing "
+    "get/wait calls fail fast instead of hanging.")
+
+ARGS_RELEASED_CAP = define(
+    "ARGS_RELEASED_CAP", int, 200_000,
+    "Bounded FIFO of task ids whose args were already released "
+    "(exactly-once guard on the refcount decrement).")
+
+# --- control-plane timeouts / cadences ---
+
+HEAD_CONTROL_TIMEOUT_S = define(
+    "HEAD_CONTROL_TIMEOUT_S", float, 30.0,
+    "Daemon-issued control RPCs to the head (peer address lookup etc.) "
+    "fail after this many seconds.")
+
+ACTOR_LEASE_WAIT_S = define(
+    "ACTOR_LEASE_WAIT_S", float, 30.0,
+    "How long a daemon waits for an actor's worker to (re)appear before "
+    "failing a leased actor method call.")
+
+ATTACH_CONTROL_TIMEOUT_S = define(
+    "ATTACH_CONTROL_TIMEOUT_S", float, 30.0,
+    "Default timeout for CLI/job attach-client control calls.")
+
+SPILL_PASS_INTERVAL_S = define(
+    "SPILL_PASS_INTERVAL_S", float, 1.0,
+    "How often the head/daemon spill loop checks the arena high-water "
+    "mark (local_object_manager spill polling analog).")
+
+REF_FLUSH_INTERVAL_S = define(
+    "REF_FLUSH_INTERVAL_S", float, 0.5,
+    "Workers batch ObjectRef hold/release events and flush them to the "
+    "head at this cadence (__del__ storms never become message storms).")
+
+JOB_ADOPT_POLL_S = define(
+    "JOB_ADOPT_POLL_S", float, 0.5,
+    "Poll interval while a restarted head watches an adopted job's "
+    "process for exit.")
+
+METRICS_FLUSH_PERIOD_S = define(
+    "METRICS_FLUSH_PERIOD_S", float, 5.0,
+    "Workers push metric snapshots to the head at this cadence "
+    "(reference: metrics_report_interval_ms).")
+
+TASK_EVENT_QUERY_LIMIT = define(
+    "TASK_EVENT_QUERY_LIMIT", int, 10_000,
+    "Default cap on task records returned by the state API "
+    "(reference: RAY_MAX_LIMIT_FROM_API_SERVER).")
+
+GC_STALE_SESSIONS = define(
+    "GC_STALE_SESSIONS", bool, True,
+    "init() sweeps session dirs whose driver/head process is dead "
+    "before creating a new one.")
+
+DASHBOARD_BIND_HOST = define(
+    "DASHBOARD_BIND_HOST", str, "127.0.0.1",
+    "Bind host for the dashboard HTTP server.")
+
+# --- ray_tpu.data streaming executor budgets (reference: Data streaming
+# backpressure, streaming_executor_state.py) ---
+
+DATA_MAX_TASKS_IN_FLIGHT = define(
+    "DATA_MAX_TASKS_IN_FLIGHT", int, 8,
+    "Per-operator cap on concurrently running Data tasks when the "
+    "DataContext doesn't override it.")
+
+DATA_BYTES_IN_FLIGHT = define(
+    "DATA_BYTES_IN_FLIGHT", int, 128 * 1024 * 1024,
+    "Per-operator byte budget of in-flight blocks (streaming "
+    "backpressure, reference byte-budget model).")
+
+DATA_BLOCK_SIZE_ESTIMATE = define(
+    "DATA_BLOCK_SIZE_ESTIMATE", int, 8 * 1024 * 1024,
+    "Default estimated output block size used for read planning before "
+    "any block has materialized.")
+
+# --- ray_tpu.serve control/data plane cadences ---
+
+SERVE_RECONCILE_PERIOD_S = define(
+    "SERVE_RECONCILE_PERIOD_S", float, 1.0,
+    "Serve controller reconcile loop period (deployment_state.py "
+    "analog).")
+
+SERVE_HANDLE_REFRESH_S = define(
+    "SERVE_HANDLE_REFRESH_S", float, 2.0,
+    "How often a ServeHandle refreshes its replica set from the "
+    "controller (long-poll refresh analog).")
+
+SERVE_STREAM_BATCH = define(
+    "SERVE_STREAM_BATCH", int, 16,
+    "Streaming responses ship this many chunks per proxy round-trip.")
+
+SERVE_STREAM_IDLE_TTL_S = define(
+    "SERVE_STREAM_IDLE_TTL_S", float, 300.0,
+    "Undrained response streams are reaped after this idle time.")
+
+SERVE_DOWNSCALE_DELAY_S = define(
+    "SERVE_DOWNSCALE_DELAY_S", float, 30.0,
+    "Default delay before the Serve autoscaler honors a downscale "
+    "decision (reference: downscale_delay_s).")
+
+SERVE_STATS_TIMEOUT_S = define(
+    "SERVE_STATS_TIMEOUT_S", float, 10.0,
+    "Timeout for the controller's replica stats fan-out each "
+    "autoscaling tick.")
+
+SERVE_HTTP_HOST = define(
+    "SERVE_HTTP_HOST", str, "127.0.0.1",
+    "Default bind host for the Serve HTTP proxy.")
+
+SERVE_HTTP_PORT = define(
+    "SERVE_HTTP_PORT", int, 8000,
+    "Default port for the Serve HTTP proxy (reference: "
+    "serve.start(http_options).")
+
+# --- runtime environments ---
+
+RUNTIME_ENV_VENV_CREATE_TIMEOUT_S = define(
+    "RUNTIME_ENV_VENV_CREATE_TIMEOUT_S", int, 120,
+    "Timeout for creating a pip runtime-env virtualenv.")
+
+RUNTIME_ENV_PIP_INSTALL_TIMEOUT_S = define(
+    "RUNTIME_ENV_PIP_INSTALL_TIMEOUT_S", int, 600,
+    "Timeout for installing a pip runtime-env's requirements "
+    "(reference: pip runtime env install timeout).")

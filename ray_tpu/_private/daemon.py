@@ -127,6 +127,13 @@ class HostDaemon:
 
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="daemon-accept").start()
+        # ship this host's per-process log lines to the head (reference:
+        # the per-node log monitor publishing via GCS pubsub)
+        from ray_tpu._private.log_monitor import LogTailer
+        self._log_tailer = LogTailer(
+            os.path.join(self.node_dir, "logs"),
+            lambda src, lines: self._head_send(
+                protocol.LogBatch(src, self.node_id, lines))).start()
         if self._peer_listener is not None:
             threading.Thread(
                 target=self._accept_loop, args=(self._peer_listener,),
@@ -229,6 +236,14 @@ class HostDaemon:
             self._route_reply(msg)
         elif isinstance(msg, protocol.FreeObjectNode):
             self._free_local(msg.object_id)
+        elif isinstance(msg, protocol.DumpStack):
+            # fan out to this host's workers; replies ride back up
+            with self.lock:
+                targets = [w for w in self.workers.values()
+                           if w.alive and (msg.worker_id is None
+                                           or w.worker_id == msg.worker_id)]
+            for w in targets:
+                w.send(msg)
         elif isinstance(msg, protocol.KillActorOnNode):
             with self.lock:
                 w = self.actors.get(msg.actor_id)
@@ -305,6 +320,8 @@ class HostDaemon:
     def _handle_worker(self, w: _DWorker, msg):
         if isinstance(msg, protocol.TaskDone):
             self._on_task_done(w, msg)
+        elif isinstance(msg, protocol.StackDumpReply):
+            self._head_send(msg)     # forward up to the collector
         elif isinstance(msg, protocol.PutRequest):
             with self.lock:
                 if msg.desc.inline is None:
@@ -340,10 +357,13 @@ class HostDaemon:
         else:
             logger.warning("unknown worker message %r", type(msg))
 
-    def _head_control(self, method, payload=None, timeout: float = 30.0):
+    def _head_control(self, method, payload=None,
+                      timeout: float | None = None):
         """The daemon's OWN control RPC to the head (distinct from the
         worker-request proxying): e.g. resolving a peer address it was
         never told about."""
+        if timeout is None:
+            timeout = constants.HEAD_CONTROL_TIMEOUT_S
         hreq = next(self._req)
         box = {"done": False, "result": None, "error": None}
         with self._ctl_cv:
@@ -429,7 +449,7 @@ class HostDaemon:
             return
         if spec.actor_id is not None and not spec.actor_creation:
             with self.cv:
-                deadline = time.monotonic() + 30.0
+                deadline = time.monotonic() + constants.ACTOR_LEASE_WAIT_S
                 w = self.actors.get(spec.actor_id)
                 while w is None or not w.alive:
                     rem = deadline - time.monotonic()
@@ -510,8 +530,9 @@ class HostDaemon:
             with self.lock:
                 self.workers.pop(wid, None)
             raise
-        w.proc = spawn.spawn_worker_proc(self.address, self.authkey, wid,
-                                         env, python_exe, cwd)
+        w.proc = spawn.spawn_worker_proc(
+            self.address, self.authkey, wid, env, python_exe, cwd,
+            log_dir=os.path.join(self.node_dir, "logs"))
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
         with self.cv:
             while not w.alive:
@@ -663,7 +684,7 @@ class HostDaemon:
             serve_pull(send, msg, None)
             return
         try:
-            payload = self.store.raw_bytes(desc)
+            payload = self.store.raw_view(desc)
         except (ObjectLostError, OSError) as e:
             payload = e
         serve_pull(send, msg, payload)
@@ -673,7 +694,7 @@ class HostDaemon:
         the disk spill dir and re-register their descriptors with the head
         (LocalObjectManager equivalent on the daemon's own store)."""
         while not self._shutdown:
-            time.sleep(1.0)
+            time.sleep(constants.SPILL_PASS_INTERVAL_S)
             try:
                 self._maybe_spill()
             except Exception:

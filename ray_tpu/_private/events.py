@@ -76,7 +76,10 @@ class TaskEventRecorder:
     # -- reads --------------------------------------------------------------
 
     def snapshot(self, filters: dict | None = None,
-                 limit: int = 10_000) -> list[dict]:
+                 limit: int | None = None) -> list[dict]:
+        if limit is None:
+            from ray_tpu._private.constants import TASK_EVENT_QUERY_LIMIT
+            limit = TASK_EVENT_QUERY_LIMIT
         with self._lock:
             out = []
             for r in reversed(self._tasks.values()):   # newest first

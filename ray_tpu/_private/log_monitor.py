@@ -1,0 +1,116 @@
+"""Per-process log capture + tail-to-head streaming.
+
+Counterpart of the reference's log pipeline: every worker/daemon process
+writes stdout+stderr to its own file under the session (node) dir, a
+LogMonitor tails those files (`python/ray/_private/log_monitor.py:102`)
+and publishes new lines so the driver can print them
+(`worker.py` log_to_driver) and the dashboard can serve them
+(`dashboard/modules/log/`). Here the head and every HostDaemon run one
+`LogTailer` each over their local ``logs/`` dir; daemons ship batches to
+the head over the node channel, and the head fans batches out to
+subscribed drivers + keeps a bounded ring per source for `/api/logs`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ray_tpu._private import config
+
+
+class LogTailer:
+    """Tails every ``*.log`` file under `log_dir`, invoking
+    ``emit(source, lines)`` with decoded new lines. `source` is the file
+    name minus extension (e.g. ``worker-abc123``)."""
+
+    def __init__(self, log_dir: str, emit, interval: float | None = None):
+        self.log_dir = log_dir
+        self.emit = emit
+        self.interval = (config.get("LOG_TAIL_INTERVAL_S")
+                         if interval is None else interval)
+        self._offsets: dict[str, int] = {}     # path -> bytes consumed
+        self._partial: dict[str, bytes] = {}   # path -> trailing part-line
+        self._stop = threading.Event()
+
+    def start(self) -> "LogTailer":
+        threading.Thread(target=self._loop, name="log-tailer",
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def poll(self) -> None:
+        """One tail pass (public so tests can drive it deterministically)."""
+        if not os.path.isdir(self.log_dir):
+            return
+        for name in sorted(os.listdir(self.log_dir)):
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(path, 0)
+            if size < off:          # truncated/rotated: start over
+                off = 0
+                self._partial.pop(path, None)
+            if size == off:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            self._offsets[path] = off + len(chunk)
+            data = self._partial.pop(path, b"") + chunk
+            *lines, tail = data.split(b"\n")
+            if tail:
+                self._partial[path] = tail
+            if lines:
+                self.emit(name[:-4],
+                          [ln.decode(errors="replace") for ln in lines])
+
+
+class LogRing:
+    """Bounded per-source line ring the head serves `/api/logs` from
+    (daemon files aren't reachable across machines, their lines are)."""
+
+    def __init__(self, max_lines: int | None = None):
+        self.max_lines = (config.get("LOG_RING_LINES")
+                          if max_lines is None else max_lines)
+        self._lock = threading.Lock()
+        self._rings: dict[str, list[str]] = {}
+        self._stamps: dict[str, float] = {}
+
+    def append(self, source: str, lines: list[str]) -> None:
+        with self._lock:
+            ring = self._rings.setdefault(source, [])
+            ring.extend(lines)
+            if len(ring) > self.max_lines:
+                del ring[:len(ring) - self.max_lines]
+            self._stamps[source] = time.time()
+
+    def sources(self) -> list[dict]:
+        with self._lock:
+            return [{"source": s, "lines": len(r),
+                     "last_ts": self._stamps.get(s)}
+                    for s, r in sorted(self._rings.items())]
+
+    def tail(self, source: str, n: int = 200) -> list[str]:
+        if n <= 0:
+            return []
+        with self._lock:
+            return list(self._rings.get(source, [])[-n:])

@@ -22,11 +22,22 @@ def native_disabled() -> bool:
 
 def build_extension(name: str) -> str | None:
     """Compile native/<name>.cc -> native/lib<name>.so if stale; return the
-    .so path, or None if native is disabled or the toolchain fails."""
+    .so path, or None if native is disabled or the toolchain fails.
+
+    RAY_TPU_SANITIZE=thread|address builds a separate sanitizer-
+    instrumented library (lib<name>.tsan.so / .asan.so) — the stress
+    harness runs against it the way the reference's plasma tests run
+    under bazel's TSAN/ASAN configs (ci/)."""
     if native_disabled():
         return None
+    sanitize = os.environ.get("RAY_TPU_SANITIZE", "")
     src = os.path.join(_DIR, name + ".cc")
-    out = os.path.join(_DIR, "lib" + name + ".so")
+    suffix = {"thread": ".tsan", "address": ".asan"}.get(sanitize, "")
+    out = os.path.join(_DIR, "lib" + name + suffix + ".so")
+    flags = ["-O2"]
+    if sanitize in ("thread", "address"):
+        flags = ["-O1", "-g", f"-fsanitize={sanitize}",
+                 "-fno-omit-frame-pointer"]
     with _BUILD_LOCK:
         try:
             if (os.path.exists(out)
@@ -34,7 +45,7 @@ def build_extension(name: str) -> str | None:
                 return out
             tmp = out + ".tmp.%d" % os.getpid()
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                ["g++", *flags, "-std=c++17", "-shared", "-fPIC",
                  "-o", tmp, src, "-lpthread"],
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, out)  # atomic: concurrent builders race safely

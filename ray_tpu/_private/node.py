@@ -314,11 +314,152 @@ class NodeServer:
         from ray_tpu._private.memory_monitor import MemoryMonitor
         self._memory_monitor = MemoryMonitor(self)
         self._memory_monitor.start()
+        # Log pipeline (reference: log_monitor.py:102 + dashboard log
+        # module): tail this host's per-process log files; daemons ship
+        # theirs over the node channel; ring + subscribers fan out.
+        from ray_tpu._private.log_monitor import LogRing, LogTailer
+        self._log_ring = LogRing()
+        self._log_subs: list = []     # conns (have .send) or callables
+        # stack-dump collection + pubsub channels
+        self._stack_req = itertools.count(1)
+        self._stack_waits: dict = {}
+        self._stack_cv = threading.Condition()
+        self._pubsub: dict = {}       # channel -> [last_seq, ring]
+        self._pubsub_cv = threading.Condition()
+        self._log_tailer = LogTailer(
+            os.path.join(session_dir, "logs"),
+            lambda src, lines: self._publish_logs(
+                protocol.LogBatch(src, None, lines))).start()
         if standalone:
             threading.Thread(target=self._snapshot_loop,
                              name="ray_tpu-gcs-snapshot",
                              daemon=True).start()
         atexit.register(self.shutdown)
+
+    # ------------------------------------------------------------------
+    # on-demand stack dumps (reference: `ray stack` CLI scripts.py:1786 +
+    # py-spy profile_manager.py — workers self-sample, no ptrace)
+    # ------------------------------------------------------------------
+
+    def collect_stacks(self, worker_id: str | None = None,
+                       timeout: float = 5.0) -> dict:
+        """Fan DumpStack to head-local workers and every node; gather
+        replies for up to `timeout`s. -> {worker_id: {pid, stacks}}."""
+        req = next(self._stack_req)
+        box: dict = {}
+        with self._stack_cv:
+            self._stack_waits[req] = box
+        expect = 0
+        with self.lock:
+            for w in self.workers.values():
+                if w.alive and w.kind != "attach" and (
+                        worker_id is None or w.worker_id == worker_id):
+                    if w.send(protocol.DumpStack(req, worker_id)):
+                        expect += 1
+            nodes = [n for n in self.nodes.values() if n.alive]
+        for n in nodes:
+            n.send(protocol.DumpStack(req, worker_id))
+        deadline = time.monotonic() + timeout
+        grace = 0.5    # node worker counts are unknown up front: stop
+        #                once replies go quiet for this long
+        last_size, quiet_since = 0, time.monotonic()
+        with self._stack_cv:
+            while True:
+                rem = deadline - time.monotonic()
+                if rem <= 0 or (worker_id is not None and box):
+                    break
+                if not nodes and expect and len(box) >= expect:
+                    break
+                if len(box) != last_size:
+                    last_size, quiet_since = len(box), time.monotonic()
+                elif box and time.monotonic() - quiet_since >= grace:
+                    break
+                self._stack_cv.wait(min(rem, 0.25))
+            self._stack_waits.pop(req, None)
+        return dict(box)
+
+    def _on_stack_reply(self, msg: protocol.StackDumpReply) -> None:
+        with self._stack_cv:
+            box = self._stack_waits.get(msg.req_id)
+            if box is not None:
+                box[msg.worker_id] = {"pid": msg.pid, "stacks": msg.text}
+                self._stack_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # pubsub channels (reference: src/ray/pubsub/publisher.h:307 long-
+    # poll publisher/subscriber framework; here a head-held ring per
+    # channel + long-poll control verbs)
+    # ------------------------------------------------------------------
+
+    def pubsub_publish(self, channel: str, message) -> int:
+        with self._pubsub_cv:
+            seq, ring = self._pubsub.setdefault(channel, [0, []])
+            seq += 1
+            ring.append((seq, message))
+            cap = config.get("PUBSUB_RING_MESSAGES")
+            if len(ring) > cap:
+                del ring[:len(ring) - cap]
+            self._pubsub[channel] = [seq, ring]
+            self._pubsub_cv.notify_all()
+        return seq
+
+    def pubsub_poll(self, channel: str, after: int,
+                    timeout: float = 30.0):
+        """Long-poll: block until the channel holds messages with seq >
+        after (or timeout) -> (last_seq, [messages]). Runs on a
+        _BLOCKING_CONTROL thread, never a reader loop."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._pubsub_cv:
+            while True:
+                seq, ring = self._pubsub.get(channel, (0, []))
+                fresh = [m for s, m in ring if s > after]
+                if fresh:
+                    return seq, fresh
+                rem = deadline - time.monotonic()
+                if rem <= 0 or self._shutdown:
+                    return seq, []
+                self._pubsub_cv.wait(min(rem, 0.5))
+
+    # ------------------------------------------------------------------
+    # log pipeline fanout
+    # ------------------------------------------------------------------
+
+    def _publish_logs(self, batch: protocol.LogBatch) -> None:
+        key = batch.source if batch.node_id is None \
+            else f"{batch.node_id}/{batch.source}"
+        self._log_ring.append(key, batch.lines)
+        with self.lock:
+            subs = list(self._log_subs)
+        dead = []
+        for s in subs:
+            if callable(s):
+                try:
+                    s(batch)
+                except Exception:
+                    dead.append(s)
+            elif not s.send(batch) or not s.alive:
+                dead.append(s)
+        if dead:
+            with self.lock:
+                self._log_subs = [s for s in self._log_subs
+                                  if s not in dead]
+
+    def _log_subscribe(self, w) -> bool:
+        if w is None:
+            # driver-mode client: print straight to this process's stderr
+            # (reference: worker.py log_to_driver printing with a
+            # (pid=..., ip=...) prefix)
+            def _print(batch: protocol.LogBatch):
+                nid = batch.node_id or "head"
+                for ln in batch.lines:
+                    print(f"({batch.source}, node={nid}) {ln}",
+                          file=sys.stderr)
+            sub = _print
+        else:
+            sub = w
+        with self.lock:
+            self._log_subs.append(sub)
+        return True
 
     # ------------------------------------------------------------------
     # autoscaler monitor (reference: autoscaler/_private/monitor.py:126 —
@@ -580,6 +721,8 @@ class NodeServer:
     def _handle(self, w: _WorkerConn, msg):
         if isinstance(msg, protocol.TaskDone):
             self._on_task_done(w, msg)
+        elif isinstance(msg, protocol.StackDumpReply):
+            self._on_stack_reply(msg)
         elif isinstance(msg, protocol.PutRequest):
             # the putting worker certainly holds its new ObjectRef right
             # now, but its batched "hold" report may lag by up to the
@@ -623,7 +766,7 @@ class NodeServer:
     # thread: that would stall every other message on the channel —
     # including, on a node channel, the TaskDone that frees the very
     # capacity being waited for.
-    _BLOCKING_CONTROL = frozenset({"create_pg"})
+    _BLOCKING_CONTROL = frozenset({"create_pg", "pubsub_poll", "stack"})
 
     def _dispatch_control(self, w, msg: protocol.ActorCallRequest):
         def run():
@@ -724,6 +867,10 @@ class NodeServer:
             self._on_node_worker_blocked(node, msg)
         elif isinstance(msg, protocol.NodeWorkerGone):
             self._drop_ref_holder(msg.worker_id)
+        elif isinstance(msg, protocol.StackDumpReply):
+            self._on_stack_reply(msg)
+        elif isinstance(msg, protocol.LogBatch):
+            self._publish_logs(replace(msg, node_id=node.node_id))
         elif isinstance(msg, protocol.ObjectCopyNote):
             with self.lock:
                 if msg.object_id in self.directory:
@@ -823,6 +970,25 @@ class NodeServer:
             return self.attach_autoscaler(payload or {})
         if method == "autoscaler_status":
             return self.autoscaler_status()
+        if method == "stack":
+            p = payload or {}
+            return self.collect_stacks(p.get("worker_id"),
+                                       float(p.get("timeout", 5.0)))
+        if method == "pubsub_publish":
+            return self.pubsub_publish(payload["channel"],
+                                       payload["message"])
+        if method == "pubsub_poll":
+            return self.pubsub_poll(payload["channel"],
+                                    int(payload.get("after", 0)),
+                                    float(payload.get("timeout", 30.0)))
+        if method == "log_subscribe":
+            return self._log_subscribe(w)
+        if method == "list_logs":
+            return self._log_ring.sources()
+        if method == "get_log":
+            p = payload or {}
+            return self._log_ring.tail(p["source"],
+                                       int(p.get("lines", 200)))
         if method == "create_pg":
             return self.create_placement_group(**payload)
         if method == "remove_pg":
@@ -995,7 +1161,7 @@ class NodeServer:
             if spec.task_id in self._args_released:
                 return
             self._args_released[spec.task_id] = True
-            while len(self._args_released) > 200_000:
+            while len(self._args_released) > constants.ARGS_RELEASED_CAP:
                 self._args_released.popitem(last=False)
             for kind, v in list(spec.args) + list(spec.kwargs.values()):
                 if kind == "ref":
@@ -1024,7 +1190,7 @@ class NodeServer:
         self.ref_holders.pop(oid, None)
         self.dead_pending.discard(oid)
         self.freed_refs[oid] = True
-        while len(self.freed_refs) > 100_000:
+        while len(self.freed_refs) > constants.FREED_REFS_CAP:
             self.freed_refs.popitem(last=False)
         origin = self.obj_origin.pop(oid, "driver")
         dropped = self.lineage.pop(oid, None)
@@ -1228,7 +1394,7 @@ class NodeServer:
                         f"get() timed out awaiting pull of {oid}")
                 self.cv.wait(0.2)
         try:
-            for _attempt in range(4):
+            for _attempt in range(constants.PULL_RETRY_ATTEMPTS):
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(
                         f"get() timed out pulling {oid}")
@@ -1239,7 +1405,7 @@ class NodeServer:
                         raise ObjectLostError(
                             f"object {oid} lives on dead node {desc.node}")
                     payload = self._pull_bytes(node, oid,
-                                               timeout=budget(120.0))
+                                               timeout=budget(constants.PULL_TIMEOUT_S))
                     local = self.store.put_serialized(oid, payload)
                     with self.lock:
                         # freed while we pulled? drop the stray copy now
@@ -1262,8 +1428,9 @@ class NodeServer:
                                 f"get() timed out pulling {oid}")
                     # the source died mid-pull: wait for a promoted copy
                     # or a reconstructed re-registration, then retry
-                    desc = self._await_fresh_desc(oid, desc,
-                                                  timeout=budget(60.0))
+                    desc = self._await_fresh_desc(
+                        oid, desc,
+                        timeout=budget(constants.OBJECT_REPLACEMENT_WAIT_S))
                     if desc.node is None or desc.inline is not None:
                         return desc     # now head-local (or error value)
             raise ObjectLostError(f"pull of {oid} kept failing")
@@ -1294,7 +1461,7 @@ class NodeServer:
                 self.cv.wait(min(rem, 0.5))
 
     def _pull_bytes(self, node: _RemoteNode, oid: str,
-                    timeout: float = 120.0) -> bytes:
+                    timeout: float | None = None) -> bytes:
         return self._pull_client.pull(
             node.send, oid, timeout=timeout,
             abort_check=lambda: None if node.alive
@@ -1311,7 +1478,7 @@ class NodeServer:
             serve_pull(node.send, msg, None)
             return
         try:
-            payload = self.store.raw_bytes(desc)
+            payload = self.store.raw_view(desc)
         except (ObjectLostError, OSError) as e:
             payload = e
         serve_pull(node.send, msg, payload)
@@ -1598,7 +1765,7 @@ class NodeServer:
 
     def _spill_loop(self):
         while not self._shutdown:
-            time.sleep(1.0)
+            time.sleep(constants.SPILL_PASS_INTERVAL_S)
             try:
                 self._maybe_spill()
             except Exception:
@@ -1703,7 +1870,16 @@ class NodeServer:
                 self.session_dir, "nodes", node_id)
         cmd = [sys.executable, "-m", "ray_tpu._private.daemon",
                head_addr, node_id, _json.dumps(res), str(int(num_tpus))]
-        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL)
+        logf = _spawn.worker_log_file(
+            os.path.join(self.session_dir, "logs"), "daemon-" + node_id[5:])
+        try:
+            proc = subprocess.Popen(
+                cmd, env=env, stdin=subprocess.DEVNULL,
+                stdout=logf or None,
+                stderr=subprocess.STDOUT if logf else None)
+        finally:
+            if logf is not None:
+                logf.close()
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
         with self.cv:
             while node_id not in self.nodes:
@@ -2102,7 +2278,8 @@ class NodeServer:
                 t.spec.runtime_env, env)
             w.proc = spawn_mod.spawn_worker_proc(
                 self._address, self._authkey, worker_id, env,
-                python_exe, cwd)
+                python_exe, cwd,
+                log_dir=os.path.join(self.session_dir, "logs"))
         except RuntimeEnvSetupError as e:
             with self.lock:
                 self._release_task_resources(t)
@@ -2216,8 +2393,9 @@ class NodeServer:
 
     def _spawn_proc(self, worker_id, env):
         from ray_tpu._private import spawn
-        return spawn.spawn_worker_proc(self._address, self._authkey,
-                                       worker_id, env)
+        return spawn.spawn_worker_proc(
+            self._address, self._authkey, worker_id, env,
+            log_dir=os.path.join(self.session_dir, "logs"))
 
     def _spawn_generic_worker(self):
         worker_id = ids.new_worker_id()
@@ -2267,7 +2445,8 @@ class NodeServer:
                 a.creation_spec.runtime_env, env)
             w.proc = spawn_mod.spawn_worker_proc(
                 self._address, self._authkey, worker_id, env,
-                python_exe, cwd)
+                python_exe, cwd,
+                log_dir=os.path.join(self.session_dir, "logs"))
         except RuntimeEnvSetupError as e:
             with self.lock:
                 self.workers.pop(worker_id, None)

@@ -74,9 +74,19 @@ class ObjectStore:
         # shm usage stays bounded by the arena capacity (reference:
         # external_storage.py:246 FileSystemStorage). Paths are absolute in
         # descriptors, so any local process can read another's spill files.
-        self._spill_dir = os.path.join(
-            constants.OBJECT_SPILL_ROOT,
-            os.path.basename(session_dir.rstrip("/")))
+        # OBJECT_SPILL_ROOT may be a URI (mem:// fake, registered gs://):
+        # spill then rides the storage seam and descriptors carry the URI
+        # (reference: smart_open S3 spill, external_storage.py:~350).
+        from ray_tpu._private.config import get as _cfg
+        spill_root = _cfg("OBJECT_SPILL_ROOT")
+        base = os.path.basename(session_dir.rstrip("/"))
+        if "://" in spill_root:
+            from ray_tpu.util import storage as _storage
+            self._spill_uri = _storage.uri_join(spill_root, base)
+            self._spill_dir = os.path.join("/tmp/ray_tpu_spill_stage", base)
+        else:
+            self._spill_uri = None
+            self._spill_dir = os.path.join(spill_root, base)
         # Keep mmaps alive while deserialized views may reference them.
         # obj_id -> (mmap, file size) for file-backed objects only.
         self._maps: dict[str, mmap.mmap] = {}
@@ -114,6 +124,10 @@ class ObjectStore:
                 with self._lock:
                     self._owned.add(object_id)
                 return Descriptor(object_id, n, arena=True)
+        if self._spill_uri is not None:
+            out = bytearray(size)
+            n = serialization.write_envelope(memoryview(out), meta, buffers)
+            return self.spill_payload(object_id, bytes(out[:n]))
         path = self._spill_path(object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb+") as f:
@@ -126,10 +140,12 @@ class ObjectStore:
         os.rename(tmp, path)  # atomic seal: object visible only when complete
         return Descriptor(object_id, n, path=path)
 
-    def put_serialized(self, object_id: str, payload: bytes) -> Descriptor:
-        """Store an already-serialized envelope (e.g. received over DCN)."""
+    def put_serialized(self, object_id: str, payload) -> Descriptor:
+        """Store an already-serialized envelope (bytes-like, e.g. the
+        preallocated buffer a chunked pull landed in)."""
         if len(payload) <= INLINE_OBJECT_MAX_BYTES:
-            return Descriptor(object_id, len(payload), inline=payload)
+            return Descriptor(object_id, len(payload),
+                              inline=bytes(payload))
         if self._arena is not None:
             buf = self._arena.create(object_id, len(payload))
             if buf is not None:
@@ -146,9 +162,14 @@ class ObjectStore:
         return os.path.join(self._spill_dir, object_id)
 
     def spill_payload(self, object_id: str, payload) -> Descriptor:
-        """Write a serialized envelope to the disk spill dir and return its
+        """Write a serialized envelope to the spill target and return its
         file-backed descriptor (reference: LocalObjectManager::SpillObjects,
-        local_object_manager.h:110)."""
+        local_object_manager.h:110; URI targets ride the storage seam)."""
+        if self._spill_uri is not None:
+            from ray_tpu.util import storage as _storage
+            uri = _storage.uri_join(self._spill_uri, object_id)
+            _storage.write_bytes(uri, bytes(payload))
+            return Descriptor(object_id, len(payload), path=uri)
         path = self._spill_path(object_id)
         tmp = path + ".tmp.%d" % os.getpid()
         with open(tmp, "wb") as f:
@@ -157,9 +178,15 @@ class ObjectStore:
         return Descriptor(object_id, len(payload), path=path)
 
     def purge_spill(self) -> None:
-        """Remove this store's spill dir (store OWNER only — head on
+        """Remove this store's spill target (store OWNER only — head on
         shutdown, daemon on exit; readers must never call this)."""
         shutil.rmtree(self._spill_dir, ignore_errors=True)
+        if self._spill_uri is not None:
+            from ray_tpu.util import storage as _storage
+            try:
+                _storage.delete(self._spill_uri)
+            except Exception:
+                pass
 
     # -- read path ----------------------------------------------------------
 
@@ -170,6 +197,14 @@ class ObjectStore:
         if desc.arena:
             view = self._arena_view(desc)
             return serialization.loads(view)
+        if desc.path is not None and "://" in desc.path:
+            from ray_tpu.util import storage as _storage
+            try:
+                return serialization.loads(_storage.read_bytes(desc.path))
+            except FileNotFoundError:
+                raise ObjectLostError(
+                    f"object {desc.object_id} missing from spill storage "
+                    f"({desc.path})") from None
         with self._lock:
             m = self._maps.get(desc.object_id)
             if m is None:
@@ -210,8 +245,38 @@ class ObjectStore:
             return desc.inline
         if desc.arena:
             return bytes(self._arena_view(desc))
+        if "://" in desc.path:
+            from ray_tpu.util import storage as _storage
+            return _storage.read_bytes(desc.path)
         with open(desc.path, "rb") as f:
             return f.read()
+
+    def raw_view(self, desc: Descriptor):
+        """Zero-copy view of the serialized envelope where possible
+        (arena: pinned view; file: cached mmap) — the serve side of the
+        pull plane chunks from this without materializing the whole
+        payload (reference: object chunks read straight out of plasma,
+        object_buffer_pool.h)."""
+        if desc.inline is not None:
+            return desc.inline
+        if desc.arena:
+            return self._arena_view(desc)
+        if "://" in desc.path:
+            from ray_tpu.util import storage as _storage
+            return _storage.read_bytes(desc.path)
+        with self._lock:
+            m = self._maps.get(desc.object_id)
+            if m is None:
+                try:
+                    with open(desc.path, "rb") as f:
+                        m = mmap.mmap(f.fileno(), desc.size,
+                                      access=mmap.ACCESS_READ)
+                except FileNotFoundError:
+                    raise ObjectLostError(
+                        f"object {desc.object_id} missing from store "
+                        f"({desc.path})") from None
+                self._maps[desc.object_id] = m
+        return memoryview(m)[:desc.size]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -273,10 +338,14 @@ class ObjectStore:
             except BufferError:
                 pass  # live views reference it; the mmap dies with the process
         if desc.path is not None:
-            try:
-                os.unlink(desc.path)
-            except FileNotFoundError:
-                pass
+            if "://" in desc.path:
+                from ray_tpu.util import storage as _storage
+                _storage.delete(desc.path)
+            else:
+                try:
+                    os.unlink(desc.path)
+                except FileNotFoundError:
+                    pass
 
     def close(self) -> None:
         with self._lock:

@@ -308,12 +308,45 @@ class PullRequest:
 @dataclass
 class PullChunk:
     """Chunked reply to PullRequest (object_manager.h:130 HandlePush uses
-    the same chunking; ObjectBufferPool's chunk size analog)."""
+    the same chunking; ObjectBufferPool's chunk size analog). `total`
+    rides the first chunk so the receiver preallocates one buffer
+    instead of accumulating parts + a join copy."""
     req_id: int
     seq: int
     data: bytes
     last: bool = False
     error: str | None = None
+    total: int = -1
+
+
+@dataclass
+class DumpStack:
+    """Head/daemon -> worker: report every thread's Python stack
+    (reference: on-demand py-spy/`ray stack` profiling,
+    dashboard/modules/reporter/profile_manager.py:10-25 — here the
+    worker samples itself via sys._current_frames, no ptrace needed).
+    `worker_id` filters when fanned out through a daemon (None = all)."""
+    req_id: int
+    worker_id: str | None = None
+
+
+@dataclass
+class StackDumpReply:
+    """Worker -> daemon -> head: the formatted stacks."""
+    req_id: int
+    worker_id: str
+    pid: int
+    text: str
+
+
+@dataclass
+class LogBatch:
+    """Daemon -> head (and head -> subscribed drivers): new stdout/stderr
+    lines tailed from one process's log file (reference: log_monitor.py
+    publishing to the driver via GCS pubsub)."""
+    source: str              # e.g. "worker-<id>" | "daemon-<node_id>"
+    node_id: str | None      # None = head host
+    lines: list = None
 
 
 @dataclass

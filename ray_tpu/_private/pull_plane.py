@@ -16,20 +16,36 @@ import threading
 import time
 
 from ray_tpu._private import protocol
+from ray_tpu._private.constants import PULL_CHUNK_BYTES, PULL_TIMEOUT_S
 from ray_tpu.exceptions import ObjectLostError
-
-PULL_CHUNK_BYTES = 1 << 20
-PULL_TIMEOUT_S = 120.0
 
 
 class _PullBuf:
-    """Reassembly buffer for one in-flight chunked pull."""
-    __slots__ = ("parts", "done", "error")
+    """Reassembly buffer for one in-flight chunked pull: preallocated
+    when the first chunk announces the total, else an append list."""
+    __slots__ = ("parts", "data", "offset", "done", "error")
 
     def __init__(self):
         self.parts = []
+        self.data = None       # bytearray once total is known
+        self.offset = 0
         self.done = False
         self.error = None
+
+    def feed(self, msg) -> None:
+        if self.data is None and msg.total >= 0 and not self.parts:
+            self.data = bytearray(msg.total)
+        if self.data is not None:
+            n = len(msg.data)
+            self.data[self.offset:self.offset + n] = msg.data
+            self.offset += n
+        else:
+            self.parts.append(msg.data)
+
+    def payload(self):
+        if self.data is not None:
+            return self.data
+        return b"".join(self.parts)
 
 
 class PullClient:
@@ -52,7 +68,7 @@ class PullClient:
                 buf.error = msg.error
                 buf.done = True
             else:
-                buf.parts.append(msg.data)
+                buf.feed(msg)
                 if msg.last:
                     buf.done = True
             if buf.done:
@@ -65,10 +81,12 @@ class PullClient:
             self._cv.notify_all()
 
     def pull(self, send, oid: str, abort_check=None,
-             timeout: float = PULL_TIMEOUT_S) -> bytes:
+             timeout: float | None = None) -> bytes:
         """Send a PullRequest via `send` and block for the reassembled
         payload. `abort_check()` (optional) is polled while waiting;
         returning a truthy string aborts with that cause."""
+        if timeout is None:
+            timeout = PULL_TIMEOUT_S
         req = next(self._req)
         buf = _PullBuf()
         with self._cv:
@@ -87,12 +105,14 @@ class PullClient:
             self._bufs.pop(req, None)
         if buf.error is not None:
             raise ObjectLostError(f"pull of {oid} failed: {buf.error}")
-        return b"".join(buf.parts)
+        return buf.payload()
 
 
 def serve_pull(send, msg: protocol.PullRequest, payload) -> None:
-    """Stream `payload` (bytes, or an exception/None for failure) back as
-    PullChunks on `send`."""
+    """Stream `payload` back as PullChunks on `send`. `payload` may be a
+    memoryview over the store's own mapping (ObjectStore.raw_view), so a
+    multi-GiB object is never materialized as one extra copy on the
+    serve side; an exception/None streams a failure chunk."""
     if payload is None or isinstance(payload, BaseException):
         send(protocol.PullChunk(
             msg.req_id, 0, b"", last=True,
@@ -104,5 +124,6 @@ def serve_pull(send, msg: protocol.PullRequest, payload) -> None:
     for off in range(0, max(n, 1), PULL_CHUNK_BYTES):
         chunk = bytes(payload[off:off + PULL_CHUNK_BYTES])
         send(protocol.PullChunk(msg.req_id, seq, chunk,
-                                last=off + PULL_CHUNK_BYTES >= n))
+                                last=off + PULL_CHUNK_BYTES >= n,
+                                total=n if seq == 0 else -1))
         seq += 1

@@ -32,6 +32,7 @@ import sys
 import threading
 import time
 
+from ray_tpu._private import constants
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
 from ray_tpu._private.constants import (
@@ -187,11 +188,13 @@ class RuntimeEnvManager:
                     subprocess.run(
                         [sys.executable, "-m", "venv",
                          "--system-site-packages", tmp],
-                        check=True, capture_output=True, timeout=120)
+                        check=True, capture_output=True,
+                        timeout=constants.RUNTIME_ENV_VENV_CREATE_TIMEOUT_S)
                     subprocess.run(
                         [os.path.join(tmp, "bin", "python"), "-m", "pip",
                          "install", "--quiet", "--no-input", *packages],
-                        check=True, capture_output=True, timeout=600)
+                        check=True, capture_output=True,
+                        timeout=constants.RUNTIME_ENV_PIP_INSTALL_TIMEOUT_S)
                 except subprocess.CalledProcessError as e:
                     shutil.rmtree(tmp, ignore_errors=True)
                     raise RuntimeEnvSetupError(

@@ -74,9 +74,21 @@ def propagate_pythonpath(env: dict) -> dict:
     return env
 
 
+def worker_log_file(log_dir: str | None, name: str):
+    """Open `<log_dir>/<name>.log` for append if per-process log capture
+    is on (reference: worker-*.out files under the session dir); None =
+    inherit the parent's stdio."""
+    from ray_tpu._private import config
+    if log_dir is None or not config.get("WORKER_LOG_REDIRECT"):
+        return None
+    os.makedirs(log_dir, exist_ok=True)
+    return open(os.path.join(log_dir, name + ".log"), "ab")
+
+
 def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
                       env: dict, python_exe: str | None = None,
-                      cwd: str | None = None) -> subprocess.Popen:
+                      cwd: str | None = None,
+                      log_dir: str | None = None) -> subprocess.Popen:
     """Exec a worker process that will register at `address`. subprocess
     (not mp.Process) so we control the child env exactly and never inherit
     the parent's TPU runtime handles/locks. `python_exe`/`cwd` come from a
@@ -85,8 +97,14 @@ def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
            "-m", "ray_tpu._private.worker_main", address, worker_id]
     env = propagate_pythonpath(dict(env))
     env["RAY_TPU_AUTHKEY"] = authkey.hex()
-    return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
-                            cwd=cwd)
+    logf = worker_log_file(log_dir, worker_id)   # ids carry their prefix
+    try:
+        return subprocess.Popen(
+            cmd, env=env, stdin=subprocess.DEVNULL, cwd=cwd,
+            stdout=logf or None, stderr=subprocess.STDOUT if logf else None)
+    finally:
+        if logf is not None:
+            logf.close()     # the child holds its own fd now
 
 
 def setup_runtime_env(runtime_env: dict | None, env: dict):

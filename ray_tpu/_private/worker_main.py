@@ -116,6 +116,17 @@ class WorkerRuntime:
                         self.store.delete(msg.desc)
                 except Exception:
                     pass
+            elif isinstance(msg, protocol.DumpStack):
+                self.send(protocol.StackDumpReply(
+                    msg.req_id, self.worker_id, os.getpid(),
+                    _format_stacks()))
+            elif isinstance(msg, protocol.LogBatch):
+                # log_to_driver subscription: another process's output,
+                # prefixed so interleaved sources stay attributable
+                nid = msg.node_id or "head"
+                for ln in msg.lines or ():
+                    print(f"({msg.source}, node={nid}) {ln}",
+                          file=sys.stderr)
             elif isinstance(msg, protocol.KillWorker):
                 self.shutdown = True
                 self.task_queue.put(None)
@@ -220,8 +231,9 @@ class WorkerRuntime:
 
     def _ref_flush_loop(self) -> None:
         from ray_tpu._private import worker as _worker_mod
+        from ray_tpu._private.constants import REF_FLUSH_INTERVAL_S
         while not self.shutdown:
-            time.sleep(0.5)
+            time.sleep(REF_FLUSH_INTERVAL_S)
             _worker_mod._drain_decs()
             self._flush_ref_events()
 
@@ -342,6 +354,18 @@ class WorkerRuntime:
             else:
                 self.run_task(push)
         os._exit(0)
+
+
+def _format_stacks() -> str:
+    """Every thread's Python stack, named (the `ray stack` payload)."""
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, tid)} ---")
+        out.extend(ln.rstrip()
+                   for ln in traceback.format_stack(frame))
+    return "\n".join(out)
 
 
 class _DepFailed(Exception):

@@ -12,6 +12,8 @@ API those frontends consume — the part tooling depends on:
   POST /api/jobs                    {"entrypoint": ...} -> {"job_id": ...}
   GET /api/jobs/<id>                job info
   GET /api/jobs/<id>/logs           captured stdout/stderr
+  GET /api/logs                     log sources (head + every node)
+  GET /api/logs/<source>?lines=N    tail of one process's output
   GET /metrics                      Prometheus text exposition
   GET /api/timeline                 chrome://tracing events
 
@@ -81,6 +83,31 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, type(self).control("timeline"))
             if path == "/api/jobs":
                 return self._send(200, type(self).control("job_list"))
+            if path == "/api/serve/applications":
+                # reference: dashboard/modules/serve/ GET status
+                from ray_tpu import serve as _serve
+                return self._send(200, _serve.status())
+            if path == "/api/stack":
+                # on-demand profiling (reference: reporter profile
+                # endpoints); ?worker=<id> filters
+                from urllib.parse import parse_qs, urlparse
+                q = parse_qs(urlparse(self.path).query)
+                wid = q.get("worker", [None])[0]
+                return self._send(200, type(self).control(
+                    "stack", {"worker_id": wid, "timeout": 5.0}))
+            if path == "/api/logs":
+                return self._send(200, type(self).control("list_logs"))
+            if path.startswith("/api/logs/"):
+                # /api/logs/<source>?lines=N  (source may contain '/':
+                # daemon-shipped entries are "<node_id>/<proc>")
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                source = u.path[len("/api/logs/"):].rstrip("/")
+                n = int(parse_qs(u.query).get("lines", ["200"])[0])
+                lines = type(self).control(
+                    "get_log", {"source": source, "lines": n})
+                return self._send(200, "\n".join(lines) + "\n",
+                                  "text/plain")
             if path.startswith("/api/jobs/"):
                 parts = path.split("/")
                 job_id = parts[3]
@@ -115,14 +142,22 @@ class _Handler(BaseHTTPRequestHandler):
                 job_id = path.split("/")[3]
                 return self._send(
                     200, {"stopped": type(self).control("job_stop", job_id)})
+            if path == "/api/serve/applications":
+                # declarative apply (reference: serve REST deploy,
+                # dashboard/modules/serve/); body = schema.py config
+                from ray_tpu import serve as _serve
+                return self._send(200, _serve.apply_config(body))
             return self._send(404, {"error": f"no route {path}"})
         except Exception as e:
             return self._send(500, {"error": repr(e)})
 
 
-def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> int:
+def start_dashboard(port: int = 8265, host: str | None = None) -> int:
     """Start (or return) the dashboard server; returns the bound port."""
     global _server
+    if host is None:
+        from ray_tpu._private.constants import DASHBOARD_BIND_HOST
+        host = DASHBOARD_BIND_HOST
     if _server is not None:
         return _server.server_address[1]
     from ray_tpu._private import worker as _worker

@@ -33,8 +33,10 @@ import ray_tpu
 from ray_tpu.data._internal import plan as plan_mod
 from ray_tpu.data.block import BlockAccessor, BlockMetadata, concat_blocks
 
-_DEFAULT_IN_FLIGHT = 8
-_DEFAULT_BYTES_IN_FLIGHT = 128 * 1024 * 1024
+from ray_tpu._private.constants import (
+    DATA_BYTES_IN_FLIGHT as _DEFAULT_BYTES_IN_FLIGHT,
+    DATA_MAX_TASKS_IN_FLIGHT as _DEFAULT_IN_FLIGHT,
+)
 
 
 def _item_bytes(item, ctx) -> int:

@@ -7,7 +7,9 @@ nothing are worse than missing ones.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+from ray_tpu._private.constants import DATA_BLOCK_SIZE_ESTIMATE
 
 
 @dataclass
@@ -18,9 +20,10 @@ class DataContext:
     # be outstanding per operator. The byte budget is what keeps a
     # pipeline whose working set exceeds the shm arena from overcommitting
     # it (blocks of unknown size count as default_block_size_estimate).
-    max_tasks_per_operator: int | None = None    # None = default (8)
-    max_bytes_in_flight: int | None = None       # None = default (128 MiB)
-    default_block_size_estimate: int = 8 * 1024 * 1024
+    max_tasks_per_operator: int | None = None    # None = config default
+    max_bytes_in_flight: int | None = None       # None = config default
+    default_block_size_estimate: int = field(
+        default_factory=lambda: DATA_BLOCK_SIZE_ESTIMATE)
     # Default parallelism for read_*/from_* when the call passes -1.
     read_parallelism: int = -1                   # -1 = #CPUs
     enable_operator_fusion: bool = True

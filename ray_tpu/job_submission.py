@@ -137,7 +137,8 @@ class JobManager:
                     # process gone and no rc recorded: crashed
                     self._finalize(info.job_id, None)
                     return
-                time.sleep(0.5)
+                from ray_tpu._private.constants import JOB_ADOPT_POLL_S
+                time.sleep(JOB_ADOPT_POLL_S)
         threading.Thread(target=watch, daemon=True).start()
 
     def _read_rc(self, job_id: str) -> Optional[int]:
@@ -169,6 +170,9 @@ class JobManager:
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         env["RAY_TPU_JOB_ID"] = job_id
+        # job drivers stream their workers' output into the job log by
+        # default (reference: jobs run with log_to_driver on)
+        env.setdefault("RAY_TPU_LOG_TO_DRIVER", "1")
         # durable launch intent BEFORE Popen: recovery must never re-exec
         # a maybe-started job (exactly-once on the pessimistic side)
         with self._lock:

@@ -51,6 +51,17 @@ class DQNConfig(AlgorithmConfig):
         self.num_envs_per_worker = 32
         self.model = {"fcnet_hiddens": (64, 64),
                       "fcnet_activation": "relu"}
+        # External experience source instead of the in-graph sampler: a
+        # callable returning a SampleBatch-like dict per iteration, or an
+        # object with .next() (JsonReader, PolicyServerInput.next_batch —
+        # the reference's policy_server_input.py client-server RL path).
+        # `env` is then only consulted for observation/action spaces.
+        self.input_ = None
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
 
 
 class DQN(Algorithm):
@@ -61,10 +72,11 @@ class DQN(Algorithm):
         cfg = self.algo_config
         from ray_tpu.rllib.env.jax_env import make_env
         self.env = make_env(cfg.env, cfg.env_config)
-        if not is_jax_env(self.env):
+        if cfg.input_ is None and not is_jax_env(self.env):
             raise ValueError(
                 "DQN v1 requires a JaxEnv (in-graph sampler); wrap python "
-                "envs or use PPO's WorkerSet path")
+                "envs, use PPO's WorkerSet path, or feed external "
+                "experience via offline_data(input_=...)")
         self.module = QModule(self.env.observation_space,
                               self.env.action_space, cfg.model)
         self._rng = jax.random.PRNGKey(cfg.seed)
@@ -73,7 +85,9 @@ class DQN(Algorithm):
         self.build_learner()
 
     def build_learner(self) -> None:
+        import threading
         cfg = self.algo_config
+        self._act_lock = threading.Lock()
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
@@ -86,14 +100,15 @@ class DQN(Algorithm):
         self._steps_sampled = 0
         self._num_updates = 0
         self._last_target_update = 0
-        self._env_keys = jax.random.split(
-            self.next_key(), cfg.num_envs_per_worker)
-        state, obs = jax.vmap(self.env.reset)(self._env_keys)
-        self._carry = {"env_state": state, "obs": obs,
-                       "ep_ret": jnp.zeros(cfg.num_envs_per_worker),
-                       "ep_len": jnp.zeros(cfg.num_envs_per_worker,
-                                           jnp.int32)}
-        self._sample_fn = jax.jit(self._sample_impl)
+        if cfg.input_ is None:
+            self._env_keys = jax.random.split(
+                self.next_key(), cfg.num_envs_per_worker)
+            state, obs = jax.vmap(self.env.reset)(self._env_keys)
+            self._carry = {"env_state": state, "obs": obs,
+                           "ep_ret": jnp.zeros(cfg.num_envs_per_worker),
+                           "ep_len": jnp.zeros(cfg.num_envs_per_worker,
+                                               jnp.int32)}
+            self._sample_fn = jax.jit(self._sample_impl)
         self._update_fn = jax.jit(self._td_update)
         self._ep_returns: list = []
         self._ep_lens: list = []
@@ -162,30 +177,82 @@ class DQN(Algorithm):
 
     # ---------------------------------------------------------------------
 
+    def compute_single_action(self, obs, explore: bool = False,
+                              epsilon: float | None = None):
+        """Epsilon-greedy single action (QModule's knob is epsilon, not
+        the base class's explore flag); jitted once — this is the hot
+        call when serving external PolicyClients, which invoke it from
+        one thread PER CONNECTION, so RNG splitting and lazy init are
+        lock-guarded."""
+        with self._act_lock:
+            if not hasattr(self, "_act_fn"):
+                self._act_fn = jax.jit(
+                    lambda p, o, k, e: self.module.compute_actions(
+                        p, o, k, epsilon=e)[0])
+            key = self.next_key()
+            params = self.params
+        eps = epsilon if epsilon is not None else (
+            self._epsilon() if explore else 0.0)
+        a = self._act_fn(params, jnp.asarray(obs)[None], key,
+                         jnp.asarray(eps))
+        return int(np.asarray(a)[0])
+
     def _epsilon(self) -> float:
         cfg = self.algo_config
         frac = min(1.0, self._steps_sampled / max(cfg.epsilon_timesteps, 1))
         return cfg.epsilon_initial + frac * (cfg.epsilon_final
                                              - cfg.epsilon_initial)
 
+    def _ingest_external(self) -> None:
+        """Pull one batch from the external input seam (policy server /
+        offline reader / callable) into the replay buffer."""
+        src = self.algo_config.input_
+        batch = src() if callable(src) else src.next()
+        flat = {k: np.asarray(v) for k, v in batch.items()}
+        self.buffer.add_batch(flat)
+        n = len(flat[sb.REWARDS])
+        self._steps_sampled += n
+        # Episode stats from done boundaries. External fragments may
+        # start/end mid-episode (JsonReader shards), so the running
+        # accumulators carry across batches instead of assuming each
+        # batch is episode-aligned.
+        dones = flat.get(sb.DONES)
+        if dones is not None:
+            if not hasattr(self, "_ext_ret"):
+                self._ext_ret, self._ext_len = 0.0, 0
+            rewards = np.asarray(flat[sb.REWARDS], np.float64)
+            for r, d in zip(rewards, np.asarray(dones, bool)):
+                self._ext_ret += float(r)
+                self._ext_len += 1
+                if d:
+                    self._ep_returns.append(self._ext_ret)
+                    self._ep_lens.append(self._ext_len)
+                    self._ext_ret, self._ext_len = 0.0, 0
+            self._ep_returns = self._ep_returns[-100:]
+            self._ep_lens = self._ep_lens[-100:]
+
     def training_step(self) -> dict:
         cfg = self.algo_config
         losses = []
-        # sample until one update's worth of new experience is in
-        self._carry, traj = self._sample_fn(
-            self.params, self._carry, self.next_key(),
-            jnp.asarray(self._epsilon()))
-        host = {k: np.asarray(v) for k, v in traj.items()}
-        rets = host.pop("episode_return").ravel()
-        lens = host.pop("episode_len").ravel()
-        fin = ~np.isnan(rets)
-        self._ep_returns.extend(rets[fin].tolist())
-        self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
-        self._ep_returns = self._ep_returns[-100:]
-        self._ep_lens = self._ep_lens[-100:]
-        flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in host.items()}
-        self.buffer.add_batch(flat)
-        self._steps_sampled += len(flat[sb.REWARDS])
+        if cfg.input_ is not None:
+            self._ingest_external()
+        else:
+            # sample until one update's worth of new experience is in
+            self._carry, traj = self._sample_fn(
+                self.params, self._carry, self.next_key(),
+                jnp.asarray(self._epsilon()))
+            host = {k: np.asarray(v) for k, v in traj.items()}
+            rets = host.pop("episode_return").ravel()
+            lens = host.pop("episode_len").ravel()
+            fin = ~np.isnan(rets)
+            self._ep_returns.extend(rets[fin].tolist())
+            self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
+            self._ep_returns = self._ep_returns[-100:]
+            self._ep_lens = self._ep_lens[-100:]
+            flat = {k: v.reshape((-1,) + v.shape[2:])
+                    for k, v in host.items()}
+            self.buffer.add_batch(flat)
+            self._steps_sampled += len(flat[sb.REWARDS])
 
         if len(self.buffer) >= cfg.learning_starts:
             for _ in range(cfg.n_updates_per_iter):

@@ -1,0 +1,170 @@
+"""Connectors: composable obs/action transform pipelines.
+
+Counterpart of the reference's `rllib/connectors/connector.py` (+
+`agent/`, `action/` subpackages): the glue between raw env I/O and the
+policy is a PIPELINE of small, stateful, serializable transforms rather
+than code baked into each policy. Obs connectors run env→module; action
+connectors run module→env. Every transform here uses pure array ops, so
+the same pipeline works on the eager rollout path (PythonEnvRunner,
+PolicyServerInput) AND inside a jitted in-graph sampler.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class Connector:
+    """One transform. `__call__` maps data -> data; `state`/`set_state`
+    carry whatever must sync from learner to rollout workers (reference:
+    Connector.serialize/deserialize)."""
+
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: List[Connector] | None = None):
+        self.connectors = list(connectors or [])
+
+    def __call__(self, x):
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def append(self, c: Connector) -> "ConnectorPipeline":
+        self.connectors.append(c)
+        return self
+
+    def state(self) -> dict:
+        return {i: c.state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: dict) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+    def __repr__(self):
+        return f"ConnectorPipeline({self.connectors})"
+
+
+# -- obs connectors ----------------------------------------------------------
+
+class FlattenObs(Connector):
+    """Dict/tuple/nd observations -> flat f32 vector (reference:
+    connectors/agent/obs_preproc.py flattening preprocessor)."""
+
+    def __call__(self, obs):
+        if isinstance(obs, dict):
+            parts = [np.asarray(obs[k], np.float32).reshape(-1)
+                     for k in sorted(obs)]
+            return np.concatenate(parts)
+        if isinstance(obs, (tuple, list)):
+            return np.concatenate(
+                [np.asarray(o, np.float32).reshape(-1) for o in obs])
+        return np.asarray(obs, np.float32).reshape(-1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs):
+        import jax.numpy as jnp
+        xp = jnp if not isinstance(obs, np.ndarray) else np
+        return xp.clip(obs, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std filter (reference: MeanStdFilter connector).
+    Workers apply a FROZEN copy synced from the learner via
+    state()/set_state(); the learner side calls update()."""
+
+    def __init__(self, shape=None, eps: float = 1e-8):
+        self.count = 0.0
+        self.mean = None
+        self.m2 = None
+        self.eps = eps
+
+    def update(self, obs) -> None:
+        x = np.asarray(obs, np.float64)
+        if x.ndim == 1:
+            x = x[None]
+        for row in x:
+            self.count += 1.0
+            if self.mean is None:
+                self.mean = row.copy()
+                self.m2 = np.zeros_like(row)
+                continue
+            delta = row - self.mean
+            self.mean += delta / self.count
+            self.m2 += delta * (row - self.mean)
+
+    def std(self):
+        if self.mean is None or self.count < 2:
+            return None
+        return np.sqrt(self.m2 / (self.count - 1)) + self.eps
+
+    def __call__(self, obs):
+        std = self.std()
+        if std is None:
+            return obs
+        return (np.asarray(obs, np.float32) - self.mean.astype(np.float32)) \
+            / std.astype(np.float32)
+
+    def state(self) -> dict:
+        return {"count": self.count,
+                "mean": None if self.mean is None else self.mean.copy(),
+                "m2": None if self.m2 is None else self.m2.copy()}
+
+    def set_state(self, state: dict) -> None:
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+
+# -- action connectors -------------------------------------------------------
+
+class ClipActions(Connector):
+    """Clip continuous actions into the env's Box bounds (reference:
+    connectors/action/clip.py)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, action):
+        import jax.numpy as jnp
+        xp = jnp if not isinstance(action, np.ndarray) else np
+        return xp.clip(action, self.low, self.high)
+
+
+class UnsquashActions(Connector):
+    """Map tanh-squashed [-1, 1] policy outputs onto the Box bounds
+    (reference: connectors/action/scale.py unsquash)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, action):
+        return self.low + (np.asarray(action, np.float32) + 1.0) * 0.5 \
+            * (self.high - self.low)
+
+
+def default_action_pipeline(action_space) -> ConnectorPipeline:
+    """The pipeline the reference builds by default: clip continuous
+    actions to the space, pass discrete through."""
+    from ray_tpu.rllib.env.spaces import Box
+    pipe = ConnectorPipeline()
+    if isinstance(action_space, Box):
+        pipe.append(ClipActions(action_space.low, action_space.high))
+    return pipe

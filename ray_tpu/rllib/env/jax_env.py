@@ -167,6 +167,88 @@ class Pendulum(JaxEnv):
         return new, obs, -cost, done, {}
 
 
+class Acrobot(JaxEnv):
+    """Acrobot-v1: swing a two-link pendulum's tip above the bar — a
+    genuinely harder task than CartPole (long horizon, sparse -1/step
+    reward, needs energy pumping). Dynamics follow the Sutton & Barto
+    formulation used by the standard Gym env, RK4-integrated; written
+    from the published equations in jnp."""
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 500))
+        self.observation_space = Box(-jnp.inf, jnp.inf, (6,))
+        self.action_space = Discrete(3)
+
+    _M1 = _M2 = 1.0       # link masses
+    _L1 = 1.0             # link 1 length
+    _LC1 = _LC2 = 0.5     # centers of mass
+    _I1 = _I2 = 1.0       # moments of inertia
+    _G = 9.8
+    _DT = 0.2
+    _MAX_V1 = 4 * jnp.pi
+    _MAX_V2 = 9 * jnp.pi
+
+    def _obs(self, s):
+        t1, t2, d1, d2 = s[0], s[1], s[2], s[3]
+        return jnp.stack([jnp.cos(t1), jnp.sin(t1),
+                          jnp.cos(t2), jnp.sin(t2), d1, d2])
+
+    def reset(self, key):
+        s = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
+        state = {"s": s, "t": jnp.asarray(0, jnp.int32)}
+        return state, self._obs(s)
+
+    def _dsdt(self, s_aug):
+        m1, m2, l1 = self._M1, self._M2, self._L1
+        lc1, lc2, i1, i2, g = self._LC1, self._LC2, self._I1, self._I2, \
+            self._G
+        t1, t2, dt1, dt2, a = (s_aug[0], s_aug[1], s_aug[2], s_aug[3],
+                               s_aug[4])
+        d1 = (m1 * lc1 ** 2 + m2 * (l1 ** 2 + lc2 ** 2
+                                    + 2 * l1 * lc2 * jnp.cos(t2))
+              + i1 + i2)
+        d2 = m2 * (lc2 ** 2 + l1 * lc2 * jnp.cos(t2)) + i2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2.0)
+        phi1 = (-m2 * l1 * lc2 * dt2 ** 2 * jnp.sin(t2)
+                - 2 * m2 * l1 * lc2 * dt2 * dt1 * jnp.sin(t2)
+                + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2.0)
+                + phi2)
+        ddt2 = ((a + d2 / d1 * phi1
+                 - m2 * l1 * lc2 * dt1 ** 2 * jnp.sin(t2) - phi2)
+                / (m2 * lc2 ** 2 + i2 - d2 ** 2 / d1))
+        ddt1 = -(d2 * ddt2 + phi1) / d1
+        return jnp.stack([dt1, dt2, ddt1, ddt2, jnp.zeros_like(a)])
+
+    def _rk4(self, s, torque):
+        y0 = jnp.concatenate([s, torque[None]])
+        dt = self._DT
+        k1 = self._dsdt(y0)
+        k2 = self._dsdt(y0 + dt / 2 * k1)
+        k3 = self._dsdt(y0 + dt / 2 * k2)
+        k4 = self._dsdt(y0 + dt * k3)
+        y = y0 + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+        return y[:4]
+
+    def step(self, state, action, key):
+        torque = jnp.asarray(action, jnp.float32) - 1.0   # {-1, 0, +1}
+        s = self._rk4(state["s"], torque)
+        wrap = lambda x: ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi  # noqa:E731
+        s = jnp.stack([
+            wrap(s[0]), wrap(s[1]),
+            jnp.clip(s[2], -self._MAX_V1, self._MAX_V1),
+            jnp.clip(s[3], -self._MAX_V2, self._MAX_V2)])
+        t = state["t"] + 1
+        solved = -jnp.cos(s[0]) - jnp.cos(s[1] + s[0]) > 1.0
+        done = solved | (t >= self.max_steps)
+        reward = jnp.where(solved, 0.0, -1.0)
+        reset_state, reset_obs = self.reset(key)
+        new_s = jnp.where(done, reset_state["s"], s)
+        new_t = jnp.where(done, reset_state["t"], t)
+        obs = jnp.where(done, reset_obs, self._obs(s))
+        return ({"s": new_s, "t": new_t}, obs, reward, done, {})
+
+
 class EagerJaxEnv:
     """Gym-API adapter over a JaxEnv, for actor-based rollout workers
     (the reference's RolloutWorker steps gym envs eagerly; this lets the
@@ -197,3 +279,4 @@ class EagerJaxEnv:
 
 register_env("CartPole-v1", lambda cfg: CartPole(cfg))
 register_env("Pendulum-v1", lambda cfg: Pendulum(cfg))
+register_env("Acrobot-v1", lambda cfg: Acrobot(cfg))

@@ -1,0 +1,228 @@
+"""External-env RL: policy server + client.
+
+Counterpart of the reference's client-server pattern
+(`rllib/env/policy_server_input.py` PolicyServerInput +
+`rllib/env/policy_client.py` PolicyClient): the SIMULATOR runs outside
+the cluster (a game, a robot, a web service), connects over TCP, asks
+the server for actions, and logs rewards; the server turns completed
+episodes into `SampleBatch`es that feed an off-policy learner via its
+``input_fn`` seam (e.g. ``DQNConfig.offline(input_=server.next_batch)``).
+
+Transport rides `multiprocessing.connection` with an HMAC authkey, like
+every other channel in this framework.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import netaddr
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch, concat_samples)
+
+
+class _Episode:
+    __slots__ = ("obs", "actions", "rewards", "last_obs")
+
+    def __init__(self):
+        self.obs: List = []
+        self.actions: List = []
+        self.rewards: List = []
+        self.last_obs = None
+
+
+class PolicyServerInput:
+    """Serve actions to external PolicyClients; collect their experience.
+
+    `compute_action(obs)` must return a single action for a single raw
+    observation (typically `algo.compute_single_action`). Obs/action
+    connector pipelines (ray_tpu.rllib.connectors) are applied server-
+    side, so external simulators send RAW observations."""
+
+    def __init__(self, compute_action, address=("127.0.0.1", 0),
+                 authkey: bytes | None = None,
+                 obs_connectors=None, action_connectors=None):
+        self.compute_action = compute_action
+        self.authkey = authkey or os.urandom(16)
+        self.obs_connectors = obs_connectors
+        self.action_connectors = action_connectors
+        self._listener = netaddr.listener(address, self.authkey)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._episodes: Dict[str, _Episode] = {}
+        self._complete: List[SampleBatch] = []
+        self._steps_ready = 0
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="policy-server-accept").start()
+
+    # -- wiring ---------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return netaddr.bound_address(self._listener)
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if self._stop:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        while not self._stop:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            try:
+                reply = self._handle(*msg)
+            except Exception as e:     # protocol error -> tell the client
+                reply = ("error", repr(e))
+            try:
+                conn.send(reply)
+            except (OSError, ValueError):
+                return
+
+    # -- protocol -------------------------------------------------------
+
+    def _handle(self, verb, *args):
+        if verb == "start_episode":
+            eid = uuid.uuid4().hex[:12]
+            with self._lock:
+                self._episodes[eid] = _Episode()
+            return ("ok", eid)
+        if verb == "get_action":
+            eid, obs = args
+            if self.obs_connectors is not None:
+                obs = self.obs_connectors(obs)
+            action = self.compute_action(obs)
+            if self.action_connectors is not None:
+                action = self.action_connectors(action)
+            with self._lock:
+                ep = self._episodes[eid]
+                ep.obs.append(np.asarray(obs))
+                ep.actions.append(np.asarray(action))
+            return ("ok", action)
+        if verb == "log_action":
+            # client-side (off-policy) action, e.g. a human or legacy
+            # controller driving while we record
+            eid, obs, action = args
+            if self.obs_connectors is not None:
+                obs = self.obs_connectors(obs)
+            with self._lock:
+                ep = self._episodes[eid]
+                ep.obs.append(np.asarray(obs))
+                ep.actions.append(np.asarray(action))
+            return ("ok", None)
+        if verb == "log_returns":
+            eid, reward = args
+            with self._lock:
+                self._episodes[eid].rewards.append(float(reward))
+            return ("ok", None)
+        if verb == "end_episode":
+            eid, last_obs = args
+            if self.obs_connectors is not None and last_obs is not None:
+                last_obs = self.obs_connectors(last_obs)
+            with self._cv:
+                ep = self._episodes.pop(eid)
+                ep.last_obs = last_obs
+                batch = self._episode_to_batch(ep)
+                if batch is not None:
+                    self._complete.append(batch)
+                    self._steps_ready += len(batch)
+                    self._cv.notify_all()
+            return ("ok", None)
+        raise ValueError(f"unknown verb {verb!r}")
+
+    @staticmethod
+    def _episode_to_batch(ep: _Episode) -> Optional[SampleBatch]:
+        n = min(len(ep.obs), len(ep.actions), len(ep.rewards))
+        if n == 0:
+            return None
+        obs = np.stack(ep.obs[:n])
+        nxt = list(ep.obs[1:n])
+        nxt.append(np.asarray(ep.last_obs) if ep.last_obs is not None
+                   else ep.obs[n - 1])
+        dones = np.zeros(n, bool)
+        dones[-1] = True
+        return SampleBatch({
+            OBS: obs.astype(np.float32),
+            ACTIONS: np.stack(ep.actions[:n]),
+            REWARDS: np.asarray(ep.rewards[:n], np.float32),
+            NEXT_OBS: np.stack(nxt).astype(np.float32),
+            DONES: dones,
+        })
+
+    # -- learner-side ingestion ----------------------------------------
+
+    def next_batch(self, min_steps: int = 1,
+                   timeout: float = 60.0) -> SampleBatch:
+        """Block until >= min_steps of external experience accumulated;
+        returns it all as one batch (the algorithm's input_fn seam)."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._steps_ready < min_steps:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(
+                        f"policy server collected {self._steps_ready}/"
+                        f"{min_steps} steps within {timeout}s")
+                self._cv.wait(min(rem, 0.5))
+            batches, self._complete = self._complete, []
+            self._steps_ready = 0
+        return concat_samples(batches)
+
+
+class PolicyClient:
+    """External-simulator side (reference: rllib/env/policy_client.py)."""
+
+    def __init__(self, address: str, authkey: bytes):
+        self._conn = netaddr.client(address, authkey)
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            self._conn.send(msg)
+            status, payload = self._conn.recv()
+        if status == "error":
+            raise RuntimeError(f"policy server error: {payload}")
+        return payload
+
+    def start_episode(self) -> str:
+        return self._call("start_episode")
+
+    def get_action(self, episode_id: str, obs):
+        return self._call("get_action", episode_id, obs)
+
+    def log_action(self, episode_id: str, obs, action) -> None:
+        self._call("log_action", episode_id, obs, action)
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._call("log_returns", episode_id, reward)
+
+    def end_episode(self, episode_id: str, obs=None) -> None:
+        self._call("end_episode", episode_id, obs)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass

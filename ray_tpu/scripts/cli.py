@@ -17,8 +17,10 @@ import sys
 import time
 
 
-def _attach(args):
-    from ray_tpu._private.attach import AttachClient, find_sessions
+def _resolve_session(args) -> str:
+    """--session, else the newest live session on this host (exit 1 if
+    none)."""
+    from ray_tpu._private.attach import find_sessions
     session = args.session
     if session is None:
         sessions = find_sessions()
@@ -26,7 +28,12 @@ def _attach(args):
             print("no live ray_tpu session found", file=sys.stderr)
             sys.exit(1)
         session = sessions[0]
-    return AttachClient(session)
+    return session
+
+
+def _attach(args):
+    from ray_tpu._private.attach import AttachClient
+    return AttachClient(_resolve_session(args))
 
 
 def _print(obj):
@@ -79,6 +86,35 @@ def cmd_timeline(args):
         json.dump(events, f)
     print(f"wrote {len(events)} events to {args.output} "
           "(open in chrome://tracing or ui.perfetto.dev)")
+
+
+def cmd_stack(args):
+    """`ray_tpu stack [WORKER_ID]` — every worker's Python stacks
+    (reference: `ray stack`, scripts.py:1786)."""
+    c = _attach(args)
+    dumps = c.control("stack", {"worker_id": args.worker_id,
+                                "timeout": args.timeout},
+                      timeout=args.timeout + 30)
+    if not dumps:
+        print("no stacks collected (no matching live workers?)")
+        return
+    for wid, d in sorted(dumps.items()):
+        print(f"===== {wid} (pid {d['pid']}) =====")
+        print(d["stacks"])
+        print()
+
+
+def cmd_logs(args):
+    """`ray_tpu logs [SOURCE]` — list log sources or tail one
+    (reference: `ray logs`, dashboard log module)."""
+    c = _attach(args)
+    if args.source is None:
+        for row in c.control("list_logs"):
+            print(f"{row['source']}\t{row['lines']} lines")
+    else:
+        for ln in c.control("get_log", {"source": args.source,
+                                        "lines": args.lines}):
+            print(ln)
 
 
 def cmd_metrics(args):
@@ -216,6 +252,20 @@ def cmd_stop(args):
             print(f"could not stop {d}: {e}", file=sys.stderr)
 
 
+def cmd_serve(args):
+    """`ray_tpu serve apply -f config.yaml` / `ray_tpu serve status` —
+    the declarative deploy path (reference: `serve deploy`/`serve
+    status` CLIs over serve/schema.py). Runs in-process as a client
+    driver of the target session."""
+    import ray_tpu
+    ray_tpu.init(address=_resolve_session(args))
+    from ray_tpu import serve
+    if args.serve_cmd == "apply":
+        _print(serve.apply_config(args.file))
+    elif args.serve_cmd == "status":
+        _print(serve.status())
+
+
 def cmd_config(args):
     """`ray_tpu config list`: print the typed option table with effective
     values (reference: the RAY_CONFIG table, ray_config_def.h)."""
@@ -328,6 +378,16 @@ def main(argv=None):
 
     sub.add_parser("metrics").set_defaults(fn=cmd_metrics)
 
+    stk = sub.add_parser("stack")
+    stk.add_argument("worker_id", nargs="?", default=None)
+    stk.add_argument("--timeout", type=float, default=5.0)
+    stk.set_defaults(fn=cmd_stack)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("source", nargs="?", default=None)
+    lg.add_argument("--lines", type=int, default=200)
+    lg.set_defaults(fn=cmd_logs)
+
     jp = sub.add_parser("job")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)
     js = jsub.add_parser("submit")
@@ -340,6 +400,13 @@ def main(argv=None):
     jsub.add_parser("list")
     jp.set_defaults(fn=cmd_job)
 
+    sv = sub.add_parser("serve")
+    svsub = sv.add_subparsers(dest="serve_cmd", required=True)
+    sva = svsub.add_parser("apply")
+    sva.add_argument("-f", "--file", required=True)
+    svsub.add_parser("status")
+    sv.set_defaults(fn=cmd_serve)
+
     mb = sub.add_parser("microbenchmark")
     mb.add_argument("--num-cpus", type=int, default=4)
     mb.set_defaults(fn=cmd_microbenchmark)
@@ -350,7 +417,15 @@ def main(argv=None):
     cp.set_defaults(fn=cmd_config)
 
     args = p.parse_args(argv)
-    args.fn(args)
+    try:
+        args.fn(args)
+    except BrokenPipeError:
+        # downstream pager/grep closed the pipe; standard CLI etiquette
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+        sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -21,10 +21,14 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import StreamingResponse
+from ray_tpu.serve.schema import apply_config, build_app_from_config
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
     "shutdown", "delete", "set_route", "get_deployment_handle",
     "DeploymentHandle", "batch", "Request", "StreamingResponse",
+    "multiplexed", "get_multiplexed_model_id", "apply_config",
+    "build_app_from_config",
 ]

@@ -164,29 +164,74 @@ def run(app: Application, *, name: str = "default",
     return handle
 
 
+_node_proxies: dict = {}
+
+
 def start(*, http_options: Optional[dict] = None):
-    """Start the HTTP proxy (reference: serve.start creates per-node
-    HTTPProxyActors; single-node here)."""
+    """Start HTTP ingress (reference: serve.start, api.py:62). With
+    ``http_options={"location": "EveryNode"}`` one proxy actor runs on
+    EVERY alive node, pinned by node affinity — the reference's
+    per-node HTTPProxyActor layout (`_private/http_proxy.py:858`) for
+    multi-host clusters where a load balancer fronts all hosts. The
+    default ("HeadOnly") keeps one proxy."""
     global _http_proxy
     from ray_tpu.serve.controller import get_controller
     from ray_tpu.serve.http_proxy import HTTPProxy
     get_controller()
+    opts = dict(http_options or {})
+    from ray_tpu._private.constants import SERVE_HTTP_HOST, SERVE_HTTP_PORT
+    host = opts.get("host", SERVE_HTTP_HOST)
+    port = opts.get("port", SERVE_HTTP_PORT)
     if _http_proxy is None:
-        opts = dict(http_options or {})
         actor_cls = ray_tpu.remote(
             num_cpus=0.1, max_concurrency=32,
             name="SERVE_HTTP_PROXY")(HTTPProxy)
-        _http_proxy = actor_cls.remote(opts.get("host", "127.0.0.1"),
-                                       opts.get("port", 8000))
+        _http_proxy = actor_cls.remote(host, port)
         ray_tpu.get(_http_proxy.ready.remote(), timeout=60)
+    if opts.get("location") == "EveryNode":
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+        client = ray_tpu._worker.get_client()
+        for n in client.control("list_nodes"):
+            nid = n["node_id"]
+            if not n.get("alive") or n.get("head") or nid in _node_proxies:
+                continue
+            cls = ray_tpu.remote(
+                num_cpus=0.1, max_concurrency=32,
+                name=f"SERVE_HTTP_PROXY_{nid}",
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid, soft=False))(HTTPProxy)
+            # port=0 on worker nodes in single-machine tests; real pods
+            # pass the same fixed port per host
+            proxy = cls.remote(host, opts.get("worker_port", port))
+            ray_tpu.get(proxy.ready.remote(), timeout=60)
+            _node_proxies[nid] = proxy
     return _http_proxy
+
+
+def proxy_endpoints() -> dict:
+    """{node_id: {"host", "port"}} for every running proxy (the list a
+    load balancer would front)."""
+    out = {}
+    if _http_proxy is not None:
+        out["head"] = ray_tpu.get(_http_proxy.ready.remote(), timeout=30)
+    for nid, proxy in _node_proxies.items():
+        try:
+            out[nid] = ray_tpu.get(proxy.ready.remote(), timeout=30)
+        except Exception:
+            pass
+    return out
 
 
 def set_route(route_prefix: str, deployment_name: str,
               app_name: str = "default"):
-    """Register an HTTP route on the proxy."""
+    """Register an HTTP route on every running proxy."""
     proxy = start()
     ray_tpu.get(proxy.set_route.remote(route_prefix, deployment_name,
+                                       app_name), timeout=30)
+    for p in _node_proxies.values():
+        ray_tpu.get(p.set_route.remote(route_prefix, deployment_name,
                                        app_name), timeout=30)
 
 
@@ -210,6 +255,13 @@ def shutdown():
     global _http_proxy
     from ray_tpu import exceptions as _exc
     from ray_tpu.serve.controller import CONTROLLER_NAME
+    for proxy in list(_node_proxies.values()):
+        try:
+            ray_tpu.get(proxy.stop.remote(), timeout=10)
+            ray_tpu.kill(proxy)
+        except _exc.RayTpuError:
+            pass
+    _node_proxies.clear()
     if _http_proxy is not None:
         try:
             ray_tpu.get(_http_proxy.stop.remote(), timeout=10)

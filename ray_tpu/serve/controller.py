@@ -26,7 +26,11 @@ from ray_tpu import exceptions as _exc
 logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
-_RECONCILE_PERIOD_S = 1.0
+from ray_tpu._private.constants import (
+    SERVE_DOWNSCALE_DELAY_S,
+    SERVE_RECONCILE_PERIOD_S as _RECONCILE_PERIOD_S,
+    SERVE_STATS_TIMEOUT_S,
+)
 
 
 class _DeploymentState:
@@ -214,7 +218,8 @@ class ServeController:
         if st.autoscaling and alive:
             try:
                 replica_stats = ray_tpu.get(
-                    [r.stats.remote() for r in alive], timeout=10)
+                    [r.stats.remote() for r in alive],
+                    timeout=SERVE_STATS_TIMEOUT_S)
                 total_inflight = sum(s["inflight"] for s in replica_stats)
                 target_per = st.autoscaling.get(
                     "target_num_ongoing_requests_per_replica", 1.0)
@@ -227,7 +232,8 @@ class ServeController:
                     st.target_num = desired
                     st._downscale_candidate_since = None
                 else:
-                    delay = st.autoscaling.get("downscale_delay_s", 30)
+                    delay = st.autoscaling.get("downscale_delay_s",
+                                               SERVE_DOWNSCALE_DELAY_S)
                     now = time.time()
                     if st._downscale_candidate_since is None:
                         st._downscale_candidate_since = now

@@ -18,7 +18,23 @@ from typing import List, Optional
 import ray_tpu
 from ray_tpu import exceptions as _exc
 
-_REFRESH_PERIOD_S = 2.0
+from ray_tpu._private.constants import (
+    SERVE_HANDLE_REFRESH_S as _REFRESH_PERIOD_S,
+)
+
+
+class _RouterState:
+    """Replica view + client-side load tracking, shared by a handle and
+    every option-carrying view derived from it (options() must not fork
+    the counters, or power-of-two routing runs on partial loads)."""
+
+    def __init__(self):
+        self.replicas: List = []
+        # actor_id -> list of outstanding ObjectRefs (pruned at pick)
+        self.outstanding: dict = {}
+        self.last_refresh = 0.0
+        self.lock = threading.Lock()
+        self.version = -1
 
 
 class DeploymentHandle:
@@ -26,19 +42,66 @@ class DeploymentHandle:
     # resolves its ObjectRefs can't grow the per-replica list unboundedly
     _MAX_TRACKED = 64
 
-    def __init__(self, deployment_name: str, app_name: str = "default"):
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 multiplexed_model_id: str = "", _router=None):
         self.deployment_name = deployment_name
         self.app_name = app_name
-        self._replicas: List = []
-        # actor_id -> list of outstanding ObjectRefs (pruned at pick time)
-        self._outstanding: dict = {}
-        self._last_refresh = 0.0
-        self._lock = threading.Lock()
-        self._version = -1
+        self._model_id = multiplexed_model_id
+        self._router = _router or _RouterState()
+
+    # delegate routing state to the SHARED router object
+    @property
+    def _replicas(self):
+        return self._router.replicas
+
+    @_replicas.setter
+    def _replicas(self, v):
+        self._router.replicas = v
+
+    @property
+    def _outstanding(self):
+        return self._router.outstanding
+
+    @_outstanding.setter
+    def _outstanding(self, v):
+        self._router.outstanding = v
+
+    @property
+    def _last_refresh(self):
+        return self._router.last_refresh
+
+    @_last_refresh.setter
+    def _last_refresh(self, v):
+        self._router.last_refresh = v
+
+    @property
+    def _lock(self):
+        return self._router.lock
+
+    @property
+    def _version(self):
+        return self._router.version
+
+    @_version.setter
+    def _version(self, v):
+        self._router.version = v
 
     # handles must survive pickling into replicas/proxies (composition)
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name))
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._model_id))
+
+    def options(self, *, multiplexed_model_id: str | None = None
+                ) -> "DeploymentHandle":
+        """Per-call options (reference: handle.options(
+        multiplexed_model_id=...) routes to the replica already serving
+        that model, serve/multiplex.py). The view SHARES the parent's
+        router state (replica cache + load counters)."""
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id,
+            _router=self._router)
 
     def _controller(self):
         from ray_tpu.serve.controller import get_controller
@@ -116,6 +179,17 @@ class DeploymentHandle:
                     f"deployment {self.deployment_name!r} has no replicas")
         if len(replicas) == 1:
             return replicas[0]
+        if self._model_id:
+            # multiplexing: rendezvous (HRW) hash keeps one model id on
+            # one stable replica so its LRU cache keeps hitting, with
+            # minimal reshuffle when the replica set changes (reference:
+            # model-id-aware routing, serve/multiplex.py)
+            import hashlib
+
+            def score(r):
+                key = f"{self._model_id}:{r._actor_id}".encode()
+                return hashlib.md5(key).digest()
+            return max(replicas, key=score)
         a, b = random.sample(replicas, 2)
         return a if self._load(a._actor_id) <= self._load(b._actor_id) else b
 
@@ -128,6 +202,9 @@ class DeploymentHandle:
         caller continue a replica-side streaming session (the proxy's
         chunk drain) against the replica that holds the generator."""
         replica = self._pick_replica()
+        if self._model_id:
+            kwargs = {**kwargs,
+                      "__multiplexed_model_id__": self._model_id}
         ref = replica.handle_request.remote(args, kwargs)
         self._record(replica._actor_id, ref)
         return ref, replica
@@ -186,6 +263,9 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs):
         replica = self._handle._pick_replica()
+        if self._handle._model_id:
+            kwargs = {**kwargs,
+                      "__multiplexed_model_id__": self._handle._model_id}
         ref = replica.handle_method.remote(self._method, args, kwargs)
         self._handle._record(replica._actor_id, ref)
         return ref

@@ -1,0 +1,80 @@
+"""Model multiplexing: many models per deployment, LRU-cached per replica.
+
+Counterpart of the reference's `serve/multiplex.py`
+(`@serve.multiplexed` + `serve.get_multiplexed_model_id`): one
+deployment serves N models; each replica lazily loads the models routed
+to it and keeps at most `max_num_models_per_replica` resident (LRU).
+Requests carry a model id via
+``handle.options(multiplexed_model_id="m1").remote(...)``; the handle
+routes a given model id to a stable replica (rendezvous hashing), so a
+model's cache hits keep landing where it's already loaded.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from functools import wraps
+
+_MODEL_ID = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the CURRENT request (reference:
+    serve.get_multiplexed_model_id)."""
+    return getattr(_MODEL_ID, "value", "")
+
+
+def _set_model_id(value: str):
+    _MODEL_ID.value = value
+
+
+def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for the replica method that loads a model by id::
+
+        @serve.deployment
+        class ModelServer:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                return load_model(model_id)      # expensive
+
+            def __call__(self, x):
+                model = self.get_model(serve.get_multiplexed_model_id())
+                return model(x)
+
+    The wrapped method becomes an LRU cache keyed by model id, scoped to
+    the replica instance; evicted models with a ``__del__``/``close`` are
+    released to the GC.
+    """
+
+    def wrap(f):
+        @wraps(f)
+        def cached(self, model_id: str):
+            cache = getattr(self, "_mux_cache", None)
+            if cache is None:
+                cache = self._mux_cache = OrderedDict()
+                self._mux_lock = threading.Lock()
+            with self._mux_lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+            model = f(self, model_id)
+            with self._mux_lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    _evicted_id, evicted = cache.popitem(last=False)
+                    close = getattr(evicted, "close", None)
+                    if callable(close):
+                        try:
+                            close()
+                        except Exception:
+                            pass
+            return model
+
+        cached.__ray_tpu_multiplexed__ = True
+        return cached
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -16,8 +16,10 @@ import time
 from types import GeneratorType
 
 STREAM_MARKER = "__serve_stream__"
-_STREAM_BATCH = 16          # chunks per proxy round-trip
-_STREAM_IDLE_TTL_S = 300.0  # undrained streams are reaped after this
+from ray_tpu._private.constants import (
+    SERVE_STREAM_BATCH as _STREAM_BATCH,
+    SERVE_STREAM_IDLE_TTL_S as _STREAM_IDLE_TTL_S,
+)
 
 
 class StreamingResponse:
@@ -108,8 +110,15 @@ class Replica:
             self._streams[sid] = [it, now]
         return sid
 
+    @staticmethod
+    def _pop_model_id(kwargs: dict) -> str:
+        return kwargs.pop("__multiplexed_model_id__", "")
+
     def handle_request(self, args: tuple, kwargs: dict):
         """__call__ path (HTTP and plain handle calls)."""
+        from ray_tpu.serve.multiplex import _set_model_id
+        kwargs = dict(kwargs)
+        _set_model_id(self._pop_model_id(kwargs))
         self._enter()
         try:
             target = (self.callable if self._is_function
@@ -120,6 +129,9 @@ class Replica:
 
     def handle_method(self, method: str, args: tuple, kwargs: dict):
         """handle.method.remote path (model composition)."""
+        from ray_tpu.serve.multiplex import _set_model_id
+        kwargs = dict(kwargs)
+        _set_model_id(self._pop_model_id(kwargs))
         self._enter()
         try:
             return self._maybe_stream(

@@ -127,6 +127,23 @@ class Checkpoint:
             self._data = None
         return self.path
 
+    # -- remote storage (reference: air/checkpoint.py:707/:735
+    # to_uri/from_uri over remote_storage.py) -------------------------------
+
+    def to_uri(self, uri: str) -> str:
+        """Upload this checkpoint through the URI-keyed storage seam
+        (ray_tpu.util.storage; mem:// fake or a registered gs:// etc.)."""
+        from ray_tpu.util import storage
+        storage.upload_dir(self.as_directory(), uri)
+        return uri
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        from ray_tpu.util import storage
+        local = storage.staging_dir(uri)
+        storage.download_dir(uri, local)
+        return cls(local)
+
     def __repr__(self):
         kind = "dict" if self._data is not None else self.path
         return f"Checkpoint({kind})"

@@ -65,4 +65,9 @@ class RunConfig:
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
         name = self.name or "train_run"
+        from ray_tpu.util import storage
+        if storage.is_uri(base):
+            # remote experiment root (reference: RunConfig.storage_path
+            # accepts s3://... URIs; air/_internal/remote_storage.py)
+            return storage.uri_join(base, name)
         return os.path.join(base, name)

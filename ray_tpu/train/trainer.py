@@ -131,21 +131,48 @@ class JaxTrainer:
 
     def _persist_checkpoint(self, ckpt, storage: str, iteration: int,
                             kept: list):
-        dest = os.path.join(storage, f"checkpoint_{iteration:06d}")
-        ckpt.to_directory(dest)
-        kept.append(dest)
+        from ray_tpu.util import storage as storage_mod
+        name = f"checkpoint_{iteration:06d}"
+        if storage_mod.is_uri(storage):
+            # write locally (staging), then push through the URI-keyed
+            # backend; on a pod the run dir isn't a shared filesystem
+            # (reference: Checkpoint.to_uri + remote_storage.py)
+            local_root = storage_mod.staging_dir(storage)
+            dest = os.path.join(local_root, name)
+            ckpt.to_directory(dest)
+            uri = storage_mod.uri_join(storage, name)
+            try:
+                storage_mod.upload_dir(dest, uri)
+            except Exception:
+                # transient remote-storage failure must not kill the
+                # run: the local checkpoint is intact (same policy as
+                # the Tune sync path, tune/experiment.py)
+                logger.exception("checkpoint upload to %s failed", uri)
+        else:
+            dest = os.path.join(storage, name)
+            ckpt.to_directory(dest)
+            uri = None
+        kept.append((dest, uri))
         limit = self.run_config.checkpoint_config.num_to_keep
         while limit and len(kept) > limit:
-            old = kept.pop(0)
-            shutil.rmtree(old, ignore_errors=True)
+            old_dest, old_uri = kept.pop(0)
+            shutil.rmtree(old_dest, ignore_errors=True)
+            if old_uri is not None:
+                try:
+                    storage_mod.delete(old_uri)
+                except Exception:
+                    logger.exception("remote checkpoint delete failed "
+                                     "(%s)", old_uri)
         return Checkpoint(dest)
 
     # ------------------------------------------------------------------
 
     def fit(self) -> Result:
+        from ray_tpu.util import storage as storage_mod
         trial_name = self.run_config.name or f"train_{int(time.time())}"
         storage = self.run_config.resolved_storage_path()
-        os.makedirs(storage, exist_ok=True)
+        if not storage_mod.is_uri(storage):
+            os.makedirs(storage, exist_ok=True)
         max_failures = self.run_config.failure_config.max_failures
         failures = 0
         latest_ckpt = self.resume_checkpoint

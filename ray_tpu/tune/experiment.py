@@ -44,13 +44,29 @@ class Trial:
         # runtime-only fields (not persisted)
         self.actor = None
         self._pbt_exploit = None
+        # remote mirror of this trial's dir (reference: tune/syncer.py);
+        # set by the Tuner when storage_path is a URI
+        self.sync_uri: Optional[str] = None
 
     # -- persistence ------------------------------------------------------
 
     def persist_checkpoint(self, ckpt: Checkpoint, iteration: int) -> str:
-        path = os.path.join(self.local_dir, f"checkpoint_{iteration:06d}")
+        name = f"checkpoint_{iteration:06d}"
+        path = os.path.join(self.local_dir, name)
         ckpt.to_directory(path)
         self.checkpoint_path = path
+        if self.sync_uri:
+            # a transient remote-storage failure must not kill the run;
+            # the local checkpoint is intact and the next sync retries
+            # (reference: syncer errors are logged, not fatal)
+            from ray_tpu.util import storage
+            try:
+                storage.upload_dir(path,
+                                   storage.uri_join(self.sync_uri, name))
+            except Exception:
+                import logging
+                logging.getLogger("ray_tpu.tune").exception(
+                    "checkpoint sync to %s failed", self.sync_uri)
         return path
 
     def latest_checkpoint(self) -> Optional[Checkpoint]:
@@ -59,6 +75,11 @@ class Trial:
         return None
 
     def to_state(self) -> dict:
+        cp = self.checkpoint_path
+        if cp and cp.startswith(self.local_dir):
+            # store relative so a restore into a DIFFERENT staging dir
+            # (URI experiments) still resolves
+            cp = os.path.relpath(cp, self.local_dir)
         return {
             "trial_id": self.trial_id,
             "config": _jsonable(self.config),
@@ -67,7 +88,7 @@ class Trial:
             "last_result": _jsonable(self.last_result),
             "error": self.error,
             "num_failures": self.num_failures,
-            "checkpoint_path": self.checkpoint_path,
+            "checkpoint_path": cp,
         }
 
     @classmethod
@@ -78,7 +99,10 @@ class Trial:
         t.last_result = state.get("last_result", {})
         t.error = state.get("error")
         t.num_failures = state.get("num_failures", 0)
-        t.checkpoint_path = state.get("checkpoint_path")
+        cp = state.get("checkpoint_path")
+        if cp and not os.path.isabs(cp):
+            cp = os.path.join(t.local_dir, cp)
+        t.checkpoint_path = cp
         if t.status in (RUNNING, PAUSED):
             t.status = PENDING      # was in flight when the driver died
         return t
@@ -104,10 +128,12 @@ def new_trial_id() -> str:
 class ExperimentState:
     """Periodic snapshot of all trial states → experiment_state.json."""
 
-    def __init__(self, experiment_dir: str, save_period_s: float = 5.0):
+    def __init__(self, experiment_dir: str, save_period_s: float = 5.0,
+                 sync_uri: Optional[str] = None):
         self.experiment_dir = experiment_dir
         os.makedirs(experiment_dir, exist_ok=True)
         self.save_period_s = save_period_s
+        self.sync_uri = sync_uri
         self._last_save = 0.0
 
     @property
@@ -124,6 +150,18 @@ class ExperimentState:
             json.dump({"timestamp": now,
                        "trials": [t.to_state() for t in trials]}, f)
         os.replace(tmp, self.path)
+        if self.sync_uri:
+            from ray_tpu.util import storage
+            try:
+                with open(self.path, "rb") as f:
+                    storage.write_bytes(
+                        storage.uri_join(self.sync_uri,
+                                         EXPERIMENT_STATE_FILE),
+                        f.read())
+            except Exception:
+                import logging
+                logging.getLogger("ray_tpu.tune").exception(
+                    "experiment-state sync to %s failed", self.sync_uri)
 
     @classmethod
     def load_trials(cls, experiment_dir: str) -> list:

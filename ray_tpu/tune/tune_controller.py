@@ -71,7 +71,8 @@ class TuneController:
                  max_failures: int = 0,
                  checkpoint_frequency: int = 0,
                  checkpoint_at_end: bool = False,
-                 callbacks: Optional[list] = None):
+                 callbacks: Optional[list] = None,
+                 sync_uri: Optional[str] = None):
         self.trainable_cls = trainable_cls
         self.trials = list(trials)
         self.scheduler = scheduler or FIFOScheduler()
@@ -83,7 +84,7 @@ class TuneController:
         self.checkpoint_frequency = checkpoint_frequency
         self.checkpoint_at_end = checkpoint_at_end
         self.callbacks = list(callbacks or [])
-        self.state = ExperimentState(experiment_dir)
+        self.state = ExperimentState(experiment_dir, sync_uri=sync_uri)
         self.experiment_dir = experiment_dir
         if max_concurrent is None:
             cpus = ray_tpu.cluster_resources().get("CPU", 1)

@@ -163,21 +163,39 @@ class Tuner:
         ]
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.util import storage as storage_mod
         tc = self.tune_config
         trainable_cls, resources = self._resolve_trainable()
+        sync_uri = None
         if self._restore_path:
-            experiment_dir = self._restore_path
+            if storage_mod.is_uri(self._restore_path):
+                # remote experiment (reference: Tuner.restore("s3://...")
+                # via tune/syncer.py sync-down): pull into local staging
+                sync_uri = self._restore_path
+                experiment_dir = storage_mod.staging_dir(sync_uri)
+                storage_mod.download_dir(sync_uri, experiment_dir)
+            else:
+                experiment_dir = self._restore_path
             trials = ExperimentState.load_trials(experiment_dir)
         else:
-            experiment_dir = self.run_config.resolved_storage_path()
+            resolved = self.run_config.resolved_storage_path()
+            if storage_mod.is_uri(resolved):
+                sync_uri = resolved
+                experiment_dir = storage_mod.staging_dir(resolved)
+            else:
+                experiment_dir = resolved
             os.makedirs(experiment_dir, exist_ok=True)
             trials = self._make_trials(experiment_dir, resources)
         if not trials:
             raise ValueError("search space produced no trials")
+        if sync_uri:
+            for t in trials:
+                t.sync_uri = storage_mod.uri_join(
+                    sync_uri, f"trial_{t.trial_id}")
 
         ckpt_cfg = self.run_config.checkpoint_config
         controller = TuneController(
-            trainable_cls, trials, experiment_dir,
+            trainable_cls, trials, experiment_dir, sync_uri=sync_uri,
             scheduler=tc.scheduler,
             searcher=tc.search_alg,
             metric=tc.metric, mode=tc.mode,

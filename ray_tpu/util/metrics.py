@@ -15,7 +15,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-_FLUSH_PERIOD_S = 5.0
+from ray_tpu._private.constants import \
+    METRICS_FLUSH_PERIOD_S as _FLUSH_PERIOD_S
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000]

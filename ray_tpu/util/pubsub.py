@@ -1,0 +1,63 @@
+"""General pubsub channels over the cluster control store.
+
+Counterpart of the reference's pubsub framework
+(`src/ray/pubsub/publisher.h:307` long-poll Publisher/SubscriberState +
+`_private/gcs_pubsub.py`): named channels live on the head; publishers
+append, subscribers long-poll from their cursor. Any session member —
+driver, worker, client driver, CLI attach — can publish or subscribe,
+which is what the reference uses for log/error/actor-event fanout.
+
+    pub = Publisher("alerts")
+    pub.publish({"sev": "warn", "msg": "thermal"})
+
+    sub = Subscriber("alerts")
+    for msg in sub.poll(timeout=10):
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def _control():
+    from ray_tpu._private.worker import get_client
+    return get_client().control
+
+
+class Publisher:
+    def __init__(self, channel: str):
+        self.channel = channel
+
+    def publish(self, message: Any) -> int:
+        """Append to the channel; returns the message's sequence number.
+        Messages must be picklable; the head retains the last
+        PUBSUB_RING_MESSAGES per channel."""
+        return _control()("pubsub_publish",
+                          {"channel": self.channel, "message": message})
+
+
+class Subscriber:
+    """Cursor-tracking subscriber: each poll returns only messages newer
+    than the last batch seen (a fresh subscriber starts at the ring's
+    current tail unless `from_start=True`)."""
+
+    def __init__(self, channel: str, from_start: bool = False):
+        self.channel = channel
+        if from_start:
+            self._cursor = 0
+        else:
+            last, _ = _control()("pubsub_poll",
+                                 {"channel": channel, "after": 1 << 62,
+                                  "timeout": 0.0})
+            self._cursor = last
+
+    def poll(self, timeout: float = 30.0) -> List[Any]:
+        """Long-poll: block up to `timeout` for new messages."""
+        last, msgs = _control()(
+            "pubsub_poll",
+            {"channel": self.channel, "after": self._cursor,
+             "timeout": timeout})
+        if msgs:
+            self._cursor = last
+        return msgs

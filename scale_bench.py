@@ -1,0 +1,221 @@
+"""Core-runtime scalability benchmark -> SCALE.json.
+
+Counterpart of the reference's `python/ray/_private/ray_perf.py:93`
+microbenchmark suites + the release scalability envelope
+(`release/benchmarks/README.md:8-31`: 1M queued tasks, 10k concurrent,
+40k actors, 1 GiB broadcast). Suites here measure the same axes at a
+scale one machine can hold, and record the machine shape next to every
+number so the envelope is honest:
+
+  queued_tasks        submit 100k no-op tasks before draining any
+  task_throughput     no-op tasks/s through the pool (warm workers)
+  actor_creation      actor processes created/s (modest N; process-per-
+                      actor on this box)
+  actor_call_rate     pipelined method calls/s on one actor
+  small_put_get       1 KiB put+get round trips/s
+  store_bandwidth     25 MiB put+get GB/s through the shm arena
+  broadcast_1gib      one 1 GiB object read by tasks on N daemon nodes
+
+Run: python scale_bench.py [--queued 100000] [--actors 200] [--out SCALE.json]
+The reference package is not installed in this container (zero-egress
+image), so `ray_comparison` records the published envelope instead of a
+same-container measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import time
+
+
+def bench_queued_tasks(ray_tpu, n: int) -> dict:
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # warm one worker so drain isn't dominated by first-spawn
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    ray_tpu.get(refs)
+    t_drain = time.perf_counter() - t1
+    return {
+        "queued": n,
+        "submit_per_s": round(n / t_submit, 1),
+        "drain_per_s": round(n / t_drain, 1),
+        "submit_s": round(t_submit, 2),
+        "drain_s": round(t_drain, 2),
+    }
+
+
+def bench_task_throughput(ray_tpu, n: int = 2000) -> dict:
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(20)])
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return {"tasks": n, "tasks_per_s": round(n / dt, 1)}
+
+
+def _settle(ray_tpu, timeout: float = 120.0) -> None:
+    """Wait until dying worker processes are reaped, so one suite's
+    teardown storm (e.g. 200 actor exits) can't pollute the next
+    suite's numbers on a small box."""
+    client = ray_tpu._worker.get_client()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        workers = client.control("list_workers")
+        if sum(1 for w in workers if w.get("alive")) <= 4:
+            return
+        time.sleep(0.5)
+
+
+def bench_actor_creation(ray_tpu, n: int) -> dict:
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    dt = time.perf_counter() - t0
+    for a in actors:
+        ray_tpu.kill(a)
+    _settle(ray_tpu)
+    return {"actors": n, "created_per_s": round(n / dt, 2),
+            "total_s": round(dt, 1)}
+
+
+def bench_actor_calls(ray_tpu, n: int = 2000) -> dict:
+    @ray_tpu.remote(num_cpus=0)
+    class Counter:
+        def __init__(self):
+            self.i = 0
+
+        def inc(self):
+            self.i += 1
+            return self.i
+
+    a = Counter.remote()
+    ray_tpu.get(a.inc.remote())
+    t0 = time.perf_counter()
+    out = ray_tpu.get([a.inc.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    assert out[-1] == n + 1
+    ray_tpu.kill(a)
+    return {"calls": n, "calls_per_s": round(n / dt, 1)}
+
+
+def bench_small_put_get(ray_tpu, n: int = 500) -> dict:
+    import numpy as np
+    arr = np.zeros(256, np.float32)   # 1 KiB
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(arr))
+    dt = time.perf_counter() - t0
+    return {"round_trips": n, "per_s": round(n / dt, 1)}
+
+
+def bench_store_bandwidth(ray_tpu, n: int = 40) -> dict:
+    import numpy as np
+    big = np.zeros(25_000_000 // 4, np.float32)   # 25 MiB
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(big))
+    dt = time.perf_counter() - t0
+    return {"mib": 25, "reps": n,
+            "gb_per_s": round(n * big.nbytes / dt / 1e9, 2)}
+
+
+def bench_broadcast(ray_tpu, cluster, gib: float = 1.0,
+                    n_nodes: int = 2) -> dict:
+    import numpy as np
+    node_ids = [cluster.add_node({"CPU": 1, f"bx{i}": 1})
+                for i in range(n_nodes)]
+
+    payload = np.ones(int(gib * (1 << 30) // 4), np.float32)
+    t_put0 = time.perf_counter()
+    ref = ray_tpu.put(payload)
+    t_put = time.perf_counter() - t_put0
+
+    @ray_tpu.remote
+    def reduce_sum(a):
+        return float(a[::4096].sum())
+
+    t0 = time.perf_counter()
+    refs = [reduce_sum.options(resources={f"bx{i}": 1}).remote(ref)
+            for i in range(n_nodes)]
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    assert all(abs(v - out[0]) < 1e-3 for v in out)
+    for nid in node_ids:
+        cluster.kill_node(nid)
+    total_bytes = payload.nbytes * n_nodes
+    return {"gib": gib, "nodes": n_nodes, "put_s": round(t_put, 2),
+            "fanout_s": round(dt, 2),
+            "aggregate_gb_per_s": round(total_bytes / dt / 1e9, 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queued", type=int, default=100_000)
+    ap.add_argument("--actors", type=int, default=200)
+    ap.add_argument("--broadcast-gib", type=float, default=1.0)
+    ap.add_argument("--broadcast-nodes", type=int, default=2)
+    ap.add_argument("--out", default="SCALE.json")
+    args = ap.parse_args()
+
+    os.environ.setdefault("RAY_TPU_OBJECT_STORE_BYTES",
+                          str(4 * (1 << 30)))   # 1 GiB payloads fit
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": max(4, os.cpu_count() or 1)})
+
+    results = {}
+    results["task_throughput"] = bench_task_throughput(ray_tpu)
+    results["queued_tasks"] = bench_queued_tasks(ray_tpu, args.queued)
+    results["actor_creation"] = bench_actor_creation(ray_tpu, args.actors)
+    results["actor_call_rate"] = bench_actor_calls(ray_tpu)
+    results["small_put_get"] = bench_small_put_get(ray_tpu)
+    results["store_bandwidth"] = bench_store_bandwidth(ray_tpu)
+    _settle(ray_tpu)
+    results["broadcast_1gib"] = bench_broadcast(
+        ray_tpu, cluster, args.broadcast_gib, args.broadcast_nodes)
+
+    doc = {
+        "machine": {
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+        },
+        "results": results,
+        "ray_comparison": {
+            "same_container": None,
+            "note": "reference ray package not installed in this "
+                    "zero-egress container; published envelope for "
+                    "context (release/benchmarks/README.md:8-31): 1M+ "
+                    "tasks queued on one m4.16xlarge (64 cores), 10k+ "
+                    "concurrent tasks / 40k+ actors on a 64-node "
+                    "cluster, 1 GiB broadcast to 50+ nodes. This box "
+                    "has 1 core; numbers above are per-core envelope "
+                    "points, not cluster ceilings.",
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc["results"], indent=2))
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

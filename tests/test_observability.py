@@ -151,3 +151,132 @@ def test_merge_snapshots_semantics():
           "series": {(): 2.0}}]
     merged = metrics_mod.merge_snapshots([a, b])
     assert merged[0]["series"][()] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Log pipeline (reference: _private/log_monitor.py:102 tail-to-driver +
+# dashboard/modules/log/): a remote task's print is captured to a per-
+# process file, tailed, and reaches (a) a subscribed driver's stderr and
+# (b) the head's log ring serving /api/logs. Subprocess-driven: needs its
+# own session with a daemon node and a log_to_driver subscription.
+# ---------------------------------------------------------------------------
+
+_LOG_E2E = r"""
+import sys, time
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+c = Cluster(head_resources={"CPU": 2}, log_to_driver=True)
+c.add_node({"CPU": 2, "far": 1})
+
+@ray_tpu.remote
+def speak_head():
+    print("HELLO-FROM-HEAD-WORKER")
+    return 1
+
+@ray_tpu.remote(resources={"far": 1})
+def speak_node():
+    print("HELLO-FROM-NODE-WORKER")
+    return 2
+
+assert ray_tpu.get([speak_head.remote(), speak_node.remote()],
+                   timeout=120) == [1, 2]
+
+client = ray_tpu._worker.get_client()
+deadline = time.time() + 30
+found = set()
+while time.time() < deadline and len(found) < 2:
+    for row in client.control("list_logs"):
+        text = "\n".join(client.control(
+            "get_log", {"source": row["source"], "lines": 500}))
+        if "HELLO-FROM-HEAD-WORKER" in text:
+            found.add("head")
+        if "HELLO-FROM-NODE-WORKER" in text:
+            found.add("node")
+    time.sleep(0.3)
+assert found == {"head", "node"}, found
+# give the subscription fanout a beat to hit our stderr, then exit; the
+# parent asserts on captured stderr
+time.sleep(1.5)
+print("LOGS-RING-OK")
+c.shutdown()
+"""
+
+
+def test_log_pipeline_to_driver_and_ring():
+    import os
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([_sys.executable, "-c", _LOG_E2E], cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "LOGS-RING-OK" in r.stdout
+    # tail-to-driver: the remote prints arrived on the DRIVER's stderr,
+    # prefixed with their source process
+    assert "HELLO-FROM-HEAD-WORKER" in r.stderr
+    assert "HELLO-FROM-NODE-WORKER" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# On-demand stack dumps (reference: `ray stack` scripts.py:1786 + py-spy
+# profile_manager.py — workers self-sample via sys._current_frames) and
+# general pubsub channels (reference: src/ray/pubsub/publisher.h:307).
+# ---------------------------------------------------------------------------
+
+def test_stack_dump_finds_busy_worker(cluster):
+    import threading
+
+    @ray_tpu.remote
+    def very_recognizable_busy_loop():
+        t0 = time.time()
+        while time.time() - t0 < 8.0:
+            time.sleep(0.05)
+        return 1
+
+    ref = very_recognizable_busy_loop.remote()
+    time.sleep(1.0)     # let it get scheduled + running
+    client = ray_tpu._worker.get_client()
+    dumps = client.control("stack", {"worker_id": None, "timeout": 4.0})
+    assert dumps, "no stacks collected"
+    text = "\n".join(d["stacks"] for d in dumps.values())
+    assert "very_recognizable_busy_loop" in text, \
+        f"busy function missing from stacks:\n{text[:2000]}"
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_pubsub_publish_poll_across_processes(cluster):
+    from ray_tpu.util.pubsub import Publisher, Subscriber
+
+    sub = Subscriber("test_chan")
+
+    @ray_tpu.remote
+    def announce(i):
+        from ray_tpu.util.pubsub import Publisher as P
+        return P("test_chan").publish({"i": i})
+
+    seqs = ray_tpu.get([announce.remote(i) for i in range(3)], timeout=60)
+    assert len(set(seqs)) == 3
+    got = []
+    deadline = time.time() + 20
+    while len(got) < 3 and time.time() < deadline:
+        got.extend(sub.poll(timeout=5.0))
+    assert sorted(m["i"] for m in got) == [0, 1, 2]
+    # cursor advanced: nothing new -> empty poll, fast
+    assert sub.poll(timeout=0.2) == []
+
+
+def test_pubsub_ring_cap(cluster, monkeypatch):
+    # the cap is re-resolved from the environment at publish time, so a
+    # small override actually exercises the trim branch
+    monkeypatch.setenv("RAY_TPU_PUBSUB_RING_MESSAGES", "10")
+    client = ray_tpu._worker.get_client()
+    for i in range(25):
+        client.control("pubsub_publish",
+                       {"channel": "cap_chan", "message": i})
+    last, msgs = client.control(
+        "pubsub_poll", {"channel": "cap_chan", "after": 0,
+                        "timeout": 0.0})
+    assert last == 25
+    assert len(msgs) == 10 and msgs == list(range(15, 25))

@@ -267,3 +267,14 @@ def test_cql_runs_on_offline_pendulum(tmp_path):
     assert np.isfinite(r1["loss"]) and np.isfinite(r2["loss"])
     a = algo.compute_single_action(np.zeros(3, np.float32))
     assert a.shape == (1,) and -2.0 <= float(a[0]) <= 2.0
+
+
+def test_ppo_acrobot_tuned_regression():
+    """Harder-than-CartPole learning oracle (reference pattern:
+    tuned_examples reward thresholds, rllib/BUILD:152-162): Acrobot needs
+    energy pumping under a -1/step sparse signal; random play scores
+    -500, the threshold is -150."""
+    from ray_tpu.rllib.train import list_tuned_examples, run_tuned_example
+    path = [p for p in list_tuned_examples() if "acrobot" in p][0]
+    result = run_tuned_example(path, verbose=False)
+    assert result["passed"], result

@@ -1,0 +1,166 @@
+"""Serve platform seams: model multiplexing, declarative config apply,
+and per-node HTTP proxies.
+
+References: `serve/multiplex.py` (@serve.multiplexed +
+get_multiplexed_model_id), `serve/schema.py` + `dashboard/modules/serve/`
+(declarative YAML/REST deploy), `_private/http_proxy.py:858` (one proxy
+actor per node).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session(ray_session):
+    yield ray_session
+    serve.delete()
+    serve.shutdown()
+    time.sleep(0.3)
+
+
+# ---------------------------------------------------------------------------
+# multiplexing
+# ---------------------------------------------------------------------------
+
+LOADS: list = []      # records (replica_pid, model_id) loads
+
+
+@serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.1})
+class MuxServer:
+    @serve.multiplexed(max_num_models_per_replica=2)
+    def get_model(self, model_id: str):
+        import os
+        return {"id": model_id, "pid": os.getpid(),
+                "stamp": time.time()}
+
+    def __call__(self, x):
+        model = self.get_model(serve.get_multiplexed_model_id())
+        return {"model": model["id"], "pid": model["pid"],
+                "stamp": model["stamp"], "x": x}
+
+
+def test_multiplexed_routing_and_lru(serve_session):
+    handle = serve.run(MuxServer.bind(), name="mux")
+
+    # same model id -> same replica (rendezvous hash) and a cache HIT
+    # (the load stamp must not change between calls)
+    r1 = handle.options(multiplexed_model_id="m1").call(1)
+    r2 = handle.options(multiplexed_model_id="m1").call(2)
+    assert r1["model"] == r2["model"] == "m1"
+    assert r1["pid"] == r2["pid"], "m1 moved replicas between calls"
+    assert r1["stamp"] == r2["stamp"], "m1 was reloaded (cache miss)"
+
+    # LRU cap 2: load 3 models pinned to ONE replica id, the first gets
+    # evicted and reloads with a new stamp
+    ids = ["a", "b", "c"]
+    first = {m: handle.options(multiplexed_model_id=m).call(0)
+             for m in ids}
+    # drive them all to the same replica? HRW may spread them; only
+    # assert eviction when a, b, c landed together with a
+    pids = {m: first[m]["pid"] for m in ids}
+    same = [m for m in ids if pids[m] == pids["a"]]
+    if len(same) == 3:
+        again = handle.options(multiplexed_model_id="a").call(0)
+        assert again["stamp"] != first["a"]["stamp"], \
+            "LRU cap did not evict the oldest model"
+    # no-model-id calls still work
+    plain = handle.call(42)
+    assert plain["model"] == "" and plain["x"] == 42
+
+
+# ---------------------------------------------------------------------------
+# declarative config apply (module-level app so import_path resolves)
+# ---------------------------------------------------------------------------
+
+@serve.deployment(ray_actor_options={"num_cpus": 0.1})
+class Echo:
+    def __init__(self, prefix: str = "echo"):
+        self.prefix = prefix
+
+    def __call__(self, x):
+        return f"{self.prefix}:{x}"
+
+
+config_app = Echo.bind("fromcfg")
+
+CONFIG = {
+    "applications": [{
+        "name": "cfg_app",
+        "route_prefix": "/cfg",
+        "import_path": "tests.test_serve_platform:config_app",
+        "deployments": [{"name": "Echo", "num_replicas": 2}],
+    }],
+}
+
+
+def test_apply_config_dict_and_overrides(serve_session):
+    out = serve.apply_config(CONFIG)
+    assert out == {"cfg_app": "deployed"}
+    handle = serve.get_deployment_handle("Echo", "cfg_app")
+    assert handle.call("hi") == "fromcfg:hi"
+    st = serve.status()
+    assert st["cfg_app:Echo"]["target_replicas"] == 2
+    serve.delete("cfg_app")
+
+
+def test_apply_config_yaml_and_cli_roundtrip(serve_session, tmp_path):
+    import yaml
+    path = tmp_path / "serve.yaml"
+    cfg = {"applications": [{
+        "name": "yaml_app", "route_prefix": "/y",
+        "import_path": "tests.test_serve_platform:config_app",
+    }]}
+    path.write_text(yaml.safe_dump(cfg))
+    out = serve.apply_config(str(path))
+    assert out == {"yaml_app": "deployed"}
+    assert serve.get_deployment_handle("Echo", "yaml_app").call("x") \
+        == "fromcfg:x"
+    serve.delete("yaml_app")
+
+
+def test_apply_config_rejects_unknown_deployment(serve_session):
+    bad = {"applications": [{
+        "name": "bad", "import_path":
+            "tests.test_serve_platform:config_app",
+        "deployments": [{"name": "Nope", "num_replicas": 2}],
+    }]}
+    with pytest.raises(Exception, match="unknown deployments"):
+        serve.apply_config(bad)
+
+
+# ---------------------------------------------------------------------------
+# per-node HTTP proxies
+# ---------------------------------------------------------------------------
+
+def test_proxy_on_every_node(serve_session):
+    import json
+    import urllib.request
+
+    from ray_tpu.cluster_utils import Cluster
+    c = Cluster.attach()
+    nid = c.add_node({"CPU": 1, "proxyhost": 1})
+    try:
+        serve.run(Echo.bind("edge"), name="edge_app")
+        serve.start(http_options={"port": 0, "worker_port": 0,
+                                  "location": "EveryNode"})
+        serve.set_route("/edge", "Echo", "edge_app")
+        from ray_tpu.serve.api import proxy_endpoints
+        eps = proxy_endpoints()
+        assert "head" in eps and nid in eps, eps
+        # the WORKER node's proxy serves the route end to end
+        port = eps[nid]["port"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/edge?x=1", timeout=30) as r:
+            body = r.read().decode()
+        assert "edge:" in body, body
+        serve.delete("edge_app")
+    finally:
+        try:
+            c.kill_node(nid)
+        except Exception:
+            pass
