@@ -166,7 +166,10 @@ class JobManager:
 
     def _exec(self, info: JobInfo) -> None:
         job_id, runtime_env = info.job_id, info.runtime_env
-        env = dict(os.environ)
+        # jobs resolve the same modules as the cluster's own processes
+        # (uninstalled checkouts included), like worker spawns do
+        from ray_tpu._private.spawn import propagate_pythonpath
+        env = propagate_pythonpath(dict(os.environ))
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         env["RAY_TPU_JOB_ID"] = job_id

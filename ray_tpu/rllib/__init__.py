@@ -24,7 +24,8 @@ from ray_tpu.rllib.algorithms import (
     A2C, A2CConfig, APPO, APPOConfig, Algorithm, AlgorithmConfig, BC,
     BCConfig, CQL, CQLConfig, DDPG, DDPGConfig, DQN, DQNConfig, IMPALA,
     IMPALAConfig, MAPPOConfig, MARWIL, MARWILConfig, MultiAgentPPO, PPO,
-    PPOConfig, SAC, SACConfig, TD3, TD3Config, get_algorithm_class,
+    PPOConfig, SAC, SACConfig, TD3, TD3Config, ES, ESConfig,
+    LinTS, LinTSConfig, LinUCB, LinUCBConfig, get_algorithm_class,
     register_algorithm)
 from ray_tpu.rllib.env.jax_env import make_env, register_env
 from ray_tpu.rllib.env.multi_agent import CoopMatch, MultiAgentJaxEnv
@@ -38,4 +39,5 @@ __all__ = [
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
     "DDPG", "DDPGConfig", "TD3", "TD3Config",
     "MultiAgentPPO", "MAPPOConfig", "MultiAgentJaxEnv", "CoopMatch",
+    "ES", "ESConfig", "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
 ]

@@ -12,6 +12,9 @@ from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.ddpg import (
     DDPG, DDPGConfig, TD3, TD3Config)
 from ray_tpu.rllib.algorithms.ma_ppo import MAPPOConfig, MultiAgentPPO
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
+from ray_tpu.rllib.algorithms.bandits import (
+    LinTS, LinTSConfig, LinUCB, LinUCBConfig)
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -19,4 +22,5 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "APPO", "APPOConfig", "SAC", "SACConfig",
            "BC", "BCConfig", "MARWIL", "MARWILConfig",
            "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
-           "MultiAgentPPO", "MAPPOConfig"]
+           "MultiAgentPPO", "MAPPOConfig", "ES", "ESConfig",
+           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig"]

@@ -18,10 +18,12 @@ import time
 
 
 def _resolve_session(args) -> str:
-    """--session, else the newest live session on this host (exit 1 if
-    none)."""
+    """--session, else RAY_TPU_ADDRESS (what `ray_tpu attach` exports
+    into its subshell), else the newest live session on this host (exit
+    1 if none)."""
+    import os
     from ray_tpu._private.attach import find_sessions
-    session = args.session
+    session = args.session or os.environ.get("RAY_TPU_ADDRESS")
     if session is None:
         sessions = find_sessions()
         if not sessions:
@@ -181,8 +183,8 @@ def cmd_start(args):
         # must hold even if the head prints nothing (select, not a
         # blocking readline)
         deadline = _time.time() + 60
-        ready = False
-        while not ready:
+        session = None
+        while session is None:
             rem = deadline - _time.time()
             if rem <= 0:
                 print("head startup timed out", file=sys.stderr)
@@ -196,8 +198,15 @@ def cmd_start(args):
                 print("head failed to start", file=sys.stderr)
                 sys.exit(1)
             print(line, end="")
-            ready = line.startswith("drive:")
-        return
+            if line.startswith("ray_tpu head up: session="):
+                session = line.split("session=", 1)[1].strip()
+            if line.startswith("drive:") and session is None:
+                # older banner without the session line (shouldn't
+                # happen); stop relaying anyway
+                break
+        # returned so callers (cmd_up) know EXACTLY which session this
+        # head owns instead of guessing by mtime
+        return session
 
     if not args.address:
         print("start needs --head or --address HOST:PORT", file=sys.stderr)
@@ -228,6 +237,153 @@ def cmd_start(args):
         os.execve(sys.executable, cmd, env)
     proc = subprocess.Popen(cmd, env=env, start_new_session=True)
     print(f"node {node_id} joining {args.address} (pid {proc.pid})")
+
+
+def _cluster_state_path(name: str) -> str:
+    import os
+    root = os.path.expanduser("~/.ray_tpu/clusters")
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, f"{name}.json")
+
+
+def cmd_up(args):
+    """`ray_tpu up -f cluster.yaml` — bring a cluster up from a config
+    (reference: the cluster launcher, scripts.py:1235 `ray up` +
+    autoscaler/_private/commands.py): start a standalone head, attach
+    the autoscaler with the config's node types, and let min_workers
+    populate. Provider: LocalDaemonNodeProvider (one machine); remote
+    machines join with `ray_tpu start --address`."""
+    import os
+    import time as _time
+
+    import yaml
+
+    with open(args.file) as f:
+        cfg = yaml.safe_load(f)
+    name = cfg.get("cluster_name", "default")
+    head_cfg = cfg.get("head", {})
+
+    # start the head detached (same path as `start --head`)
+    head_args = argparse.Namespace(
+        head=True, address=None, authkey=None,
+        port=head_cfg.get("port"), num_cpus=head_cfg.get("num_cpus"),
+        num_tpus=head_cfg.get("num_tpus"),
+        resources=json.dumps(head_cfg.get("resources", {})),
+        session_dir=None, block=False)
+    session = cmd_start(head_args)
+    if not session:
+        print("could not determine the new head's session", file=sys.stderr)
+        sys.exit(1)
+    from ray_tpu._private.attach import AttachClient
+    c = AttachClient(session)
+    autoscaler_cfg = {
+        "max_workers": cfg.get("max_workers", 8),
+        "idle_timeout_minutes": cfg.get("idle_timeout_minutes", 5.0),
+        "available_node_types": cfg.get("available_node_types", {}),
+    }
+    # node_config defaults to the declared resources
+    for spec in autoscaler_cfg["available_node_types"].values():
+        spec.setdefault("node_config",
+                        {"resources": spec.get("resources", {})})
+    c.control("attach_autoscaler", autoscaler_cfg)
+
+    with open(_cluster_state_path(name), "w") as f:
+        json.dump({"session": session, "config_file":
+                   os.path.abspath(args.file)}, f)
+
+    # wait for min_workers to come up
+    want = sum(s.get("min_workers", 0)
+               for s in autoscaler_cfg["available_node_types"].values())
+    deadline = _time.time() + 120
+    while _time.time() < deadline:
+        alive = [n for n in c.control("list_nodes")
+                 if n["alive"] and not n.get("head")]
+        if len(alive) >= want:
+            break
+        _time.sleep(1.0)
+    c.close()
+    if len(alive) < want:
+        print(f"cluster {name!r}: only {len(alive)}/{want} min_workers "
+              f"came up within 120s", file=sys.stderr)
+        sys.exit(1)
+    print(f"cluster {name!r} up: session={session}, "
+          f"{len(alive)} worker node(s)")
+
+
+def _cluster_session(args) -> str:
+    import os
+    if getattr(args, "session", None):
+        return args.session
+    path = _cluster_state_path(args.name)
+    if not os.path.exists(path):
+        print(f"no cluster state for {args.name!r} (ran `up`?)",
+              file=sys.stderr)
+        sys.exit(1)
+    with open(path) as f:
+        return json.load(f)["session"]
+
+
+def cmd_down(args):
+    """`ray_tpu down NAME` — tear the cluster down (reference: `ray
+    down`, scripts.py:1235+)."""
+    import os
+    import signal as _signal
+    session = _cluster_session(args)
+    try:
+        with open(os.path.join(session, "driver.pid")) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, _signal.SIGTERM)
+        print(f"cluster {args.name!r} down (head pid {pid})")
+    except (OSError, ValueError) as e:
+        # head already gone (crash/reboot): still clear the state so
+        # the cluster name isn't wedged forever
+        print(f"head already gone ({e}); clearing cluster state")
+    try:
+        os.unlink(_cluster_state_path(args.name))
+    except OSError:
+        pass
+
+
+def cmd_attach(args):
+    """`ray_tpu attach NAME` — a subshell wired to the cluster
+    (reference: `ray attach` opens a shell on the head; locally that
+    means RAY_TPU_ADDRESS/AUTHKEY exported so `ray_tpu.init(address=
+    os.environ['RAY_TPU_ADDRESS'])` and the CLI hit this cluster)."""
+    import os
+    session = _cluster_session(args)
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = session
+    with open(os.path.join(session, "authkey"), "rb") as f:
+        env["RAY_TPU_AUTHKEY"] = f.read().hex()
+    shell = env.get("SHELL", "/bin/sh")
+    print(f"attached to {session} (exit the shell to detach)")
+    os.execve(shell, [shell], env)
+
+
+def cmd_submit(args):
+    """`ray_tpu submit NAME script.py [args...]` — run a script as a job
+    on the cluster and stream its result (reference: `ray submit`,
+    scripts.py:1235-1728)."""
+    import os
+    session = _cluster_session(args)
+    from ray_tpu._private.attach import AttachClient
+    c = AttachClient(session)
+    import shlex
+    entry = " ".join(shlex.quote(p) for p in
+                     [sys.executable, os.path.abspath(args.script),
+                      *args.script_args])
+    job_id = c.control("job_submit", {
+        "entrypoint": entry, "job_id": None,
+        "runtime_env": {"env_vars": {"RAY_TPU_ADDRESS": session}},
+        "metadata": None})
+    print(f"submitted {job_id}")
+    while True:
+        st = c.control("job_status", job_id)["status"]
+        if st in ("SUCCEEDED", "FAILED", "STOPPED"):
+            print(c.control("job_logs", job_id), end="")
+            print(st)
+            sys.exit(0 if st == "SUCCEEDED" else 1)
+        time.sleep(0.5)
 
 
 def cmd_stop(args):
@@ -362,6 +518,24 @@ def main(argv=None):
 
     st = sub.add_parser("stop")
     st.set_defaults(fn=cmd_stop)
+
+    up = sub.add_parser("up")
+    up.add_argument("-f", "--file", required=True)
+    up.set_defaults(fn=cmd_up)
+
+    dn = sub.add_parser("down")
+    dn.add_argument("name", nargs="?", default="default")
+    dn.set_defaults(fn=cmd_down)
+
+    at = sub.add_parser("attach")
+    at.add_argument("name", nargs="?", default="default")
+    at.set_defaults(fn=cmd_attach)
+
+    sm = sub.add_parser("submit")
+    sm.add_argument("name")
+    sm.add_argument("script")
+    sm.add_argument("script_args", nargs=argparse.REMAINDER)
+    sm.set_defaults(fn=cmd_submit)
 
     sub.add_parser("status").set_defaults(fn=cmd_status)
 

@@ -1,0 +1,91 @@
+"""Cluster launcher CLI: `ray_tpu up / submit / down` from a YAML config.
+
+Counterpart of the reference's cluster launcher
+(`scripts/scripts.py:1235-1728` up/down/attach/exec/submit driving
+`autoscaler/_private/commands.py`): `up` starts a standalone head +
+attaches the autoscaler (min_workers populate via
+LocalDaemonNodeProvider), `submit` runs a script as a job wired to the
+cluster, `down` tears it all down.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+JOB_SCRIPT = """
+import os
+import ray_tpu
+ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+
+@ray_tpu.remote(resources={"launcher_worker": 1})
+def where():
+    return os.environ.get("RAY_TPU_NODE_ID", "head")
+
+node = ray_tpu.get(where.remote(), timeout=120)
+assert node != "head", node
+print("JOB-RAN-ON", node)
+ray_tpu.shutdown()
+"""
+
+
+def _cli(*argv, timeout=180, env=None):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+        cwd=REPO, env=env or dict(os.environ), capture_output=True,
+        text=True, timeout=timeout)
+
+
+def test_up_submit_down(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(f"""
+cluster_name: launcher_test
+max_workers: 2
+idle_timeout_minutes: 30
+head:
+  port: {port}
+  num_cpus: 2
+available_node_types:
+  worker:
+    resources: {{CPU: 2, launcher_worker: 1}}
+    min_workers: 1
+    max_workers: 2
+""")
+    script = tmp_path / "job.py"
+    script.write_text(JOB_SCRIPT)
+
+    env = dict(os.environ)
+    env["HOME"] = str(tmp_path)           # isolate ~/.ray_tpu state
+    env["RAY_TPU_HEAD_BIND_HOST"] = "127.0.0.1"
+    up = down = None
+    try:
+        up = _cli("up", "-f", str(cfg), env=env, timeout=240)
+        assert up.returncode == 0, up.stdout + up.stderr
+        assert "1 worker node(s)" in up.stdout, up.stdout
+
+        state = json.load(open(
+            tmp_path / ".ray_tpu" / "clusters" / "launcher_test.json"))
+        session = state["session"]
+        assert os.path.exists(os.path.join(session, "head_address"))
+
+        sub = _cli("submit", "launcher_test", str(script), env=env,
+                   timeout=240)
+        assert sub.returncode == 0, sub.stdout + sub.stderr
+        assert "JOB-RAN-ON" in sub.stdout
+        assert "SUCCEEDED" in sub.stdout
+    finally:
+        down = _cli("down", "launcher_test", env=env, timeout=60)
+        # teardown must report success and actually kill the head
+        assert down.returncode == 0, down.stdout + down.stderr
+        time.sleep(2.0)
+        assert not os.path.exists(
+            tmp_path / ".ray_tpu" / "clusters" / "launcher_test.json")
