@@ -165,6 +165,14 @@ HEAD_SNAPSHOT_INTERVAL_S = define(
     "placement groups -> session_dir/head_state.pkl; reference: "
     "Redis-backed GCS persistence, redis_store_client.h:33).")
 
+HEAD_SNAPSHOT_URI = define(
+    "HEAD_SNAPSHOT_URI", str, "",
+    "Optional URI (mem:// fake, registered gs://...) the standalone "
+    "head mirrors its metadata snapshot to; a NEW head on ANY machine "
+    "restores from it when its session dir has no local snapshot — "
+    "head failover (reference: Redis-backed GCS persistence, "
+    "redis_store_client.h:33).")
+
 AUTOSCALER_UPDATE_INTERVAL_S = define(
     "AUTOSCALER_UPDATE_INTERVAL_S", float, 1.0,
     "Head monitor tick: refresh LoadMetrics from cluster state and run "

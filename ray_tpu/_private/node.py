@@ -559,14 +559,28 @@ class NodeServer:
     def _snapshot_loop(self):
         import pickle
         period = config.get("HEAD_SNAPSHOT_INTERVAL_S")
+        uri = config.get("HEAD_SNAPSHOT_URI")
+        last_digest = None
         while not self._shutdown:
             time.sleep(period)
             try:
                 state = self._snapshot_state()
+                blob = pickle.dumps(state)
+                import hashlib
+                digest = hashlib.sha1(blob).digest()
+                if digest == last_digest:
+                    continue      # unchanged: skip disk AND remote writes
                 tmp = self._snapshot_path() + ".tmp"
                 with open(tmp, "wb") as f:
-                    pickle.dump(state, f)
+                    f.write(blob)
                 os.replace(tmp, self._snapshot_path())
+                if uri:
+                    # remote mirror -> a replacement head on another
+                    # machine can take over (Redis-GCS analog)
+                    from ray_tpu.util import storage
+                    storage.write_bytes(
+                        storage.uri_join(uri, "head_state.pkl"), blob)
+                last_digest = digest
             except Exception:
                 logger.exception("head snapshot failed")
 
@@ -604,11 +618,31 @@ class NodeServer:
     def _restore_state(self):
         import pickle
         path = self._snapshot_path()
-        if not os.path.exists(path):
+        blob = None
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                logger.exception("local head snapshot unreadable")
+        if blob is None:
+            uri = config.get("HEAD_SNAPSHOT_URI")
+            if uri:
+                # failover: a fresh machine with no session dir restores
+                # the cluster metadata from the remote mirror
+                try:
+                    from ray_tpu.util import storage
+                    blob = storage.read_bytes(
+                        storage.uri_join(uri, "head_state.pkl"))
+                    logger.warning("restoring head state from %s", uri)
+                except FileNotFoundError:
+                    pass
+                except Exception:
+                    logger.exception("remote head snapshot unreadable")
+        if blob is None:
             return
         try:
-            with open(path, "rb") as f:
-                state = pickle.load(f)
+            state = pickle.loads(blob)
         except Exception:
             logger.exception("head snapshot unreadable; starting fresh")
             return

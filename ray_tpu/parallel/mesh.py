@@ -83,6 +83,52 @@ class MeshSpec:
             dev_array = np.asarray(devices).reshape(shape)
         return Mesh(dev_array, AXIS_ORDER)
 
+    def build_multislice(self, num_slices: int, devices=None) -> Mesh:
+        """Multi-slice (DCN) mesh: the OUTER factor of the `data` (or,
+        when data==1, `pipe`) axis spans slices, so gradient psums do a
+        hierarchical reduce (in-slice over ICI, then one cross-slice hop
+        over DCN) while every model axis (fsdp/seq/expert/tensor) stays
+        inside a slice — the scaling-book multi-pod recipe. On real
+        multi-slice TPU runtimes this delegates to
+        `mesh_utils.create_hybrid_device_mesh` (slice-aware placement);
+        elsewhere (CPU simulation, single-slice) devices are grouped
+        into `num_slices` contiguous blocks, which preserves the
+        collective structure the compiler sees."""
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        if n % num_slices:
+            raise ValueError(
+                f"{n} devices cannot split into {num_slices} slices")
+        sizes = self.resolve(n)
+        dcn_axis = "data" if sizes["data"] % num_slices == 0 \
+            else "pipe"
+        if sizes[dcn_axis] % num_slices:
+            raise ValueError(
+                f"neither data={sizes['data']} nor pipe={sizes['pipe']} "
+                f"divides into {num_slices} slices (the DCN axis must)")
+        ici_sizes = dict(sizes)
+        ici_sizes[dcn_axis] //= num_slices
+        ici_shape = tuple(ici_sizes[a] for a in AXIS_ORDER)
+        dcn_shape = tuple(num_slices if a == dcn_axis else 1
+                          for a in AXIS_ORDER)
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=np.asarray(devices))
+        except (ValueError, AssertionError, KeyError, AttributeError):
+            # No slice metadata (CPU sim / single-slice): contiguous
+            # blocks of n/num_slices devices play the slices, stacked
+            # along the DCN axis.
+            per = n // num_slices
+            blocks = [
+                np.asarray(devices[i * per:(i + 1) * per]).reshape(
+                    ici_shape)
+                for i in range(num_slices)
+            ]
+            axis = AXIS_ORDER.index(dcn_axis)
+            dev_array = np.concatenate(blocks, axis=axis)
+        return Mesh(dev_array, AXIS_ORDER)
+
 
 def single_device_mesh() -> Mesh:
     """A 1-device mesh so the same pjit code paths run everywhere."""

@@ -53,6 +53,11 @@ class AlgorithmConfig:
         self.num_envs_per_worker = 8
         self.rollout_fragment_length = 128
         self.seed = 0
+        # connector pipelines between env and module on the eager
+        # rollout paths (ray_tpu.rllib.connectors; reference:
+        # rllib/connectors/ agent+action pipelines)
+        self.observation_connectors = None
+        self.action_connectors = None
         # training
         self.lr = 5e-4
         self.gamma = 0.99
@@ -75,14 +80,26 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: int | None = None,
                  num_envs_per_worker: int | None = None,
-                 rollout_fragment_length: int | None = None):
+                 rollout_fragment_length: int | None = None,
+                 observation_connectors=None, action_connectors=None):
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if observation_connectors is not None:
+            self.observation_connectors = observation_connectors
+        if action_connectors is not None:
+            self.action_connectors = action_connectors
         return self
+
+    def connector_dict(self) -> dict | None:
+        if self.observation_connectors is None \
+                and self.action_connectors is None:
+            return None
+        return {"obs": self.observation_connectors,
+                "action": self.action_connectors}
 
     env_runners = rollouts      # new-stack alias in the reference
 

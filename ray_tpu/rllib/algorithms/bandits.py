@@ -19,7 +19,7 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import (
     Algorithm, AlgorithmConfig, register_algorithm)
-from ray_tpu.rllib.env.jax_env import JaxEnv, register_env
+from ray_tpu.rllib.env.jax_env import JaxEnv, is_jax_env, register_env
 from ray_tpu.rllib.env.spaces import Box, Discrete
 
 
@@ -84,6 +84,10 @@ class _LinearBandit(Algorithm):
 
     def setup(self, config: dict) -> None:
         super().setup(config)
+        if not is_jax_env(self.env):
+            raise ValueError(
+                "linear bandits need a JaxEnv (the interact loop is "
+                "jitted); wrap python envs")
         if not isinstance(self.env.action_space, Discrete):
             raise ValueError("bandits need a Discrete action space")
 

@@ -95,7 +95,8 @@ class IMPALA(Algorithm):
         self.workers = WorkerSet(
             max(1, cfg.num_rollout_workers), env_creator, module_creator,
             cfg.rollout_fragment_length, seed=cfg.seed,
-            num_cpus_per_worker=cfg.num_cpus_per_worker)
+            num_cpus_per_worker=cfg.num_cpus_per_worker,
+            connectors=cfg.connector_dict())
         self._update_fn = jax.jit(self._vtrace_update)
         # async pipeline: one in-flight sample per worker
         self._inflight: dict = {}

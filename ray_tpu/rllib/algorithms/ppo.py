@@ -120,7 +120,8 @@ class PPO(Algorithm):
                 max(1, cfg.num_rollout_workers), env_creator,
                 module_creator, cfg.rollout_fragment_length,
                 seed=cfg.seed,
-                num_cpus_per_worker=cfg.num_cpus_per_worker)
+                num_cpus_per_worker=cfg.num_cpus_per_worker,
+                connectors=cfg.connector_dict())
             self._update_fn = jax.jit(self._sgd_epochs)
 
     # -- fully-compiled iteration (JaxEnv path) ---------------------------

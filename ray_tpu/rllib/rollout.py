@@ -96,12 +96,23 @@ def episode_stats(traj) -> dict:
 
 
 class PythonEnvRunner:
-    """Eager sampler for gym-API Python envs (reset/step methods)."""
+    """Eager sampler for gym-API Python envs (reset/step methods).
 
-    def __init__(self, env, module, rollout_length: int, seed: int = 0):
+    `obs_connectors`/`action_connectors` (ray_tpu.rllib.connectors
+    pipelines) sit between env and module, the reference's
+    agent/action connector placement (rllib/connectors/): obs are
+    transformed before the policy sees them (and the TRANSFORMED obs
+    land in the batch — training must see what the policy saw); policy
+    outputs are transformed before the env steps, with the RAW policy
+    action recorded so logp stays consistent."""
+
+    def __init__(self, env, module, rollout_length: int, seed: int = 0,
+                 obs_connectors=None, action_connectors=None):
         self.env = env
         self.module = module
         self.rollout_length = rollout_length
+        self.obs_connectors = obs_connectors
+        self.action_connectors = action_connectors
         self._key = jax.random.PRNGKey(seed)
         self._obs = None
         self._ep_ret = 0.0
@@ -110,9 +121,15 @@ class PythonEnvRunner:
         self._episode_lens: list = []
         self._compute = jax.jit(self.module.compute_actions)
 
+    def _connect_obs(self, obs):
+        if self.obs_connectors is not None:
+            obs = self.obs_connectors(obs)
+        return obs
+
     def _reset_env(self):
         out = self.env.reset()
-        self._obs = out[0] if isinstance(out, tuple) else out
+        self._obs = self._connect_obs(
+            out[0] if isinstance(out, tuple) else out)
 
     def sample(self, params) -> Tuple[SampleBatch, float]:
         if self._obs is None:
@@ -124,8 +141,11 @@ class PythonEnvRunner:
             obs = np.asarray(self._obs, np.float32)
             a, logp, v = self._compute(params, obs[None], k)
             action = np.asarray(a)[0]
+            env_action = action
+            if self.action_connectors is not None:
+                env_action = np.asarray(self.action_connectors(action))
             out = self.env.step(
-                action.item() if action.ndim == 0 else action)
+                env_action.item() if env_action.ndim == 0 else env_action)
             if len(out) == 5:       # gymnasium-style
                 nxt, r, term, trunc, _ = out
                 done = bool(term or trunc)
@@ -145,7 +165,7 @@ class PythonEnvRunner:
                 self._ep_ret, self._ep_len = 0.0, 0
                 self._reset_env()
             else:
-                self._obs = nxt
+                self._obs = self._connect_obs(nxt)
         obs = np.asarray(self._obs, np.float32)
         _, _, last_v = self._compute(
             params, obs[None], jax.random.PRNGKey(0))

@@ -26,15 +26,28 @@ class RolloutWorker:
     """Actor body: env(s) + policy copy; produces SampleBatches."""
 
     def __init__(self, env_creator: Callable, module_creator: Callable,
-                 rollout_length: int, worker_index: int, seed: int):
+                 rollout_length: int, worker_index: int, seed: int,
+                 connectors: dict | None = None):
         env = env_creator(worker_index)
         from ray_tpu.rllib.env.jax_env import EagerJaxEnv, is_jax_env
         if is_jax_env(env):
             env = EagerJaxEnv(env, seed=seed + worker_index)
         self.module = module_creator(env)
-        self.runner = PythonEnvRunner(env, self.module, rollout_length,
-                                      seed=seed + worker_index)
+        connectors = connectors or {}
+        self.runner = PythonEnvRunner(
+            env, self.module, rollout_length, seed=seed + worker_index,
+            obs_connectors=connectors.get("obs"),
+            action_connectors=connectors.get("action"))
         self.params = None
+
+    def set_connector_state(self, state: dict) -> None:
+        """Sync learner-side connector state (e.g. a NormalizeObs
+        running filter) to this worker (reference: connector state in
+        sync_weights)."""
+        for key, st in state.items():
+            pipe = getattr(self.runner, f"{key}_connectors", None)
+            if pipe is not None:
+                pipe.set_state(st)
 
     def set_weights(self, params) -> None:
         self.params = params
@@ -64,11 +77,12 @@ class WorkerSet:
     def __init__(self, num_workers: int, env_creator: Callable,
                  module_creator: Callable, rollout_length: int,
                  seed: int = 0, num_cpus_per_worker: float = 1.0,
-                 max_restarts: int = 2):
+                 max_restarts: int = 2, connectors: dict | None = None):
         self.num_workers = num_workers
         self._make = lambda i: ray_tpu.remote(
             num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
-                env_creator, module_creator, rollout_length, i, seed)
+                env_creator, module_creator, rollout_length, i, seed,
+                connectors)
         self._workers: List = [self._make(i) for i in range(num_workers)]
         self._restarts = [0] * num_workers
         self.max_restarts = max_restarts
@@ -112,6 +126,12 @@ class WorkerSet:
     def sync_weights(self, params) -> None:
         params_ref = ray_tpu.put(_to_host(params))
         self.foreach_worker("set_weights", params_ref)
+
+    def sync_connector_states(self, state: dict) -> None:
+        """Push learner-side connector state (e.g. a NormalizeObs
+        running filter, keyed "obs"/"action" -> pipeline.state()) to
+        every worker (reference: connector state rides sync_weights)."""
+        self.foreach_worker("set_connector_state", state)
 
     def stop(self) -> None:
         for w in self._workers:

@@ -184,7 +184,8 @@ def cmd_start(args):
         # blocking readline)
         deadline = _time.time() + 60
         session = None
-        while session is None:
+        ready = False
+        while not ready:
             rem = deadline - _time.time()
             if rem <= 0:
                 print("head startup timed out", file=sys.stderr)
@@ -197,13 +198,10 @@ def cmd_start(args):
             if not line:          # EOF: the head died before readiness
                 print("head failed to start", file=sys.stderr)
                 sys.exit(1)
-            print(line, end="")
+            print(line, end="")   # relay the WHOLE banner (address/join)
             if line.startswith("ray_tpu head up: session="):
                 session = line.split("session=", 1)[1].strip()
-            if line.startswith("drive:") and session is None:
-                # older banner without the session line (shouldn't
-                # happen); stop relaying anyway
-                break
+            ready = line.startswith("drive:")
         # returned so callers (cmd_up) know EXACTLY which session this
         # head owns instead of guessing by mtime
         return session
@@ -261,6 +259,11 @@ def cmd_up(args):
     with open(args.file) as f:
         cfg = yaml.safe_load(f)
     name = cfg.get("cluster_name", "default")
+    if os.path.exists(_cluster_state_path(name)):
+        print(f"cluster {name!r} already has state "
+              f"({_cluster_state_path(name)}); run `ray_tpu down {name}` "
+              "first", file=sys.stderr)
+        sys.exit(1)
     head_cfg = cfg.get("head", {})
 
     # start the head detached (same path as `start --head`)

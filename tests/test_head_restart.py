@@ -36,3 +36,58 @@ def test_head_restart_survival(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=480)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "ALL-OK" in r.stdout
+
+
+def test_head_failover_from_snapshot_uri(tmp_path):
+    """Head FAILOVER: a replacement head in a brand-new session dir (a
+    different machine, in effect) restores cluster metadata from the
+    remote snapshot mirror (reference: Redis-backed GCS lets a restarted
+    GCS process recover state from outside the dead host)."""
+    script = r"""
+import os, sys, time
+import ray_tpu
+from ray_tpu._private.node import NodeServer
+
+uri = sys.argv[1]
+dir_a, dir_b = sys.argv[2], sys.argv[3]
+os.environ["RAY_TPU_HEAD_SNAPSHOT_URI"] = uri
+os.environ["RAY_TPU_HEAD_SNAPSHOT_INTERVAL_S"] = "0.2"
+
+# head A: create metadata, let a snapshot mirror land, die
+a = NodeServer({"CPU": 2.0}, dir_a, 0, standalone=True)
+a.kv[("ns", "k")] = b"survives-machines"
+a.named_actors["phoenix"] = "actor_00ff"
+from ray_tpu._private.node import _ActorState
+from ray_tpu._private import protocol
+spec = protocol.TaskSpec(
+    task_id="t1", function_id="f1", function_desc="Phoenix.__init__",
+    function_blob=b"", actor_id="actor_00ff", actor_creation=True,
+    actor_options={"name": "phoenix"})
+a.actors["actor_00ff"] = _ActorState(
+    actor_id="actor_00ff", creation_spec=spec, name="phoenix",
+    node="node_far", ready=True)
+time.sleep(1.0)                 # >= one snapshot tick
+import os as _os
+_os.kill(_os.getpid(), 0)       # (alive) — now simulate death by just
+a._shutdown = True              # stopping its loops; dir_a is NOT reused
+
+# head B: brand-new session dir, same snapshot URI
+b = NodeServer({"CPU": 2.0}, dir_b, 0, standalone=True)
+assert b.kv.get(("ns", "k")) == b"survives-machines", b.kv
+assert b.named_actors.get("phoenix") == "actor_00ff"
+st = b.actors["actor_00ff"]
+assert st.node == "node_far" and not st.dead
+b._shutdown = True
+print("FAILOVER-OK")
+"""
+    import uuid
+    uri = f"mem://headfail-{uuid.uuid4().hex[:8]}"
+    dir_a = str(tmp_path / "session_a")
+    dir_b = str(tmp_path / "session_b")
+    os.makedirs(dir_a)
+    os.makedirs(dir_b)
+    r = subprocess.run(
+        [sys.executable, "-c", script, uri, dir_a, dir_b],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "FAILOVER-OK" in r.stdout
