@@ -20,44 +20,91 @@ from ray_tpu.rllib.core.distributions import Categorical, DiagGaussian
 from ray_tpu.rllib.env.spaces import Box, Discrete
 
 
+_ACTIVATIONS = {"tanh": nn.tanh, "relu": nn.relu, "swish": nn.swish}
+
+
 class _MLPTorso(nn.Module):
     hiddens: Tuple[int, ...] = (64, 64)
     activation: str = "tanh"
 
     @nn.compact
     def __call__(self, x):
-        act = {"tanh": nn.tanh, "relu": nn.relu,
-               "swish": nn.swish}[self.activation]
+        act = _ACTIVATIONS[self.activation]
         for h in self.hiddens:
             x = act(nn.Dense(h)(x))
         return x
 
 
+# Default conv stack for [H, W, C] observations: the classic DQN-paper
+# architecture the reference catalog also defaults to for 84x84 inputs
+# (rllib/models/catalog.py conv_filters). (out_channels, kernel, stride).
+DEFAULT_CONV_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+
+class _ConvTorso(nn.Module):
+    """NHWC conv encoder -> flat features. Channel counts are multiples
+    of 8/16 so the MXU tiles convs cleanly (conv = implicit matmul)."""
+    filters: Tuple = DEFAULT_CONV_FILTERS
+    hiddens: Tuple[int, ...] = (256,)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        act = _ACTIVATIONS[self.activation]
+        for out, kernel, stride in self.filters:
+            x = act(nn.Conv(out, (kernel, kernel),
+                            strides=(stride, stride), padding="SAME")(x))
+        x = x.reshape(*x.shape[:-3], -1)
+        for h in self.hiddens:
+            x = act(nn.Dense(h)(x))
+        return x
+
+
+def build_torso(obs_shape: tuple, cfg: dict, default_activation: str,
+                name: str):
+    """Catalog seam (reference: `rllib/models/catalog.py` — pick the
+    encoder from the observation space): rank-3 [H, W, C] observations
+    get the conv stack, everything else the fcnet."""
+    if len(obs_shape) == 3:
+        return _ConvTorso(
+            tuple(tuple(f) for f in cfg.get("conv_filters",
+                                            DEFAULT_CONV_FILTERS)),
+            tuple(cfg.get("post_fcnet_hiddens", (256,))),
+            cfg.get("conv_activation", "relu"), name=name)
+    return _MLPTorso(tuple(cfg.get("fcnet_hiddens", (64, 64))),
+                     cfg.get("fcnet_activation", default_activation),
+                     name=name)
+
+
 class _PolicyValueNet(nn.Module):
     """Separate policy/value torsos (the reference's default fcnet with
-    vf_share_layers=False, `rllib/models/catalog.py`)."""
+    vf_share_layers=False, `rllib/models/catalog.py`); the torso kind
+    comes from the catalog (conv for image obs)."""
     num_outputs: int
-    hiddens: Tuple[int, ...] = (64, 64)
+    obs_shape: tuple = ()
+    model_config: dict = None
     activation: str = "tanh"
 
     @nn.compact
     def __call__(self, obs):
-        pi = _MLPTorso(self.hiddens, self.activation, name="pi")(obs)
+        cfg = self.model_config or {}
+        pi = build_torso(self.obs_shape, cfg, self.activation, "pi")(obs)
         logits = nn.Dense(self.num_outputs, name="pi_out",
                           kernel_init=nn.initializers.orthogonal(0.01))(pi)
-        vf = _MLPTorso(self.hiddens, self.activation, name="vf")(obs)
+        vf = build_torso(self.obs_shape, cfg, self.activation, "vf")(obs)
         value = nn.Dense(1, name="vf_out")(vf)[..., 0]
         return logits, value
 
 
 class _QNet(nn.Module):
     num_actions: int
-    hiddens: Tuple[int, ...] = (64, 64)
-    activation: str = "relu"
+    obs_shape: tuple = ()
+    model_config: dict = None
 
     @nn.compact
     def __call__(self, obs):
-        x = _MLPTorso(self.hiddens, self.activation)(obs)
+        x = build_torso(self.obs_shape, self.model_config or {},
+                        "relu", "q")(obs)
         return nn.Dense(self.num_actions)(x)
 
 
@@ -74,18 +121,17 @@ class RLModule:
         self.observation_space = observation_space
         self.action_space = action_space
         self.discrete = isinstance(action_space, Discrete)
-        self.hiddens = tuple(cfg.get("fcnet_hiddens", (64, 64)))
         self.activation = cfg.get("fcnet_activation", "tanh")
         if self.discrete:
             self.num_outputs = action_space.n
         else:
             self.num_outputs = int(np.prod(action_space.shape)) * 2
-        self.net = _PolicyValueNet(self.num_outputs, self.hiddens,
-                                   self.activation)
-        self._obs_dim = int(np.prod(observation_space.shape))
+        self._obs_shape = tuple(observation_space.shape)
+        self.net = _PolicyValueNet(self.num_outputs, self._obs_shape,
+                                   cfg, self.activation)
 
     def init(self, key) -> dict:
-        dummy = jnp.zeros((1, self._obs_dim))
+        dummy = jnp.zeros((1, *self._obs_shape))
         return self.net.init(key, dummy)["params"]
 
     def forward(self, params, obs):
@@ -120,13 +166,11 @@ class QModule:
         self.observation_space = observation_space
         self.action_space = action_space
         self.num_actions = action_space.n
-        self.net = _QNet(self.num_actions,
-                         tuple(cfg.get("fcnet_hiddens", (64, 64))),
-                         cfg.get("fcnet_activation", "relu"))
-        self._obs_dim = int(np.prod(observation_space.shape))
+        self._obs_shape = tuple(observation_space.shape)
+        self.net = _QNet(self.num_actions, self._obs_shape, cfg)
 
     def init(self, key) -> dict:
-        dummy = jnp.zeros((1, self._obs_dim))
+        dummy = jnp.zeros((1, *self._obs_shape))
         return self.net.init(key, dummy)["params"]
 
     def q_values(self, params, obs):

@@ -105,6 +105,8 @@ def softmax_xent(logits, targets):
     logits twice instead of log_softmax's materialize-then-gather (the
     logits tensor is the biggest array in an LM step — at GPT-2 bench
     shape it is 1.6 GB f32, so every avoided pass is ~2 ms of HBM)."""
+    logits = logits.astype(jnp.float32)   # no-op for f32; bf16 logits
+    #                                       upcast before the logsumexp
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jax.scipy.special.logsumexp(logits, axis=-1) - tgt
 

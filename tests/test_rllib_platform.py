@@ -289,3 +289,88 @@ def test_connector_state_syncs_to_workers(ray_session):
         assert obs.mean() < -1.0, obs.mean()
     finally:
         ws.stop()
+
+
+# ---------------------------------------------------------------------------
+# model catalog: conv encoders for image observations
+# (reference: rllib/models/catalog.py picks the net from the obs space)
+# ---------------------------------------------------------------------------
+
+def test_catalog_builds_conv_for_image_obs():
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.core.rl_module import QModule, RLModule
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    obs_space = Box(0.0, 1.0, (12, 12, 3))
+    mod = RLModule(obs_space, Discrete(4), {})
+    params = mod.init(jax.random.PRNGKey(0))
+    # conv kernels exist (catalog chose the conv torso, not an fcnet)
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    assert any("Conv" in jax.tree_util.keystr(p) for p, _ in flat), \
+        [jax.tree_util.keystr(p) for p, _ in flat][:6]
+    obs = jnp.ones((5, 12, 12, 3))
+    actions, logp, value = mod.compute_actions(
+        params, obs, jax.random.PRNGKey(1))
+    assert actions.shape == (5,) and value.shape == (5,)
+
+    q = QModule(obs_space, Discrete(4), {})
+    qp = q.init(jax.random.PRNGKey(0))
+    assert q.q_values(qp, obs).shape == (5, 4)
+
+
+class _ImageSeek:
+    """Tiny image env: the agent's pixel must reach the corner; obs is a
+    [8, 8, 1] grid. Exercises the conv path end-to-end in PPO's
+    in-graph sampler."""
+
+    def __init__(self, cfg=None):
+        import jax.numpy as jnp
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        self.observation_space = Box(0.0, 1.0, (8, 8, 1))
+        self.action_space = Discrete(4)
+        self._jnp = jnp
+
+    def _obs(self, pos):
+        jnp = self._jnp
+        grid = jnp.zeros((8, 8, 1))
+        return grid.at[pos[0], pos[1], 0].set(1.0)
+
+    def reset(self, key):
+        import jax
+        pos = jax.random.randint(key, (2,), 0, 8)
+        state = {"pos": pos, "t": self._jnp.asarray(0, "int32")}
+        return state, self._obs(pos)
+
+    def step(self, state, action, key):
+        jnp = self._jnp
+        delta = jnp.asarray([[0, 1], [0, -1], [1, 0], [-1, 0]])[action]
+        pos = jnp.clip(state["pos"] + delta, 0, 7)
+        t = state["t"] + 1
+        reached = (pos[0] == 7) & (pos[1] == 7)
+        done = reached | (t >= 32)
+        reward = jnp.where(reached, 1.0, -0.01)
+        reset_state, reset_obs = self.reset(key)
+        new = {"pos": jnp.where(done, reset_state["pos"], pos),
+               "t": jnp.where(done, reset_state["t"], t)}
+        obs = jnp.where(done, reset_obs, self._obs(pos))
+        return new, obs, reward, done, {}
+
+
+def test_ppo_conv_in_graph_smoke():
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.jax_env import JaxEnv
+
+    class Env(_ImageSeek, JaxEnv):
+        pass
+
+    algo = (PPOConfig().environment(Env)
+            .rollouts(num_envs_per_worker=8, rollout_fragment_length=32)
+            .training(train_batch_size=256, sgd_minibatch_size=128,
+                      num_sgd_iter=2)
+            .debugging(seed=0)
+            .build())
+    r = algo.train()
+    assert "episode_reward_mean" in r
+    import numpy as np
+    assert np.isfinite(r.get("policy_loss", 0.0))
