@@ -32,7 +32,9 @@ from ray_tpu.data.read_api import (
     read_numpy,
     read_parquet,
     read_text,
+    read_sql,
     read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -42,6 +44,8 @@ __all__ = [
     "from_arrow", "from_huggingface", "from_items", "from_numpy",
     "from_pandas", "from_torch", "range", "range_tensor",
     "read_binary_files", "read_csv", "read_datasource", "read_json",
-    "read_images", "read_numpy", "read_parquet", "read_text",
+    "read_images", "read_numpy", "read_parquet", "read_sql",
+    "read_text",
+    "read_webdataset",
     "read_tfrecords",
 ]

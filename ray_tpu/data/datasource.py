@@ -51,6 +51,16 @@ def _chunk(files: list, parallelism: int) -> list[list]:
             if bounds[i] < bounds[i + 1]]
 
 
+def _object_array(vals: list) -> np.ndarray:
+    """list -> 1-D object ndarray (ragged/bytes-safe; np.asarray would
+    coerce to fixed-width dtypes and e.g. strip trailing NULs from
+    bytes)."""
+    arr = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        arr[i] = v
+    return arr
+
+
 class Datasource:
     """Subclass hook-point (reference: `datasource.py` Datasource)."""
 
@@ -150,9 +160,7 @@ class ImageDatasource(FileBasedDatasource):
         if size is not None:
             col = np.stack(imgs)
         else:
-            col = np.empty(len(imgs), dtype=object)
-            for i, im in enumerate(imgs):
-                col[i] = im
+            col = _object_array(imgs)
         return {"image": col, "path": np.asarray(names, dtype=object)}
 
 
@@ -191,6 +199,140 @@ class TFRecordDatasource(FileBasedDatasource):
                     arr[i] = v
                 cols[k] = arr
         return cols
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """POSIX-tar shards in the webdataset layout (reference:
+    `data/datasource/webdataset_datasource.py`): files inside each tar
+    are grouped into samples by their basename ("abc.jpg" + "abc.cls" =
+    one sample with keys "jpg" and "cls"), decoded by extension:
+
+    - jpg/jpeg/png/ppm -> HWC uint8 arrays (PIL)
+    - cls/id           -> int
+    - txt              -> str
+    - json             -> parsed object
+    - npy              -> ndarray
+    - anything else    -> raw bytes
+
+    Rows carry "__key__" plus one column per extension. Pass
+    ``decode=False`` to get raw bytes for every entry."""
+
+    _IMAGE_EXTS = ("jpg", "jpeg", "png", "ppm")
+
+    def _decode(self, ext: str, data: bytes):
+        if not self._kwargs.get("decode", True):
+            return data
+        if ext in self._IMAGE_EXTS:
+            import io
+
+            from PIL import Image
+            with Image.open(io.BytesIO(data)) as im:
+                return np.asarray(im.convert(
+                    self._kwargs.get("mode", "RGB")))
+        if ext in ("cls", "id"):
+            return int(data.decode().strip())
+        if ext == "txt":
+            return data.decode()
+        if ext == "json":
+            import json as _json
+            return _json.loads(data)
+        if ext == "npy":
+            import io
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        return data
+
+    def _read_files(self, files):
+        import tarfile
+
+        rows: list[dict] = []
+        for path in files:
+            samples: dict[str, dict] = {}
+            order: list[str] = []
+            with tarfile.open(path) as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    base = os.path.basename(member.name)
+                    # webdataset groups by everything before the FIRST
+                    # dot: "000.seg.png" joins sample "000" as field
+                    # "seg.png" (compound extensions)
+                    key, _, ext = base.partition(".")
+                    data = tar.extractfile(member).read()
+                    if key not in samples:
+                        samples[key] = {"__key__": key}
+                        order.append(key)
+                    samples[key][ext.lower()] = self._decode(
+                        ext.lower(), data)
+            rows.extend(samples[k] for k in order)
+        cols: dict[str, list] = {}
+        for row in rows:
+            for k in row:
+                cols.setdefault(k, [])
+        for row in rows:
+            for k, acc in cols.items():
+                acc.append(row.get(k))
+        return {k: _object_array(vals) for k, vals in cols.items()}
+
+
+class SQLDatasource(Datasource):
+    """DBAPI-2 query results as rows (reference:
+    `data/datasource/sql_datasource.py` read_sql over a connection
+    factory). `connection_factory` must be picklable (e.g. a module-
+    level function returning sqlite3/psycopg connections) since read
+    tasks run in workers. Parallelism: the query runs once per shard
+    with OFFSET/LIMIT pagination when `shard_rows` is given (the query
+    MUST be deterministically ordered — put an ORDER BY on a unique key
+    or shards may duplicate/miss rows; the final shard is unbounded so
+    no row past num_shards*shard_rows is dropped), else as a single
+    task."""
+
+    def __init__(self, sql: str, connection_factory, shard_rows=None,
+                 num_shards: int = 1):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.shard_rows = shard_rows
+        self.num_shards = num_shards
+
+    def _fetch(self, sql: str):
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        cols = {}
+        for j, name in enumerate(names):
+            vals = [r[j] for r in rows]
+            if any(isinstance(v, bytes) for v in vals):
+                # np.asarray would make fixed-width "S" dtype and strip
+                # trailing NULs — silent BLOB corruption
+                cols[name] = _object_array(vals)
+                continue
+            try:
+                cols[name] = np.asarray(vals)
+            except ValueError:
+                cols[name] = _object_array(vals)
+        return cols
+
+    # last shard is unbounded so rows past num_shards*shard_rows are
+    # never silently dropped (2**62 is within every engine's LIMIT max)
+    _UNBOUNDED = 1 << 62
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        base_sql = self.sql.rstrip().rstrip(";")
+        if self.shard_rows is None:
+            return [ReadTask(lambda sql=base_sql: self._fetch(sql))]
+        tasks = []
+        for i in range(self.num_shards):
+            limit = (self.shard_rows if i < self.num_shards - 1
+                     else self._UNBOUNDED)
+            sharded = (f"{base_sql} LIMIT {limit} "
+                       f"OFFSET {i * self.shard_rows}")
+            tasks.append(ReadTask(
+                lambda sql=sharded: self._fetch(sql)))
+        return tasks
 
 
 class RangeDatasource(Datasource):

@@ -79,6 +79,25 @@ def read_images(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
                             parallelism)
 
 
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1,
+             shard_rows=None, num_shards: int = 1) -> Dataset:
+    """DBAPI query -> Dataset (reference: read_sql, data/read_api.py).
+    `connection_factory` must be picklable (module-level function or
+    functools.partial of one). Sharded reads (`shard_rows`) paginate
+    with OFFSET/LIMIT: give the query a deterministic ORDER BY."""
+    return read_datasource(
+        dsrc.SQLDatasource(sql, connection_factory,
+                           shard_rows=shard_rows, num_shards=num_shards),
+        parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Webdataset tar shards -> one row per sample (reference:
+    read_webdataset, data/read_api.py)."""
+    return read_datasource(dsrc.WebDatasetDatasource(paths, **kwargs),
+                           parallelism=parallelism)
+
+
 def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     """tf.train.Example records as columns (reference: read_tfrecords),
     decoded by the built-in proto codec — no tensorflow needed."""

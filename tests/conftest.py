@@ -25,6 +25,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (full tuned regressions)")
+
+
 @pytest.fixture(scope="session")
 def ray_session():
     """One shared local session for all tests (worker spawn is ~2s on the
