@@ -266,17 +266,27 @@ class NodeServer:
         with open(os.path.join(session_dir, "driver.pid"), "w") as f:
             f.write(str(os.getpid()))
 
-        # Reuse an existing session authkey (standalone head restarting
-        # into its old session dir: daemons and clients still hold the old
-        # key), else mint one. Persisted (0600) so external processes —
+        # Session authkey, in precedence order: operator-pinned env (a
+        # k8s Secret — head pod restarts keep the credential), an
+        # existing session file (standalone restart into the same dir),
+        # else freshly minted. Persisted (0600) so external processes —
         # the CLI, job drivers — can attach to this session (reference:
         # Redis password / GCS address in the session dir).
         keypath = os.path.join(session_dir, "authkey")
-        if standalone and os.path.exists(keypath):
+        env_key = os.environ.get("RAY_TPU_AUTHKEY") if standalone else None
+        on_disk = None
+        if os.path.exists(keypath):
             with open(keypath, "rb") as f:
-                self._authkey = f.read()
+                on_disk = f.read()
+        if env_key:
+            self._authkey = bytes.fromhex(env_key)
+        elif standalone and on_disk:
+            self._authkey = on_disk
         else:
             self._authkey = os.urandom(16)
+        if on_disk != self._authkey:
+            # write only on change: restarting heads must not truncate
+            # the file under clients that are mid-read retrying attach
             fd = os.open(keypath,
                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
             with os.fdopen(fd, "wb") as f:

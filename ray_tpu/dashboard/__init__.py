@@ -70,6 +70,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             path = self.path.split("?")[0].rstrip("/")
+            if path == "":
+                # minimal operator UI over the JSON APIs (the reference
+                # ships a React SPA; this is one static page)
+                import os as _os
+                page = _os.path.join(_os.path.dirname(
+                    _os.path.abspath(__file__)), "index.html")
+                with open(page, "r") as f:
+                    return self._send(200, f.read(), "text/html")
             if path == "/healthz":
                 return self._send(200, {"status": "ok"})
             if path == "/metrics":
