@@ -16,6 +16,8 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
+from ray_tpu.train.torch_trainer import TorchTrainer
+from ray_tpu.train.sklearn_trainer import SklearnTrainer
 
 # Session facade re-exports (reference: ray.air.session / ray.train.*)
 report = session.report
@@ -26,7 +28,8 @@ get_world_rank = session.get_world_rank
 get_mesh_spec = session.get_mesh_spec
 
 __all__ = [
-    "JaxTrainer", "Result", "TrainingFailedError", "Checkpoint",
+    "JaxTrainer", "TorchTrainer", "SklearnTrainer", "Result",
+    "TrainingFailedError", "Checkpoint",
     "Predictor", "JaxPredictor", "BatchPredictor",
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
     "session", "report", "get_checkpoint", "get_dataset_shard",

@@ -1,0 +1,79 @@
+"""SklearnTrainer — fit a scikit-learn estimator on a cluster worker.
+
+Counterpart of the reference's `train/sklearn/sklearn_trainer.py`: the
+estimator trains in ONE remote worker (sklearn is not data-parallel;
+`n_jobs` threads parallelize inside it), datasets materialize from
+ray_tpu.data, and the fitted estimator comes back as a dict checkpoint.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import Result
+
+
+def _fit_remote(estimator, datasets: dict, label_column: str,
+                score: bool):
+    import numpy as np
+
+    # materialize ON the worker (the driver never holds the rows)
+    blocks = {name: ds.take_all() if hasattr(ds, "take_all") else ds
+              for name, ds in datasets.items()}
+    # ONE canonical feature order shared by every split — per-split
+    # dict insertion order could silently misalign train vs valid
+    feats = sorted(k for k in blocks["train"][0] if k != label_column)
+
+    def to_xy(rows):
+        y = np.asarray([r[label_column] for r in rows])
+        x = np.column_stack([
+            np.asarray([r[k] for r in rows]) for k in feats])
+        return x, y
+
+    x, y = to_xy(blocks["train"])
+    t0 = time.time()
+    estimator.fit(x, y)
+    metrics = {"fit_time_s": time.time() - t0}
+    if score:
+        metrics["train_score"] = float(estimator.score(x, y))
+    if "valid" in blocks:
+        xv, yv = to_xy(blocks["valid"])
+        metrics["valid_score"] = float(estimator.score(xv, yv))
+    return estimator, metrics
+
+
+class SklearnTrainer:
+    def __init__(self, estimator, *, label_column: str,
+                 datasets: dict,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 score: bool = True):
+        self.estimator = estimator
+        self.label_column = label_column
+        self.datasets = dict(datasets)
+        self.scaling = scaling_config or ScalingConfig()
+        if self.scaling.num_workers > 1:
+            raise ValueError(
+                "SklearnTrainer fits on ONE worker (sklearn is not "
+                "data-parallel; use n_jobs inside the estimator and "
+                "CPU in resources_per_worker)")
+        self.run_config = run_config or RunConfig()
+        self.score = score
+
+    def fit(self) -> Result:
+        import ray_tpu
+        res = self.scaling.worker_resources()
+        fit = ray_tpu.remote(
+            num_cpus=res.get("CPU", 1.0))(_fit_remote)
+        try:
+            est, metrics = ray_tpu.get(
+                fit.remote(self.estimator, self.datasets,
+                           self.label_column, self.score),
+                timeout=3600)
+        except Exception as e:   # surface the worker traceback
+            return Result(error=repr(e))
+        return Result(metrics=metrics,
+                      checkpoint=Checkpoint.from_dict({"estimator": est}),
+                      metrics_history=[metrics])

@@ -1,0 +1,63 @@
+"""TorchTrainer — distributed PyTorch over the same worker-group spine.
+
+Counterpart of the reference's `train/torch/torch_trainer.py` +
+`train/torch/config.py` (rank-0 rendezvous, `dist.init_process_group`)
++ `train_loop_utils.py:75` (`prepare_model` DDP wrap): the worker group,
+session API (report/get_checkpoint/get_dataset_shard), checkpointing,
+and FailureConfig restarts are IDENTICAL to JaxTrainer — only the
+collective rendezvous differs (torch gloo instead of
+`jax.distributed.initialize`). gloo because these workers are CPU
+hosts: on this framework TPU compute belongs to the JAX path, and
+TorchTrainer covers torch-native workloads (data prep models,
+CPU fine-tunes, reference-parity training loops).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    _rendezvous_method = "setup_torch_distributed"
+    _always_rendezvous = True     # DDP needs a process group at world=1
+
+
+def prepare_model(model):
+    """Wrap for data-parallel gradient sync when world_size > 1
+    (reference: train_loop_utils.py:75 prepare_model -> DDP)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across workers with a DistributedSampler
+    (reference: train_loop_utils.py:116). The rebuilt loader keeps the
+    original's worker/pinning/seeding settings; loaders built with a
+    custom batch_sampler can't be re-sharded this way and are
+    rejected."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, DistributedSampler
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return loader
+    if loader.batch_size is None:
+        raise ValueError(
+            "prepare_data_loader cannot re-shard a DataLoader built "
+            "with a custom batch_sampler; construct it with batch_size "
+            "and let the sampler be replaced")
+    sampler = DistributedSampler(loader.dataset)
+    kwargs = dict(
+        batch_size=loader.batch_size, sampler=sampler,
+        num_workers=loader.num_workers, collate_fn=loader.collate_fn,
+        pin_memory=loader.pin_memory, drop_last=loader.drop_last,
+        timeout=loader.timeout, worker_init_fn=loader.worker_init_fn,
+        generator=loader.generator)
+    if loader.num_workers > 0:
+        kwargs["persistent_workers"] = loader.persistent_workers
+        if loader.prefetch_factor is not None:
+            kwargs["prefetch_factor"] = loader.prefetch_factor
+    return DataLoader(loader.dataset, **kwargs)

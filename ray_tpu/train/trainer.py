@@ -98,16 +98,23 @@ class JaxTrainer:
                                     placement_group=pg, env_vars={})
         return workers, pg
 
+    # subclass seam: which TrainWorker method performs the collective
+    # rendezvous, and whether a 1-worker group still needs one (torch DDP
+    # requires an initialized process group even at world_size=1)
+    _rendezvous_method = "setup_distributed"
+    _always_rendezvous = False
+
     def _setup_workers(self, workers, checkpoint):
         sc = self.scaling
         for w in workers:
             wait_for_actor_ready(w, timeout=180)
-        if sc.num_workers > 1:
+        if sc.num_workers > 1 or self._always_rendezvous:
             # Rendezvous address probed on worker 0's host, not the driver.
             coordinator = ray_tpu.get(
                 workers[0].get_coordinator_address.remote(), timeout=60)
-            ray_tpu.get([w.setup_distributed.remote(
-                coordinator, sc.num_workers, i)
+            ray_tpu.get([
+                getattr(w, self._rendezvous_method).remote(
+                    coordinator, sc.num_workers, i)
                 for i, w in enumerate(workers)], timeout=300)
         ray_tpu.get([
             w.start_training.remote(

@@ -57,6 +57,18 @@ class TrainWorker:
             process_id=process_id)
         return jax.device_count()
 
+    def setup_torch_distributed(self, coordinator: str,
+                                num_processes: int, process_id: int):
+        """torch.distributed rendezvous over gloo (the reference's
+        `_setup_torch_process_group`, train/torch/config.py:70-113;
+        gloo because these workers are CPU hosts — TPU compute runs
+        through the JAX backend instead of NCCL)."""
+        import torch.distributed as dist
+        dist.init_process_group(
+            "gloo", init_method=f"tcp://{coordinator}",
+            world_size=num_processes, rank=process_id)
+        return dist.get_world_size()
+
     def device_info(self):
         import jax
         return {"backend": jax.default_backend(),
