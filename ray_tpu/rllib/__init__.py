@@ -25,6 +25,7 @@ from ray_tpu.rllib.algorithms import (
     BCConfig, CQL, CQLConfig, DDPG, DDPGConfig, DQN, DQNConfig, IMPALA,
     IMPALAConfig, MAPPOConfig, MARWIL, MARWILConfig, MultiAgentPPO, PPO,
     PPOConfig, SAC, SACConfig, TD3, TD3Config, ES, ESConfig,
+    ApexDQN, ApexDQNConfig,
     LinTS, LinTSConfig, LinUCB, LinUCBConfig, get_algorithm_class,
     register_algorithm)
 from ray_tpu.rllib.env.jax_env import make_env, register_env
@@ -40,4 +41,5 @@ __all__ = [
     "DDPG", "DDPGConfig", "TD3", "TD3Config",
     "MultiAgentPPO", "MAPPOConfig", "MultiAgentJaxEnv", "CoopMatch",
     "ES", "ESConfig", "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
+    "ApexDQN", "ApexDQNConfig",
 ]

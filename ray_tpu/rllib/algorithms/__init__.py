@@ -13,6 +13,7 @@ from ray_tpu.rllib.algorithms.ddpg import (
     DDPG, DDPGConfig, TD3, TD3Config)
 from ray_tpu.rllib.algorithms.ma_ppo import MAPPOConfig, MultiAgentPPO
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
+from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.bandits import (
     LinTS, LinTSConfig, LinUCB, LinUCBConfig)
 
@@ -23,4 +24,5 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "BC", "BCConfig", "MARWIL", "MARWILConfig",
            "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "MultiAgentPPO", "MAPPOConfig", "ES", "ESConfig",
-           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig"]
+           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
+           "ApexDQN", "ApexDQNConfig"]

@@ -135,7 +135,8 @@ class PythonEnvRunner:
         if self._obs is None:
             self._reset_env()
         rows = {k: [] for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
-                                sb.ACTION_LOGP, sb.VF_PREDS)}
+                                sb.ACTION_LOGP, sb.VF_PREDS,
+                                sb.NEXT_OBS)}
         for _ in range(self.rollout_length):
             self._key, k = jax.random.split(self._key)
             obs = np.asarray(self._obs, np.float32)
@@ -166,6 +167,9 @@ class PythonEnvRunner:
                 self._reset_env()
             else:
                 self._obs = self._connect_obs(nxt)
+            # true successor for TD consumers (done rows are masked by
+            # (1-done) in targets, so the auto-reset obs is harmless)
+            rows[sb.NEXT_OBS].append(np.asarray(self._obs, np.float32))
         obs = np.asarray(self._obs, np.float32)
         _, _, last_v = self._compute(
             params, obs[None], jax.random.PRNGKey(0))

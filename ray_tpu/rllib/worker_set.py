@@ -32,7 +32,14 @@ class RolloutWorker:
         from ray_tpu.rllib.env.jax_env import EagerJaxEnv, is_jax_env
         if is_jax_env(env):
             env = EagerJaxEnv(env, seed=seed + worker_index)
-        self.module = module_creator(env)
+        import inspect
+        try:
+            takes_index = "worker_index" in inspect.signature(
+                module_creator).parameters
+        except (TypeError, ValueError):
+            takes_index = False
+        self.module = (module_creator(env, worker_index=worker_index)
+                       if takes_index else module_creator(env))
         connectors = connectors or {}
         self.runner = PythonEnvRunner(
             env, self.module, rollout_length, seed=seed + worker_index,

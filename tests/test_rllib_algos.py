@@ -328,3 +328,34 @@ def test_linucb_and_lints_low_regret():
             last = algo.train()
         assert last["mean_regret"] < 0.25 * rand_regret, \
             (cfg_cls.__name__, last, rand_regret)
+
+
+def test_apex_dqn_cartpole_learns(ray_session):
+    """Ape-X: actor fan-out with per-actor epsilons feeding one central
+    prioritized replay (reference: rllib/algorithms/apex_dqn/)."""
+    from ray_tpu.rllib.algorithms.apex_dqn import ApexDQNConfig
+
+    algo = (ApexDQNConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=128)
+            .training(learning_starts=256, train_batch_size=128,
+                      n_updates_per_iter=48,
+                      target_network_update_freq=200,
+                      model={"fcnet_hiddens": (64, 64)})
+            .debugging(seed=0)
+            .build())
+    try:
+        eps = algo._actor_epsilon
+        # the paper's diversity schedule: strictly decreasing epsilons
+        assert eps(0) > eps(1) > 0
+        best = 0.0
+        for _ in range(40):
+            r = algo.train()
+            rew = r.get("episode_reward_mean")
+            if rew == rew:
+                best = max(best, rew)
+            if best > 80:
+                break
+        assert best > 80, best
+        assert r["buffer_size"] > 0
+    finally:
+        algo.cleanup()
