@@ -226,6 +226,14 @@ OBJECT_REPLACEMENT_WAIT_S = define(
     "After an object's source died mid-pull, how long to wait for a "
     "promoted copy or lineage reconstruction to re-register it.")
 
+SCHEDULER_DISPATCH_WINDOW = define(
+    "SCHEDULER_DISPATCH_WINDOW", int, 64,
+    "Max non-dispatchable tasks one schedule pass examines before "
+    "leaving the rest queued (the pass rotates the examined prefix to "
+    "the back, so successive passes cover the whole backlog). Bounds "
+    "every scheduling event to O(window) instead of O(backlog) — the "
+    "reference caps its dispatch loop the same way.")
+
 FREED_REFS_CAP = define(
     "FREED_REFS_CAP", int, 100_000,
     "Bounded FIFO of freed object ids kept as tombstones so racing "

@@ -31,7 +31,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field, replace
 from multiprocessing import connection
 
@@ -200,6 +200,11 @@ class NodeServer:
 
         self.directory: dict[str, Descriptor] = {}
         self.obj_waiting_tasks: dict[str, list[_TaskState]] = {}
+        # counter-based get() waiters: oid -> [waiter dicts]; each
+        # registration decrements instead of every blocked get()
+        # rescanning its whole id list per wakeup (O(ids^2) for a
+        # 100k-ref ray.get otherwise)
+        self._get_waiters: dict[str, list] = {}
 
         # Distributed refcount state (reference: ReferenceCounter,
         # reference_count.h:61). An object is freed when: no process holds
@@ -218,7 +223,7 @@ class NodeServer:
         # bounded FIFO so a long session doesn't grow it forever
         self._args_released: "OrderedDict[str, bool]" = OrderedDict()
 
-        self.pending: list[_TaskState] = []
+        self.pending: "deque[_TaskState]" = deque()
         self.workers: dict[str, _WorkerConn] = {}
         self.actors: dict[str, _ActorState] = {}
         self.named_actors: dict[str, str] = {}
@@ -297,6 +302,9 @@ class NodeServer:
             os.unlink(self._address)
         if standalone:
             self._restore_state()
+        self._sched_event = threading.Event()
+        threading.Thread(target=self._scheduler_loop,
+                         name="ray_tpu-scheduler", daemon=True).start()
         self._listener = connection.Listener(
             family="AF_UNIX", address=self._address, authkey=self._authkey)
         self._accept_thread = threading.Thread(
@@ -1280,6 +1288,8 @@ class NodeServer:
         waiting = self.obj_waiting_tasks.pop(object_id, ())
         for t in waiting:
             t.deps.discard(object_id)
+        for waiter in self._get_waiters.pop(object_id, ()):
+            waiter["n"] -= 1
         self.cv.notify_all()
         return bool(waiting)
 
@@ -1299,11 +1309,14 @@ class NodeServer:
     def get_locations(self, object_ids, timeout=None, localize=True) -> dict:
         """Block until every id has a descriptor. With `localize` (the
         default), remote descriptors are pulled into the head's store first
-        so the returned locations are all readable here."""
+        so the returned locations are all readable here. Blocking rides a
+        COUNTER waiter that registrations decrement — a get() over 100k
+        refs costs O(ids), not O(ids) per wakeup."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self.cv:
             while True:
-                missing = [o for o in object_ids if o not in self.directory]
+                missing = [o for o in object_ids
+                           if o not in self.directory]
                 freed = [o for o in missing if o in self.freed_refs]
                 if freed:
                     raise ObjectFreedError(
@@ -1317,14 +1330,43 @@ class NodeServer:
                 if not missing:
                     locs = {o: self.directory[o] for o in object_ids}
                     break
-                if deadline is not None:
-                    rem = deadline - time.monotonic()
-                    if rem <= 0:
-                        raise GetTimeoutError(
-                            f"get() timed out waiting for {missing[:3]}...")
-                    self.cv.wait(rem)
-                else:
-                    self.cv.wait(1.0)
+                waiter = {"n": len(missing)}
+                for o in missing:
+                    self._get_waiters.setdefault(o, []).append(waiter)
+                try:
+                    while waiter["n"] > 0:
+                        if deadline is not None:
+                            rem = deadline - time.monotonic()
+                            if rem <= 0:
+                                raise GetTimeoutError(
+                                    f"get() timed out waiting for "
+                                    f"{missing[:3]}...")
+                            notified = self.cv.wait(min(rem, 1.0))
+                        else:
+                            notified = self.cv.wait(1.0)
+                        # freed/lost don't decrement; poll them on the
+                        # 1s TIMEOUT tick only — scanning the missing
+                        # list on every registration wakeup would be
+                        # O(ids) per completed task again
+                        if (not notified and waiter["n"] > 0 and any(
+                                o in self.freed_refs
+                                or o in self.lost_objects
+                                for o in missing
+                                if o not in self.directory)):
+                            break
+                finally:
+                    for o in missing:
+                        lst = self._get_waiters.get(o)
+                        if lst is not None:
+                            try:
+                                lst.remove(waiter)
+                            except ValueError:
+                                pass
+                            if not lst:
+                                self._get_waiters.pop(o, None)
+                # loop back: re-verify everything under the same lock
+                # (an object may have been freed between registration
+                # and this read — the outer while handles it)
         if localize:
             locs = self._localize(locs, deadline=deadline)
         return locs
@@ -2029,7 +2071,8 @@ class NodeServer:
                 self.actors[spec.actor_id] = a
                 if a.name:
                     self.named_actors[a.name] = spec.actor_id
-                self.pending.append(t)
+                if t.deps:
+                    self.pending.append(t)
             elif spec.actor_id is not None:
                 a = self.actors.get(spec.actor_id)
                 if a is None or a.dead:
@@ -2042,15 +2085,98 @@ class NodeServer:
                     return
                 a.queue.append(t)
             else:
+                if t.deps:
+                    self.pending.append(t)
+            had_deps = bool(t.deps)
+        if not had_deps:
+            self._submit_fastpath(t, spec)
+
+    def _submit_fastpath(self, t: _TaskState, spec) -> None:
+        """Dispatch attempt scoped to the JUST-submitted work instead of
+        rescanning the whole backlog (which turns a deep queue of
+        unschedulable tasks into O(n^2) submission — the reference's
+        submit path also only queue-and-schedules the new task,
+        cluster_task_manager.cc:44 QueueAndScheduleTask). Only called
+        for tasks with no deps at submit time (the task is NOT in
+        self.pending here, so no racing pass can double-dispatch it);
+        full scheduler passes drain the backlog on capacity events."""
+        if spec.actor_id is not None and not spec.actor_creation:
+            # actor method: pump just that actor's queue
+            to_send = []
+            with self.lock:
+                a = self.actors.get(spec.actor_id)
+                if a is not None:
+                    self._pump_actor(a, to_send)
+            for w, msg in to_send:
+                w.send(msg)
+            return
+        with self.lock:
+            if self._shutdown or t.cancelled:
+                return
+            to_send = []
+            if spec.actor_creation:
+                disp = self._try_dispatch_actor_creation(t, to_send)
+            else:
+                disp = self._try_dispatch_generic(t, to_send)
+            if disp is not True:
+                # False/"localizing": nothing to rescan — the backlog is
+                # unchanged. None: resources fit but no idle worker —
+                # the scheduler pass owns the spawn logic, wake it.
                 self.pending.append(t)
-        self._schedule()
+        for w, msg in to_send:
+            w.send(msg)
+        if disp is None:
+            self._schedule()
 
     def _schedule(self):
-        """Dispatch every runnable task. Called after any state change."""
-        to_send = []   # (worker, message) executed outside the lock
-        with self.lock:
+        """Signal the scheduler thread: dispatch work soon. Call sites
+        fire this after any capacity- or queue-changing event; the
+        dedicated thread coalesces bursts of signals into bounded
+        passes (reference: the raylet's ScheduleAndDispatchTasks loop
+        runs on its own io_service the same way,
+        cluster_task_manager.cc:130)."""
+        self._sched_event.set()
+
+    def _scheduler_loop(self):
+        """Run window-bounded passes until the backlog stops yielding
+        dispatches. The rotation in _schedule_pass walks a different
+        backlog segment each time, so continuation passes guarantee
+        every queued task is (re)examined without any single pass
+        paying O(backlog)."""
+        window = constants.SCHEDULER_DISPATCH_WINDOW
+        while not self._shutdown:
+            self._sched_event.wait(timeout=1.0)   # 1s tick = safety net
             if self._shutdown:
                 return
+            self._sched_event.clear()
+            futile = 0
+            while not self._shutdown:
+                try:
+                    dispatched, tripped = self._schedule_pass()
+                except Exception:
+                    logger.exception("scheduler pass failed")
+                    break
+                if self._sched_event.is_set():
+                    self._sched_event.clear()
+                    futile = 0
+                    continue        # new capacity arrived mid-pass
+                futile = 0 if dispatched else futile + 1
+                if not tripped:
+                    break           # whole backlog examined this pass
+                with self.lock:
+                    n = len(self.pending)
+                if futile * window >= n:
+                    break           # one full rotation, no progress
+            # wait for the next signal
+
+    def _schedule_pass(self):
+        """One bounded dispatch pass. -> (n_dispatched, window_tripped)."""
+        to_send = []   # (worker, message) executed outside the lock
+        n_dispatched = 0
+        tripped = False
+        with self.lock:
+            if self._shutdown:
+                return 0, False
             # --- generic + actor-creation tasks ---
             still = []
             want_spawn = 0
@@ -2059,7 +2185,28 @@ class NodeServer:
             # run at once (reference: prestart-on-backlog is similarly
             # resource-capped, node_manager.cc:1885).
             sim = dict(self.available)
-            for t in self.pending:
+            # Dispatch WINDOW: stop examining the queue after this many
+            # consecutive tasks fail to dispatch (cluster saturated).
+            # Without it every submit's schedule pass rescans the whole
+            # backlog and a 100k-task queue turns submission O(n^2) —
+            # the reference bounds its dispatch loop the same way
+            # (cluster_task_manager dispatch caps per iteration).
+            window = constants.SCHEDULER_DISPATCH_WINDOW
+            misses = 0
+            # Per-pass memo: once a PLAIN task (no affinity/PG) with
+            # resource shape R failed to dispatch, every later plain-R
+            # task in the same pass fails identically — skip the
+            # placement scan (the backlog is usually many copies of one
+            # shape, so this turns the rescan O(shapes), not O(tasks)).
+            # The deque scan is IN PLACE: examined-and-kept tasks go
+            # back to the front, the untouched tail never moves, so a
+            # pass costs O(window), not O(backlog).
+            unfit: dict = {}
+            examined = 0
+            n0 = len(self.pending)
+            while self.pending and examined < n0 and misses < window:
+                t = self.pending.popleft()
+                examined += 1
                 if t.cancelled:
                     continue
                 if t.deps:
@@ -2068,18 +2215,42 @@ class NodeServer:
                 if t.spec.actor_creation:
                     disp = self._try_dispatch_actor_creation(t, to_send)
                 else:
-                    disp = self._try_dispatch_generic(t, to_send)
-                    if disp:
+                    plain = (not t.spec.placement_group_id
+                             and not t.spec.scheduling_strategy)
+                    sig = (frozenset(t.spec.resources.items())
+                           if plain else None)
+                    if sig is not None and sig in unfit:
+                        disp = unfit[sig]
+                    else:
+                        disp = self._try_dispatch_generic(t, to_send)
+                        # memoize only SHAPE-level outcomes; "localizing"
+                        # is task-specific and must not poison the shape
+                        if sig is not None and (disp is False
+                                                or disp is None):
+                            unfit[sig] = disp
+                    if disp is True:
                         _sub(sim, t.spec.resources)
                     elif disp is None:   # resources fit but no idle worker
                         if _fits(sim, t.spec.resources):
                             _sub(sim, t.spec.resources)
                             want_spawn += 1
                         still.append(t)
+                        misses += 1
                         continue
-                if not disp:
+                if disp is True:
+                    n_dispatched += 1
+                else:
                     still.append(t)
-            self.pending = still
+                    misses += 1
+            tripped = misses >= window and bool(self.pending)
+            if tripped:
+                # window tripped with tasks left unexamined: ROTATE the
+                # examined-but-kept prefix to the back so successive
+                # passes walk different segments of the backlog (no
+                # starvation for shapes stuck behind other shapes)
+                self.pending.extend(still)
+            else:
+                self.pending.extendleft(reversed(still))
             # --- actor method calls ---
             for a in self.actors.values():
                 self._pump_actor(a, to_send)
@@ -2100,6 +2271,7 @@ class NodeServer:
                     self._on_node_death(w)
                 else:
                     self._on_worker_death(w)
+        return n_dispatched, tripped
 
     def _pick_node(self, spec) -> str | None:
         """Cluster scheduling policy (counterpart of
@@ -2282,7 +2454,7 @@ class NodeServer:
             self._lease_to_node(self.nodes[target], t, to_send)
             return True
         if self._needs_localize_locked(t):
-            return False
+            return "localizing"   # task-specific wait: NEVER memoized
         from ray_tpu._private.runtime_env import is_trivial
         if n_tpu > 0 or not is_trivial(t.spec.runtime_env):
             # TPU tasks need TPU_VISIBLE_CHIPS in the environment BEFORE the
@@ -2462,7 +2634,7 @@ class NodeServer:
                 if self._spawn_failures >= 3:
                     # Startup is systematically broken (bad env, missing
                     # package): fail queued work instead of a respawn storm.
-                    failed, self.pending = self.pending, []
+                    failed, self.pending = self.pending, deque()
                     for t in failed:
                         if not t.spec.actor_creation:
                             self._store_error(
@@ -2995,6 +3167,7 @@ class NodeServer:
             self._shutdown = True
             workers = list(self.workers.values())
             nodes = list(self.nodes.values())
+        self._sched_event.set()   # release the scheduler thread
         for node in nodes:
             node.alive = False
             node.send(protocol.KillNode())
