@@ -244,6 +244,11 @@ ARGS_RELEASED_CAP = define(
     "Bounded FIFO of task ids whose args were already released "
     "(exactly-once guard on the refcount decrement).")
 
+HEAD_BACKLOG_CAP = define(
+    "HEAD_BACKLOG_CAP", int, 10_000,
+    "Max daemon->head messages buffered during a head-channel blip for "
+    "replay after reconnect (completions must survive the window).")
+
 # --- control-plane timeouts / cadences ---
 
 HEAD_CONTROL_TIMEOUT_S = define(

@@ -19,6 +19,7 @@ listener and share its arena zero-copy, exactly like workers on the head.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import logging
@@ -110,6 +111,22 @@ class HostDaemon:
             family="AF_UNIX", address=self.address, authkey=self.authkey)
         self._head = netaddr.client(head_address, self.authkey)
         self._head_lock = threading.Lock()
+        # Reliable-delivery state for head-bound messages: a blip can
+        # swallow sends WITHOUT an exception (the first write into a
+        # half-closed TCP socket succeeds silently), so reliable messages
+        # are seq-wrapped (protocol.NodeSeq), retained in a bounded ring,
+        # and the whole ring is replayed after reconnect — the head
+        # dedupes on seq, so completions that land inside the blip window
+        # arrive exactly once.
+        self._send_seq = itertools.count(1)
+        self._sent_ring: collections.deque = collections.deque(
+            maxlen=constants.HEAD_BACKLOG_CAP)
+        # lease task id -> None while running, else the seq of its
+        # terminal message (NodeTaskDone/Failed/NodeActorDied). Reported
+        # in re-registration so the head can requeue leases the blip
+        # swallowed; entries whose terminal seq fell off the replay ring
+        # were delivered long ago and are pruned at reconnect.
+        self._live_leases: dict[str, int | None] = {}
         if tcp:
             # peer pulls dial us over TCP; bind an ephemeral port on the
             # interface that routes to the head and advertise host:port
@@ -121,7 +138,9 @@ class HostDaemon:
         else:
             self._peer_listener = None
             self.advertised_address = self.address
-        self._head_send(protocol.RegisterNode(
+        # raw (un-seq'd) send: RegisterNode must be the literal first
+        # message on the channel for the head to classify it
+        self._head.send(protocol.RegisterNode(
             node_id=node_id, pid=os.getpid(), resources=resources,
             num_tpu_chips=num_tpu_chips, address=self.advertised_address))
 
@@ -133,7 +152,8 @@ class HostDaemon:
         self._log_tailer = LogTailer(
             os.path.join(self.node_dir, "logs"),
             lambda src, lines: self._head_send(
-                protocol.LogBatch(src, self.node_id, lines))).start()
+                protocol.LogBatch(src, self.node_id, lines),
+                reliable=False)).start()
         if self._peer_listener is not None:
             threading.Thread(
                 target=self._accept_loop, args=(self._peer_listener,),
@@ -146,12 +166,49 @@ class HostDaemon:
     # channels
     # ------------------------------------------------------------------
 
-    def _head_send(self, msg) -> None:
+    def _head_send(self, msg, reliable: bool = True) -> int | None:
+        """Send to the head; returns the seq for reliable messages.
+        `reliable` messages (completions, object registrations, lifecycle
+        events) are seq-wrapped and retained for replay across channel
+        blips; lossy streams (LogBatch, PullChunk) pass `reliable=False`
+        and ride unwrapped. Outbound pull REQUESTS stay reliable on
+        purpose: a blip-swallowed request would hang the puller, while
+        the chunk REPLIES it triggers are the lossy part."""
         with self._head_lock:
+            if reliable:
+                msg = protocol.NodeSeq(next(self._send_seq), msg)
+                self._sent_ring.append(msg)
             try:
                 self._head.send(msg)
             except (OSError, ValueError, BrokenPipeError):
+                # reliable: already in the ring, replayed on reconnect;
+                # lossy: dropped by design
                 pass
+            return msg.seq if reliable else None
+
+    def _lease_terminal(self, task_id: str, seq: int | None) -> None:
+        """Record that `task_id`'s terminal message was sent with `seq`
+        (its outcome now rides the replay ring, not this table)."""
+        with self.lock:
+            if seq is None:
+                self._live_leases.pop(task_id, None)
+            elif task_id in self._live_leases:
+                self._live_leases[task_id] = seq
+            if len(self._live_leases) > 2 * constants.HEAD_BACKLOG_CAP:
+                # amortized bound: entries whose terminal fell off the
+                # replay ring were delivered long ago (self.lock ->
+                # _head_lock nesting is the one order used everywhere)
+                with self._head_lock:
+                    oldest = (self._sent_ring[0].seq
+                              if self._sent_ring else None)
+                for tid, s in list(self._live_leases.items()):
+                    if s is not None and (oldest is None or s < oldest):
+                        del self._live_leases[tid]
+
+    def _send_terminal(self, task_id: str, msg) -> None:
+        """Send a lease's terminal outcome and move its delivery guarantee
+        from the live-lease table to the replay ring."""
+        self._lease_terminal(task_id, self._head_send(msg))
 
     def head_loop(self):
         """Main thread: serve the head channel until it closes. A closed
@@ -186,16 +243,25 @@ class HostDaemon:
                 conn = netaddr.client(self.head_address, self.authkey)
             except Exception:
                 continue
-            with self._head_lock:
-                self._head = conn
             # fail every request proxied before the crash: the restarted
             # head has no record of those req ids, so waiting is forever
+            with self._head_lock:
+                oldest_seq = (self._sent_ring[0].seq
+                              if self._sent_ring else None)
             with self.lock:
                 proxied, self._proxy = self._proxy, {}
                 live_actors = {aid: {} for aid, w in self.actors.items()
                                if w.alive}
                 objects = {oid: self._tag(d)
                            for oid, d in self._objs.items()}
+                # prune leases whose terminal message fell off the replay
+                # ring — the head saw those long ago; what remains is
+                # every lease still running or whose outcome replays below
+                for tid, s in list(self._live_leases.items()):
+                    if s is not None and (oldest_seq is None
+                                          or s < oldest_seq):
+                        del self._live_leases[tid]
+                leases = list(self._live_leases)
             with self._ctl_cv:
                 for box in self._ctl.values():
                     box["error"] = "head restarted"
@@ -209,25 +275,47 @@ class HostDaemon:
                         "while this get() was in flight"))
                 else:
                     w.send(protocol.ErrorReply(wreq, "head restarted"))
-            self._head_send(protocol.RegisterNode(
+            register = protocol.RegisterNode(
                 node_id=self.node_id, pid=os.getpid(),
                 resources=self.resources, num_tpu_chips=self.num_tpu_chips,
                 address=self.advertised_address, actors=live_actors,
-                objects=objects))
-            logger.warning("re-registered with restarted head "
-                           "(%d actors, %d objects)",
-                           len(live_actors), len(objects))
+                objects=objects, leases=leases)
+            # RegisterNode must be the FIRST message on the new channel
+            # (the head classifies connections by it); then the retained
+            # seq ring replays in order — the head drops already-seen
+            # seqs, so messages swallowed by the blip (TCP reports no
+            # error on the first write into a half-closed socket) arrive
+            # exactly once. All under _head_lock so no concurrent
+            # _head_send can jump the replay.
+            with self._head_lock:
+                try:
+                    conn.send(register)
+                    for wrapped in self._sent_ring:
+                        conn.send(wrapped)
+                except (OSError, ValueError, BrokenPipeError):
+                    continue     # new conn died mid-handshake: retry
+                self._head = conn
+            logger.warning("re-registered with head "
+                           "(%d actors, %d objects, %d replayed)",
+                           len(live_actors), len(objects),
+                           len(self._sent_ring))
             return True
         return False
 
     def _handle_head(self, msg):
         if isinstance(msg, protocol.LeaseTask):
+            with self.lock:
+                self._live_leases[msg.spec.task_id] = None
             threading.Thread(target=self._run_lease, args=(msg,),
                              daemon=True).start()
         elif isinstance(msg, protocol.PullRequest):
+            # chunks are a lossy stream: the puller re-requests on stall,
+            # and retaining MB-sized chunks in the replay ring would
+            # balloon it
             threading.Thread(
                 target=self._serve_pull,
-                args=(self._head_send, msg), daemon=True).start()
+                args=(lambda m: self._head_send(m, reliable=False), msg),
+                daemon=True).start()
         elif isinstance(msg, protocol.PullChunk):
             self._pull_client.on_chunk(msg)
         elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
@@ -444,7 +532,7 @@ class HostDaemon:
             arg_locs = {oid: self._ensure_local(d)
                         for oid, d in lease.arg_locations.items()}
         except (ObjectLostError, OSError) as e:
-            self._head_send(protocol.NodeTaskFailed(
+            self._send_terminal(spec.task_id, protocol.NodeTaskFailed(
                 spec.task_id, f"dependency pull failed: {e}"))
             return
         if spec.actor_id is not None and not spec.actor_creation:
@@ -454,8 +542,10 @@ class HostDaemon:
                 while w is None or not w.alive:
                     rem = deadline - time.monotonic()
                     if rem <= 0 or self._shutdown:
-                        self._head_send(protocol.NodeTaskFailed(
-                            spec.task_id, "actor worker not on this node"))
+                        self._send_terminal(
+                            spec.task_id, protocol.NodeTaskFailed(
+                                spec.task_id,
+                                "actor worker not on this node"))
                         return
                     self.cv.wait(min(rem, 0.2))
                     w = self.actors.get(spec.actor_id)
@@ -467,11 +557,11 @@ class HostDaemon:
                 # actor lifecycle runs through NodeActorDied (a plain
                 # NodeTaskFailed for a creation task would strand the
                 # actor in PENDING forever on the head)
-                self._head_send(protocol.NodeActorDied(
+                self._send_terminal(spec.task_id, protocol.NodeActorDied(
                     spec.actor_id, f"runtime env setup failed: {e}"))
                 return
             if w is None:
-                self._head_send(protocol.NodeActorDied(
+                self._send_terminal(spec.task_id, protocol.NodeActorDied(
                     spec.actor_id, "actor worker failed to start"))
                 return
             w.actor_id = spec.actor_id
@@ -483,11 +573,11 @@ class HostDaemon:
                 w = self._spawn_worker("dedicated", lease.tpu_chips,
                                        spec.runtime_env)
             except RuntimeEnvSetupError as e:
-                self._head_send(protocol.NodeTaskFailed(
+                self._send_terminal(spec.task_id, protocol.NodeTaskFailed(
                     spec.task_id, f"runtime env setup failed: {e}"))
                 return
             if w is None:
-                self._head_send(protocol.NodeTaskFailed(
+                self._send_terminal(spec.task_id, protocol.NodeTaskFailed(
                     spec.task_id, "dedicated worker failed to start"))
                 return
         else:
@@ -503,7 +593,7 @@ class HostDaemon:
                 except RuntimeEnvSetupError:
                     w = None
                 if w is None:
-                    self._head_send(protocol.NodeTaskFailed(
+                    self._send_terminal(spec.task_id, protocol.NodeTaskFailed(
                         spec.task_id, "worker failed to start"))
                     return
         with self.lock:
@@ -563,7 +653,7 @@ class HostDaemon:
                 retire = w
             elif w.kind == "generic":
                 w.idle = True
-        self._head_send(protocol.NodeTaskDone(
+        self._send_terminal(msg.task_id, protocol.NodeTaskDone(
             task_id=msg.task_id, return_descs=tagged, error=msg.error,
             actor_ready=msg.actor_ready))
         if retire is not None:
@@ -598,11 +688,16 @@ class HostDaemon:
                 self.store.release_all_pins(pid)
         self._head_send(protocol.NodeWorkerGone(w.worker_id))
         if actor_id is not None:
-            self._head_send(protocol.NodeActorDied(
+            seq = self._head_send(protocol.NodeActorDied(
                 actor_id, "worker process died"))
+            # the actor-death notice is terminal for every lease that was
+            # running on the actor worker (the head requeues them through
+            # its actor restart path)
+            for tid in inflight:
+                self._lease_terminal(tid, seq)
         else:
             for tid in inflight:
-                self._head_send(protocol.NodeTaskFailed(
+                self._send_terminal(tid, protocol.NodeTaskFailed(
                     tid, "worker died while running task"))
 
     # ------------------------------------------------------------------

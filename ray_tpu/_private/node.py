@@ -168,6 +168,7 @@ class _RemoteNode:
     free_tpu_chips: list = field(default_factory=list)
     alive: bool = True
     inflight: dict = field(default_factory=dict)  # task_id -> _TaskState
+    last_seq: int = 0   # highest NodeSeq seen (dedupe for blip replays)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
     # duck-typing so the shared get/wait request handlers accept a node
     # channel in place of a _WorkerConn
@@ -846,8 +847,88 @@ class NodeServer:
             worker_id="node:" + reg.node_id)
         with self.lock:
             old = self.nodes.get(reg.node_id)
+            readopted_actors = set(reg.actors or {})
             if old is not None:
                 node.proc = old.proc
+                # seq dedupe spans registrations of the same daemon
+                # process: the replayed ring must not re-apply messages
+                # the old channel already delivered
+                node.last_seq = old.last_seq
+                # The superseded registration must never drive teardown:
+                # if its reader later sees EOF (channel blip + reconnect),
+                # _on_node_death would otherwise pass the alive-guard and
+                # rip down the LIVE node's actors/objects by node_id.
+                old.alive = False
+                # Migrate still-running leases: the daemon process
+                # survived the blip and will report their completion on
+                # the NEW channel — _on_node_task_done must find them
+                # here, and their resource holds must be re-debited from
+                # this fresh (fully-available) registration so the
+                # eventual release balances. PG-task CPU holds are
+                # covered by the whole-bundle re-debit below; a creating
+                # actor's hold is covered by the ready-actor re-attach
+                # below iff the daemon re-reported it.
+                # A lease ABSENT from reg.leases was swallowed by the
+                # blip (or its outcome already delivered): the daemon
+                # will never report it, so re-dispatch instead of
+                # migrating a wait-forever entry.
+                known = (None if reg.leases is None else set(reg.leases))
+                requeue = []
+                # SHARE the table (don't copy): an old-channel reader that
+                # passed the alive/seq guard just before this supersede
+                # applies its terminal against the same dict the new
+                # channel serves — with a copy, that in-flight apply would
+                # pop an orphaned table and the completion would be lost
+                # on both channels (its seq is already marked seen).
+                node.inflight = old.inflight
+                for tid, t in list(node.inflight.items()):
+                    spec = t.spec
+                    if known is not None and tid not in known:
+                        requeue.append(t)
+                        del node.inflight[tid]
+                        continue
+                    if spec.actor_creation:
+                        a = self.actors.get(spec.actor_id)
+                        if (a is not None
+                                and spec.actor_id not in readopted_actors
+                                and not spec.placement_group_id):
+                            _sub(node.available, a.resources)
+                    elif spec.actor_id is None \
+                            and not spec.placement_group_id:
+                        _sub(node.available, spec.resources)
+                    for chip in t.tpu_chips:
+                        if chip in node.free_tpu_chips:
+                            node.free_tpu_chips.remove(chip)
+                for t in requeue:
+                    # release credits the superseded object (discarded)
+                    # for node-pool holds and the persistent PG bundles
+                    # for PG holds — the new registration starts fully
+                    # available, so the books balance either way
+                    spec = t.spec
+                    if spec.actor_creation:
+                        a = self.actors.get(spec.actor_id)
+                        if a is None or a.dead:
+                            continue
+                        self._release_actor_resources(a)
+                        if t in a.inflight:
+                            a.inflight.remove(t)
+                        t.tpu_chips = []
+                        t.node = None
+                        self.task_events.requeued(spec)
+                        self.pending.append(t)
+                    elif spec.actor_id is not None:
+                        a = self.actors.get(spec.actor_id)
+                        if a is None or a.dead:
+                            continue
+                        if t in a.inflight:
+                            a.inflight.remove(t)
+                        t.node = None
+                        a.queue.insert(0, t)
+                    else:
+                        self._release_task_resources(t)
+                        t.node = None
+                        self.task_events.requeued(spec)
+                        self.pending.append(t)
             self.nodes[reg.node_id] = node
             # RE-registration after a head restart: re-attach the actors
             # still alive on that daemon and re-hold their resources +
@@ -895,7 +976,10 @@ class NodeServer:
         while True:
             try:
                 msg = conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
+                # TypeError: conn closed out from under us locally
+                # (mp.connection raises it instead of OSError); the death
+                # path must still run, never a silent reader crash
                 try:
                     self._on_node_death(node)
                 except Exception:
@@ -903,6 +987,20 @@ class NodeServer:
                                      node.node_id)
                 return
             try:
+                if isinstance(msg, protocol.NodeSeq):
+                    # Reliability envelope: drop blip-replay duplicates.
+                    # Under the lock, and only while THIS registration is
+                    # current: once superseded (alive=False, set under
+                    # the same lock that copies last_seq into the new
+                    # registration), late messages buffered on the old
+                    # channel are discarded here and owned by the new
+                    # channel's ring replay — otherwise a message applied
+                    # after the last_seq snapshot would be applied twice.
+                    with self.lock:
+                        if not node.alive or msg.seq <= node.last_seq:
+                            continue
+                        node.last_seq = msg.seq
+                    msg = msg.inner
                 self._handle_node(node, msg)
             except Exception:
                 logger.exception("error handling %r from node %s",
@@ -1030,9 +1128,18 @@ class NodeServer:
             return self.pubsub_publish(payload["channel"],
                                        payload["message"])
         if method == "pubsub_poll":
+            t = float(payload.get("timeout", 30.0))
+            # attach clients enforce a transport deadline
+            # (ATTACH_CONTROL_TIMEOUT_S) that a full-length server poll
+            # would race into a spurious ConnectionError on an idle
+            # channel; cap their blocking window safely below it
+            if w is not None and w.worker_id.startswith("attach_"):
+                # max() guards an env-shrunk ATTACH_CONTROL_TIMEOUT_S
+                # from turning long-polls into a busy loop
+                t = min(t, max(1.0,
+                               constants.ATTACH_CONTROL_TIMEOUT_S - 5.0))
             return self.pubsub_poll(payload["channel"],
-                                    int(payload.get("after", 0)),
-                                    float(payload.get("timeout", 30.0)))
+                                    int(payload.get("after", 0)), t)
         if method == "log_subscribe":
             return self._log_subscribe(w)
         if method == "list_logs":
@@ -1242,6 +1349,7 @@ class NodeServer:
         self.ref_holders.pop(oid, None)
         self.dead_pending.discard(oid)
         self.freed_refs[oid] = True
+        self._poke_get_waiters(oid)
         while len(self.freed_refs) > constants.FREED_REFS_CAP:
             self.freed_refs.popitem(last=False)
         origin = self.obj_origin.pop(oid, "driver")
@@ -1292,6 +1400,14 @@ class NodeServer:
             waiter["n"] -= 1
         self.cv.notify_all()
         return bool(waiting)
+
+    def _poke_get_waiters(self, oid: str) -> None:
+        """Flag blocked get()s that `oid` was freed/lost so they re-check
+        and raise promptly instead of waiting for a 1s timeout tick (which
+        registration wakeups can starve indefinitely). Caller holds lock."""
+        for waiter in self._get_waiters.get(oid, ()):
+            waiter["dirty"] = True
+        self.cv.notify_all()
 
     def register_object(self, object_id: str, desc: Descriptor,
                         origin: str = "driver"):
@@ -1344,10 +1460,13 @@ class NodeServer:
                             notified = self.cv.wait(min(rem, 1.0))
                         else:
                             notified = self.cv.wait(1.0)
-                        # freed/lost don't decrement; poll them on the
-                        # 1s TIMEOUT tick only — scanning the missing
-                        # list on every registration wakeup would be
-                        # O(ids) per completed task again
+                        # freed/lost don't decrement the counter; the
+                        # free/lost paths set `dirty` on registered
+                        # waiters so we re-check on any wakeup without
+                        # an O(ids) scan per registration wakeup. The
+                        # 1s-tick scan stays as a belt-and-braces path.
+                        if waiter.get("dirty"):
+                            break
                         if (not notified and waiter["n"] > 0 and any(
                                 o in self.freed_refs
                                 or o in self.lost_objects
@@ -1732,6 +1851,11 @@ class NodeServer:
         with self.lock:
             if not node.alive:
                 return
+            if self.nodes.get(node.node_id) is not node:
+                # a newer registration has replaced this object; only the
+                # current one may tear down node state
+                node.alive = False
+                return
             node.alive = False
             logger.warning("node %s died", node.node_id)
             inflight, node.inflight = dict(node.inflight), {}
@@ -1787,6 +1911,7 @@ class NodeServer:
                     rebuild_oids.append(oid)
                 else:
                     self.lost_objects[oid] = f"node {node.node_id} died"
+                    self._poke_get_waiters(oid)
                     lost_oids.append(oid)
             for oid, copies in list(self.copy_nodes.items()):
                 copies.pop(node.node_id, None)
@@ -1904,6 +2029,7 @@ class NodeServer:
                     failed.append((cur, "no lineage" if spec is None
                                    else f"exceeded {n} reconstructions"))
                     self.lost_objects[cur] = failed[-1][1]
+                    self._poke_get_waiters(cur)
                     continue
                 # one resubmit rebuilds ALL the task's returns
                 for rid in spec.return_ids:

@@ -238,6 +238,26 @@ class RegisterNode:
     address: str = ""            # daemon's own listener, for peer pulls
     actors: dict | None = None   # actor_id -> {} live on this node
     objects: dict | None = None  # oid -> tagged Descriptor sealed here
+    # On RE-register: every lease task id this daemon received and whose
+    # outcome the head will still learn (running, or terminal message
+    # retained in the NodeSeq replay ring). A lease the head holds
+    # inflight that is NOT listed was swallowed by the channel blip —
+    # the head must re-dispatch it instead of waiting forever.
+    leases: list | None = None
+
+
+@dataclass
+class NodeSeq:
+    """Daemon -> head reliability envelope. TCP gives no delivery
+    guarantee across a channel blip (the first send() into a half-closed
+    socket succeeds silently), so every reliable daemon->head message
+    carries a per-daemon monotone seq; the daemon retains a replay ring
+    and re-sends it after reconnect-and-reregister, and the head drops
+    seq <= last_seq duplicates. Lossy streams (LogBatch, PullChunk) ride
+    unwrapped. Reference analogue: gRPC request/retry semantics on the
+    raylet->GCS edges."""
+    seq: int
+    inner: object
 
 
 @dataclass

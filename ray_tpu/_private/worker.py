@@ -173,7 +173,11 @@ class BaseClient:
     def submit(self, spec) -> None:
         raise NotImplementedError
 
-    def control(self, method: str, payload=None):
+    def control(self, method: str, payload=None,
+                timeout: float | None = None):
+        # `timeout` is a client-side transport deadline; in-process and
+        # worker-channel clients have none and ignore it, the attach
+        # client uses it so long-polls (pubsub) can outlast its default.
         raise NotImplementedError
 
 
@@ -213,7 +217,7 @@ class DriverClient(BaseClient):
     def submit(self, spec):
         self.node.submit(spec)
 
-    def control(self, method, payload=None):
+    def control(self, method, payload=None, timeout=None):
         return self.node._control(method, payload, None)
 
 
@@ -243,7 +247,7 @@ class WorkerClient(BaseClient):
     def submit(self, spec):
         self.rt.submit_spec(spec)
 
-    def control(self, method, payload=None):
+    def control(self, method, payload=None, timeout=None):
         return self.rt.control(method, payload)
 
 

@@ -14,6 +14,7 @@ reference jobs start their own driver.
 from __future__ import annotations
 
 import os
+import re
 import shlex
 import subprocess
 import threading
@@ -42,6 +43,9 @@ class JobInfo:
 
     def to_dict(self) -> dict:
         return asdict(self)
+
+
+_JOB_ID_RE = re.compile(r"[A-Za-z0-9_.-]+")
 
 
 def _proc_start(pid: int) -> Optional[int]:
@@ -80,6 +84,19 @@ class JobManager:
     def _rc_path(self, job_id: str) -> str:
         return os.path.join(self.log_dir, f"{job_id}.rc")
 
+    def _pid_path(self, job_id: str) -> str:
+        return os.path.join(self.log_dir, f"{job_id}.pid")
+
+    def _read_pid(self, job_id: str) -> Optional[tuple]:
+        """(pid, start_ticks) the wrapper recorded, or None. start_ticks
+        is the pid-reuse-proof identity (/proc/<pid>/stat f22)."""
+        try:
+            with open(self._pid_path(job_id)) as f:
+                pid_s, start_s = f.read().split()
+                return int(pid_s), int(start_s)
+        except (OSError, ValueError):
+            return None
+
     def _persist(self, info: JobInfo) -> None:
         import json
         tmp = self._info_path(info.job_id) + ".tmp"
@@ -102,13 +119,23 @@ class JobManager:
                 # STARTING before Popen): safe to run now
                 self._exec(info)
             elif info.status == "STARTING":
-                # head died inside the launch window — the process may or
-                # may not exist, and we have no durable pid. Re-running
-                # could double-execute a non-idempotent entrypoint, so
-                # fail it (unless its rc already landed).
+                # head died inside the launch window. The wrapper writes
+                # its pid to a durable file as its very first act, so:
+                # rc landed -> finalize; pid landed and alive -> adopt;
+                # otherwise the process never got as far as the pid file
+                # (or died before writing rc) -> FAILED. Re-running could
+                # double-execute a non-idempotent entrypoint, never that.
                 rc = self._read_rc(info.job_id)
+                rec = self._read_pid(info.job_id)
+                live = rec is not None and _proc_start(rec[0]) == rec[1]
                 if rc is not None:
                     self._finalize(info.job_id, rc)
+                elif live:
+                    with self._lock:
+                        info.status = "RUNNING"
+                        info.pid, info.pid_start = rec
+                    self._persist(info)
+                    self._adopt(info)
                 else:
                     with self._lock:
                         info.status = "FAILED"
@@ -154,6 +181,11 @@ class JobManager:
                runtime_env: dict | None = None,
                metadata: dict | None = None) -> str:
         job_id = job_id or f"job_{uuid.uuid4().hex[:12]}"
+        # job_id lands in file paths and (quoted) shell text; constrain it
+        # so neither can be abused (reference: submission IDs are opaque)
+        if not _JOB_ID_RE.fullmatch(job_id):
+            raise ValueError(
+                f"invalid job_id {job_id!r}: must match [A-Za-z0-9_.-]+")
         with self._lock:
             if job_id in self._jobs:
                 raise ValueError(f"job {job_id!r} already exists")
@@ -185,8 +217,15 @@ class JobManager:
         logf = open(log_path, "ab")
         # subshell + rc file: the exit status survives a head restart
         # (a restarted head is no longer the parent and cannot wait())
-        wrapped = (f"({info.entrypoint}); _rc=$?; "
-                   f"echo $_rc > {self._rc_path(job_id)}; exit $_rc")
+        # pid file first: a restarted head can adopt (or kill) the
+        # process group even if the head died between Popen and _persist.
+        # Start ticks ride along as the pid-reuse-proof identity (the
+        # wrapper is /bin/sh, comm has no spaces, so f22 is field 22).
+        wrapped = (f"echo $$ $(awk '{{print $22}}' /proc/$$/stat) "
+                   f"> {shlex.quote(self._pid_path(job_id))}; "
+                   f"({info.entrypoint}); _rc=$?; "
+                   f"echo $_rc > {shlex.quote(self._rc_path(job_id))}; "
+                   f"exit $_rc")
         try:
             proc = subprocess.Popen(
                 wrapped, shell=True, stdout=logf, stderr=subprocess.STDOUT,

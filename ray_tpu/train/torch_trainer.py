@@ -40,7 +40,8 @@ def prepare_data_loader(loader):
     custom batch_sampler can't be re-sharded this way and are
     rejected."""
     import torch.distributed as dist
-    from torch.utils.data import DataLoader, DistributedSampler
+    from torch.utils.data import (DataLoader, DistributedSampler,
+                                  SequentialSampler)
     if not (dist.is_available() and dist.is_initialized()
             and dist.get_world_size() > 1):
         return loader
@@ -49,7 +50,12 @@ def prepare_data_loader(loader):
             "prepare_data_loader cannot re-shard a DataLoader built "
             "with a custom batch_sampler; construct it with batch_size "
             "and let the sampler be replaced")
-    sampler = DistributedSampler(loader.dataset)
+    # shuffle unless the ORIGINAL loader was sequential — a sequential
+    # eval loader must stay in-order, while any randomized sampler
+    # (RandomSampler, WeightedRandomSampler, custom) keeps shuffling
+    # (reference: train_loop_utils.py:408-410 `not SequentialSampler`)
+    shuffle = not isinstance(loader.sampler, SequentialSampler)
+    sampler = DistributedSampler(loader.dataset, shuffle=shuffle)
     kwargs = dict(
         batch_size=loader.batch_size, sampler=sampler,
         num_workers=loader.num_workers, collate_fn=loader.collate_fn,

@@ -54,10 +54,16 @@ class Subscriber:
 
     def poll(self, timeout: float = 30.0) -> List[Any]:
         """Long-poll: block up to `timeout` for new messages."""
+        # the client-side deadline (honored by transports that have one)
+        # sits strictly above the server-side poll; the head additionally
+        # caps attach-worker polls below ATTACH_CONTROL_TIMEOUT_S so an
+        # idle channel returns an empty batch instead of racing the
+        # transport timeout into a spurious ConnectionError
         last, msgs = _control()(
             "pubsub_poll",
             {"channel": self.channel, "after": self._cursor,
-             "timeout": timeout})
+             "timeout": timeout},
+            timeout=timeout + 10.0)
         if msgs:
             self._cursor = last
         return msgs

@@ -112,6 +112,82 @@ def test_tcp_cluster_end_to_end():
     assert "CLIENT-OK" in (r.stdout + r.stderr)
 
 
+_BLIP_DRIVER = """
+import sys, time
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private.worker import get_client
+
+c = Cluster(head_resources={"CPU": 1})
+nid = c.add_node({"CPU": 3, "left": 1, "right": 1})
+node = get_client().node
+
+@ray_tpu.remote(resources={"left": 1}, num_cpus=1)
+def slow():
+    import time as t
+    t.sleep(6)
+    return 7
+
+@ray_tpu.remote(resources={"right": 1}, num_cpus=1)
+def quick():
+    return 42
+
+ref = slow.remote()
+time.sleep(3.5)             # leased; worker spawned and running
+rn = node.nodes[nid]
+assert rn.inflight, "task not inflight on the daemon yet"
+
+# Half-open channel blip, worst-case ordering: the daemon reconnects and
+# re-registers BEFORE the head observes the old channel's EOF. The shim
+# delays the head's EOF handler past the re-registration; the daemon's
+# NodeTaskDone lands inside the blip window, where TCP swallows the
+# first write into a half-closed socket without an error.
+orig_death = node._on_node_death
+def late_death(n):
+    time.sleep(6)
+    orig_death(n)
+node._on_node_death = late_death
+rn.conn.close()
+
+# a lease dispatched INTO the dead channel: the daemon never receives
+# it, so its absence from the re-registration's lease list must requeue
+# it onto the new channel (without this it waits in inflight forever)
+ref2 = quick.remote()
+
+# the completion must arrive via the seq-ring replay on the new channel
+# and be found in the MIGRATED inflight table — either missing piece
+# hangs this get() forever
+assert ray_tpu.get(ref, timeout=60) == 7
+assert ray_tpu.get(ref2, timeout=60) == 42
+time.sleep(7)               # let the late EOF fire against the old object
+
+# the superseded registration's teardown must be a no-op: node alive,
+# resources balanced, and fresh work still runs there
+new_rn = node.nodes[nid]
+assert new_rn.alive and new_rn is not rn and not rn.alive
+assert new_rn.available.get("CPU") == 3.0, new_rn.available
+assert new_rn.available.get("left") == 1.0, new_rn.available
+assert new_rn.available.get("right") == 1.0, new_rn.available
+assert ray_tpu.get(slow.remote(), timeout=60) == 7
+c.shutdown()
+print("BLIP-OK")
+"""
+
+
+def test_channel_blip_replay_and_supersede():
+    """Daemon channel blip + reconnect: blip-window completions replay
+    exactly once (NodeSeq ring), the superseded registration's inflight
+    migrates, and its late EOF never tears down the live node."""
+    env = _tcp_env()
+    env["RAY_TPU_DAEMON_RECONNECT_GRACE_S"] = "30"
+    r = subprocess.run([sys.executable, "-c", _BLIP_DRIVER], env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-4000:]}"
+    assert "BLIP-OK" in r.stdout
+
+
 def _run_matrix(path: str, timeout: int):
     r = subprocess.run(
         [sys.executable, "-m", "pytest", path, "-x", "-q",

@@ -172,6 +172,21 @@ def _torch_ddp_loop(config):
     for other in gathered[1:]:
         assert torch.allclose(gathered[0], other, atol=1e-6), \
             "DDP ranks diverged: gradient sync did not happen"
+    # prepare_data_loader derives shuffling from the ORIGINAL sampler
+    # (reference: train_loop_utils.py:408-410): a sequential eval loader
+    # must stay in-order after sharding; a shuffle=True loader keeps
+    # shuffling. Regression for the silent shuffle=True default.
+    from torch.utils.data import DataLoader, TensorDataset
+    from ray_tpu.train.torch_trainer import prepare_data_loader
+    seq_ds = TensorDataset(torch.arange(16, dtype=torch.float32))
+    seq = prepare_data_loader(DataLoader(seq_ds, batch_size=2))
+    assert seq.sampler.shuffle is False
+    order = torch.cat([b[0] for b in seq])
+    assert torch.equal(order, order.sort().values), \
+        "sequential loader was silently shuffled by prepare_data_loader"
+    rnd = prepare_data_loader(
+        DataLoader(seq_ds, batch_size=2, shuffle=True))
+    assert rnd.sampler.shuffle is True
     session.report({
         "rank": rank,
         "world": dist.get_world_size(),
