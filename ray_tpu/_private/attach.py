@@ -75,7 +75,7 @@ class AttachClient:
         while True:
             try:
                 msg = self._conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 with self._have:
                     self._replies[-1] = None   # poison: connection gone
                     self._have.notify_all()

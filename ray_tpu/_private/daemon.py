@@ -139,10 +139,17 @@ class HostDaemon:
             self._peer_listener = None
             self.advertised_address = self.address
         # raw (un-seq'd) send: RegisterNode must be the literal first
-        # message on the channel for the head to classify it
-        self._head.send(protocol.RegisterNode(
-            node_id=node_id, pid=os.getpid(), resources=resources,
-            num_tpu_chips=num_tpu_chips, address=self.advertised_address))
+        # message on the channel for the head to classify it. A send
+        # failure here must NOT kill the daemon — head_loop's first recv
+        # fails the same way and drives reconnect-and-reregister.
+        try:
+            self._head.send(protocol.RegisterNode(
+                node_id=node_id, pid=os.getpid(), resources=resources,
+                num_tpu_chips=num_tpu_chips,
+                address=self.advertised_address))
+        except (OSError, ValueError, BrokenPipeError):
+            logger.warning("initial register send failed; deferring to "
+                           "the reconnect path")
 
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="daemon-accept").start()
@@ -219,7 +226,7 @@ class HostDaemon:
         while not self._shutdown:
             try:
                 msg = self._head.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 if self._reconnect_head():
                     continue
                 break
@@ -361,7 +368,7 @@ class HostDaemon:
     def _serve_conn(self, conn):
         try:
             reg = conn.recv()
-        except (EOFError, OSError):
+        except (EOFError, OSError, TypeError):
             return
         if isinstance(reg, protocol.RegisterWorker):
             with self.lock:
@@ -380,7 +387,7 @@ class HostDaemon:
             while True:
                 try:
                     msg = conn.recv()
-                except (EOFError, OSError):
+                except (EOFError, OSError, TypeError):
                     return
                 if isinstance(msg, protocol.PullRequest):
                     threading.Thread(target=self._serve_pull,
@@ -396,7 +403,7 @@ class HostDaemon:
         while True:
             try:
                 msg = w.conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 self._on_worker_death(w)
                 return
             try:
@@ -754,7 +761,7 @@ class HostDaemon:
             while True:
                 try:
                     msg = _c.recv()
-                except (EOFError, OSError):
+                except (EOFError, OSError, TypeError):
                     return
                 if isinstance(msg, protocol.PullChunk):
                     self._pull_client.on_chunk(msg)

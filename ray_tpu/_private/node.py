@@ -491,14 +491,22 @@ class NodeServer:
         StandardAutoscaler -> NodeProvider -> real HostDaemons."""
         from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
         from ray_tpu.autoscaler.load_metrics import LoadMetrics
-        from ray_tpu.autoscaler.node_provider import LocalDaemonNodeProvider
+        from ray_tpu.autoscaler.node_provider import make_node_provider
+        prov_spec = config.pop("provider", None) \
+            if isinstance(config, dict) else None
+        if prov_spec and prov_spec.get("type") == "gcp-tpu":
+            # booted slices need somewhere to register; the head is the
+            # only party that knows its own dialable address + authkey
+            prov_spec.setdefault("head_address",
+                                 self.tcp_address or self._address)
+            prov_spec.setdefault("authkey_hex", self._authkey.hex())
         with self.lock:
             if getattr(self, "_autoscaler", None) is not None:
                 raise RuntimeError("autoscaler already attached")
             self._load_metrics = LoadMetrics()
             self._pending_gangs: list = []
             self._autoscaler = StandardAutoscaler(
-                provider or LocalDaemonNodeProvider(self), config,
+                provider or make_node_provider(prov_spec, self), config,
                 self._load_metrics)
             self._autoscaler_err: str | None = None
             self._autoscaler_ts: float = 0.0
@@ -733,7 +741,7 @@ class NodeServer:
     def _serve_conn(self, conn, remote=False):
         try:
             reg = conn.recv()
-        except (EOFError, OSError):
+        except (EOFError, OSError, TypeError):
             return
         if isinstance(reg, protocol.RegisterNode):
             self._serve_node_conn(conn, reg)
@@ -762,7 +770,7 @@ class NodeServer:
         while True:
             try:
                 msg = w.conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 self._on_worker_death(w)
                 return
             try:

@@ -99,7 +99,7 @@ class WorkerRuntime:
         while True:
             try:
                 msg = self.conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
                 if self.exit_on_disconnect:
                     os._exit(0)
                 self.shutdown = True

@@ -1,0 +1,391 @@
+"""GCE TPU-VM node provider — `ray-tpu up` provisions real slices.
+
+Counterpart of the reference's GCP provider
+(`autoscaler/_private/gcp/node_provider.py:59` GCPNodeProvider,
+`gcp/node.py:547` GCPTPU resource wrapper, `gcp/config.py` bootstrap),
+redesigned around the one property that matters for TPU clusters: a TPU
+slice is ONE API resource (`projects.locations.nodes`) that the platform
+materializes as N hosts atomically. Gang semantics (SURVEY §7.4#3,
+"whole-slice atomicity") therefore fall out of the API: one
+`create_node` call per slice either yields every host of a v5e-16 or
+nothing — there is no partial-slice state to reconcile, unlike the
+reference's per-instance GCE path.
+
+Transport: the provider speaks the TPU REST surface
+(https://tpu.googleapis.com/v2) through an injectable `HttpClient` so
+tests (and air-gapped environments) can point it at a fake server with
+`provider: {type: gcp-tpu, api_endpoint: "http://127.0.0.1:PORT"}`.
+Auth is resolved lazily: explicit token in the provider config, then
+`google.auth` application-default credentials, then the GCE metadata
+server — never at import time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (
+    TAG_NODE_STATUS,
+    NodeProvider,
+)
+
+logger = logging.getLogger("ray_tpu.gcp_tpu")
+
+# TPU node lifecycle states (REST: projects.locations.nodes.state).
+# "non-terminated" for the autoscaler's purposes = anything that still
+# holds (or will hold) capacity; PREEMPTED/TERMINATED slices are gone.
+_LIVE_STATES = frozenset({
+    "CREATING", "READY", "RESTARTING", "REPAIRING", "STARTING", "STOPPED",
+    "STOPPING",
+})
+
+# GCP label values: lowercase letters, digits, dash/underscore, <=63.
+_LABEL_SANITIZE = re.compile(r"[^a-z0-9_-]")
+
+# transient HTTP statuses worth retrying (quota, races, server blips)
+_RETRY_STATUSES = frozenset({429, 500, 502, 503})
+
+
+def _to_label(value: str) -> str:
+    return _LABEL_SANITIZE.sub("-", str(value).lower())[:63]
+
+
+class HttpClient:
+    """Minimal JSON-over-HTTP seam. Tests substitute their own instance
+    (or just an `api_endpoint` at a fake server); prod uses this one."""
+
+    def __init__(self, token_source=None):
+        self._token_source = token_source
+        self._creds = None          # cached google.auth credentials
+        self._meta_token = None     # (token, expiry_ts) via metadata
+
+    def request(self, method: str, url: str, body: dict | None = None,
+                timeout: float = 30.0):
+        """-> (status_code, parsed_json_or_{}). Network errors raise."""
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        tok = self._token()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = resp.read()
+                return resp.status, (json.loads(payload) if payload else {})
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                parsed = json.loads(payload) if payload else {}
+            except ValueError:
+                parsed = {"raw": payload.decode("utf-8", "replace")}
+            return e.code, parsed
+
+    def _token(self) -> Optional[str]:
+        """Bearer token, cached until near expiry — the autoscaler polls
+        the API every few seconds and must not pay (or rate-limit) an
+        OAuth round trip per request."""
+        if self._token_source is not None:
+            return self._token_source()
+        try:
+            if self._creds is None:
+                import google.auth
+                self._creds, _ = google.auth.default(
+                    scopes=["https://www.googleapis.com/auth/"
+                            "cloud-platform"])
+            if not self._creds.valid:
+                import google.auth.transport.requests
+                self._creds.refresh(
+                    google.auth.transport.requests.Request())
+            return self._creds.token
+        except Exception:
+            self._creds = None
+        try:
+            tok, exp = self._meta_token or (None, 0)
+            if tok and time.time() < exp - 60:
+                return tok
+            req = urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/"
+                "instance/service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                payload = json.loads(resp.read())
+            self._meta_token = (payload["access_token"],
+                                time.time() + payload.get("expires_in", 0))
+            return self._meta_token[0]
+        except Exception:
+            return None
+
+
+def bootstrap_gcp_tpu(provider_cfg: dict) -> dict:
+    """Validate + default-fill a `provider: {type: gcp-tpu, ...}` block
+    (the reference's `gcp/config.py` bootstrap, minus IAM mutation —
+    TPU-VM service accounts come pre-scoped; we refuse to silently edit
+    project IAM from a laptop). Returns a normalized copy."""
+    cfg = dict(provider_cfg)
+    missing = [k for k in ("project_id", "zone") if not cfg.get(k)]
+    if missing:
+        raise ValueError(
+            f"provider gcp-tpu requires {missing} (cluster YAML "
+            "provider: {type: gcp-tpu, project_id: ..., zone: ...})")
+    cfg.setdefault("api_endpoint", "https://tpu.googleapis.com")
+    cfg.setdefault("api_version", "v2")
+    cfg.setdefault("operation_poll_interval_s", 5.0)
+    cfg.setdefault("operation_timeout_s", 1800.0)   # slices take a while
+    cfg.setdefault("max_retries", 5)
+    return cfg
+
+
+class TpuVmNodeProvider(NodeProvider):
+    """NodeProvider over TPU-VM slices: provider node id == TPU node
+    name (the last path segment). One provider node is one SLICE; the
+    hosts inside it each run a HostDaemon that joins the head via the
+    startup script, so cluster membership can exceed provider-node count
+    — the autoscaler reasons in slices, the scheduler in hosts, which is
+    exactly the two-level split TPU gang placement wants."""
+
+    def __init__(self, provider_cfg: dict, cluster_name: str = "default",
+                 http: HttpClient | None = None):
+        cfg = bootstrap_gcp_tpu(provider_cfg)
+        self.cfg = cfg
+        self.cluster_name = _to_label(cluster_name)
+        token = cfg.get("token")
+        self.http = http or HttpClient(
+            token_source=(lambda: token) if token else None)
+        self._base = (f"{cfg['api_endpoint']}/{cfg['api_version']}/projects/"
+                      f"{cfg['project_id']}/locations/{cfg['zone']}")
+        self._lock = threading.Lock()
+        self._cache: dict[str, dict] = {}    # node name -> last API view
+        self._counter = int(time.time()) % 100000
+
+    # ---- REST plumbing ------------------------------------------------
+
+    def _call(self, method: str, path: str, body: dict | None = None):
+        """Request with bounded retry on transient statuses; raises
+        RuntimeError on terminal API errors."""
+        url = f"{self._base}{path}" if path.startswith("/") else path
+        delay = 1.0
+        for attempt in range(int(self.cfg["max_retries"])):
+            status, payload = self.http.request(method, url, body)
+            if status < 300:
+                return payload
+            if status in _RETRY_STATUSES:
+                logger.warning("TPU API %s %s -> %s (attempt %d), retrying",
+                               method, path, status, attempt + 1)
+                time.sleep(delay)
+                delay = min(delay * 2, 30.0)
+                continue
+            raise RuntimeError(
+                f"TPU API {method} {path} failed: {status} "
+                f"{payload.get('error', payload)}")
+        raise RuntimeError(
+            f"TPU API {method} {path}: exhausted "
+            f"{self.cfg['max_retries']} retries (last status {status})")
+
+    def _wait_operation(self, op: dict) -> dict:
+        """Block until a long-running operation completes; returns its
+        response. Gang atomicity surfaces here: a slice create either
+        finishes READY (all hosts exist) or the operation reports an
+        error and NO node remains."""
+        name = op.get("name")
+        if not name or op.get("done"):
+            return self._op_result(op)
+        deadline = time.monotonic() + float(self.cfg["operation_timeout_s"])
+        # operation names are full resource paths
+        url = f"{self.cfg['api_endpoint']}/{self.cfg['api_version']}/{name}"
+        while time.monotonic() < deadline:
+            op = self._call("GET", url)
+            if op.get("done"):
+                return self._op_result(op)
+            time.sleep(float(self.cfg["operation_poll_interval_s"]))
+        raise RuntimeError(f"TPU operation {name} timed out")
+
+    @staticmethod
+    def _op_result(op: dict) -> dict:
+        if op.get("error"):
+            raise RuntimeError(f"TPU operation failed: {op['error']}")
+        return op.get("response", {})
+
+    # ---- NodeProvider verbs -------------------------------------------
+
+    def _list_nodes(self) -> list[dict]:
+        out, page = [], None
+        while True:
+            path = "/nodes" + (f"?pageToken={page}" if page else "")
+            resp = self._call("GET", path)
+            out.extend(resp.get("nodes", []))
+            page = resp.get("nextPageToken")
+            if not page:
+                return out
+
+    @staticmethod
+    def _short(name: str) -> str:
+        return name.rsplit("/", 1)[-1]
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        want = {_to_label(k): _to_label(v) for k, v in tag_filters.items()}
+        out = []
+        with self._lock:
+            self._cache.clear()
+            for n in self._list_nodes():
+                if n.get("state") not in _LIVE_STATES:
+                    continue
+                labels = n.get("labels", {})
+                if labels.get("ray-tpu-cluster") != self.cluster_name:
+                    continue
+                if all(labels.get(k) == v for k, v in want.items()):
+                    nid = self._short(n["name"])
+                    self._cache[nid] = n
+                    out.append(nid)
+        return out
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        n = self._get(node_id)
+        return dict(n.get("labels", {})) if n else {}
+
+    def create_node(self, node_config: dict, tags: Dict[str, str],
+                    count: int) -> None:
+        """One API create per SLICE (gang-atomic). `node_config` carries
+        the TPU node body fields (accelerator_type, runtime_version,
+        optional startup_script / network / extra body passthrough)."""
+        body_base = {
+            "acceleratorType": node_config.get(
+                "accelerator_type", node_config.get("acceleratorType")),
+            "runtimeVersion": node_config.get(
+                "runtime_version",
+                node_config.get("runtimeVersion", "tpu-ubuntu2204-base")),
+        }
+        if not body_base["acceleratorType"]:
+            raise ValueError(
+                "gcp-tpu node_config needs accelerator_type "
+                "(e.g. v5litepod-8)")
+        extra = node_config.get("body") or {}
+        body_base.update(extra)
+        # tag round-tripping through GCP labels must be lossless: the
+        # autoscaler compares node_tags() values verbatim against config
+        # node-type names, so a name that sanitization would rewrite
+        # (uppercase, '.') would silently miscount workers and churn
+        # real billed slices — refuse it up front
+        bad = [v for v in tags.values() if _to_label(str(v)) != str(v)]
+        if bad:
+            raise ValueError(
+                f"gcp-tpu requires label-safe tag values "
+                f"(lowercase [a-z0-9_-], <=63 chars); offending: {bad} — "
+                "rename the node type in available_node_types")
+        labels = {_to_label(k): _to_label(v) for k, v in tags.items()}
+        labels["ray-tpu-cluster"] = self.cluster_name
+        labels[_to_label(TAG_NODE_STATUS)] = "up-to-date"
+        body_base["labels"] = labels
+        script = node_config.get("startup_script")
+        if not script and self.cfg.get("head_address"):
+            # default join path: every host of the slice starts a
+            # HostDaemon against the head that launched it. num_tpus
+            # omitted -> per-host chip auto-detection; custom resources
+            # (anything beyond CPU/TPU) are forwarded explicitly.
+            declared = dict(node_config.get("resources") or {})
+            custom = {k: v for k, v in declared.items()
+                      if k not in ("CPU", "TPU")}
+            ntpus = node_config.get("num_tpus")
+            script = default_startup_script(
+                self.cfg["head_address"],
+                self.cfg.get("authkey_hex", ""),
+                num_tpus=None if ntpus is None else int(ntpus),
+                custom_resources=custom or None)
+        if script:
+            meta = dict(body_base.get("metadata") or {})
+            meta["startup-script"] = script
+            body_base["metadata"] = meta
+        if node_config.get("preemptible"):
+            body_base.setdefault("schedulingConfig", {})["preemptible"] = \
+                True
+        ops = []
+        with self._lock:
+            start = self._counter
+            self._counter += count
+        from ray_tpu.autoscaler.node_provider import TAG_NODE_TYPE
+        ntype = labels.get(_to_label(TAG_NODE_TYPE), "worker")
+        for i in range(count):
+            # resource NAMES are stricter than labels (no underscores)
+            node_id = re.sub(r"[^a-z0-9-]", "-", (
+                f"ray-tpu-{self.cluster_name}-{ntype}-{start + i}"))
+            op = self._call("POST", f"/nodes?nodeId={node_id}", body_base)
+            ops.append((node_id, op))
+        # block until every slice materializes (or surfaces its error):
+        # the autoscaler's update loop is already off-thread, and "create
+        # returned" meaning "capacity exists" keeps its accounting honest
+        errs = []
+        for node_id, op in ops:
+            try:
+                self._wait_operation(op)
+            except RuntimeError as e:
+                errs.append(f"{node_id}: {e}")
+        if errs:
+            raise RuntimeError(
+                "slice creation failed: " + "; ".join(errs))
+
+    def terminate_node(self, node_id: str) -> None:
+        op = self._call("DELETE", f"/nodes/{node_id}")
+        # deletion can run async; the next non_terminated_nodes pass sees
+        # DELETING and drops it, so no need to block here
+        with self._lock:
+            self._cache.pop(node_id, None)
+
+    def is_running(self, node_id: str) -> bool:
+        n = self._get(node_id, refresh=True)
+        return bool(n) and n.get("state") == "READY"
+
+    def internal_ip(self, node_id: str) -> Optional[str]:
+        n = self._get(node_id)
+        for ep in (n or {}).get("networkEndpoints", []):
+            if ep.get("ipAddress"):
+                return ep["ipAddress"]
+        return None
+
+    def _get(self, node_id: str, refresh: bool = False) -> Optional[dict]:
+        with self._lock:
+            cached = self._cache.get(node_id)
+        if cached is not None and not refresh:
+            return cached
+        status, payload = self.http.request(
+            "GET", f"{self._base}/nodes/{node_id}")
+        if status == 404:
+            return None
+        if status >= 300:
+            raise RuntimeError(
+                f"TPU API GET nodes/{node_id} failed: {status}")
+        with self._lock:
+            self._cache[node_id] = payload
+        return payload
+
+
+def default_startup_script(head_address: str, authkey_hex: str,
+                           num_tpus: int | None = None,
+                           custom_resources: dict | None = None,
+                           extra: str = "") -> str:
+    """Startup script run on EVERY host of the slice: join the head as a
+    HostDaemon. The TPU platform executes it per-worker, which is how one
+    provider node fans out into N cluster nodes. When `num_tpus` is None
+    the host auto-detects its local chips (`start` runs
+    `_detect_tpu_chips()` when the flag is absent) — the right default on
+    a real TPU-VM; custom resources the node type declared ride along so
+    the hosts advertise what the autoscaler planned for."""
+    join = (f"python3 -m ray_tpu.scripts.cli start "
+            f"--address {head_address}")
+    if num_tpus is not None:
+        join += f" --num-tpus {int(num_tpus)}"
+    if custom_resources:
+        import shlex
+        join += f" --resources {shlex.quote(json.dumps(custom_resources))}"
+    return "\n".join([
+        "#!/bin/bash",
+        "set -e",
+        extra or "true",
+        f"export RAY_TPU_AUTHKEY={authkey_hex}",
+        join + " --block &",
+    ])

@@ -42,6 +42,25 @@ class NodeProvider:
         return None
 
 
+def make_node_provider(spec: dict | None, node_server=None) -> NodeProvider:
+    """Build a provider from a config spec. The head constructs providers
+    from `attach_autoscaler` payloads — provider INSTANCES never cross a
+    process boundary, so cluster YAML / control payloads carry
+    `provider: {type: ..., ...}` instead (reference: `provider.type` in
+    cluster configs resolved by `autoscaler/_private/providers.py`)."""
+    spec = dict(spec or {})
+    kind = spec.pop("type", "local")
+    if kind == "local":
+        return LocalDaemonNodeProvider(node_server)
+    if kind == "fake":
+        return FakeNodeProvider(float(spec.get("startup_delay_s", 0.0)))
+    if kind == "gcp-tpu":
+        from ray_tpu.autoscaler.gcp_tpu import TpuVmNodeProvider
+        cluster = spec.pop("cluster_name", "default")
+        return TpuVmNodeProvider(spec, cluster_name=cluster)
+    raise ValueError(f"unknown node provider type {kind!r}")
+
+
 class LocalDaemonNodeProvider(NodeProvider):
     """Launches REAL HostDaemon processes on this machine — the e2e
     provider behind the closed autoscaler loop (counterpart of the

@@ -137,6 +137,13 @@ class JobManager:
                     self._persist(info)
                     self._adopt(info)
                 else:
+                    # close the race where the wrapper wrote its rc (and
+                    # exited) between the first rc read and the liveness
+                    # check — a successful exit must not be marked FAILED
+                    rc = self._read_rc(info.job_id)
+                    if rc is not None:
+                        self._finalize(info.job_id, rc)
+                        continue
                     with self._lock:
                         info.status = "FAILED"
                         info.finished_ts = time.time()

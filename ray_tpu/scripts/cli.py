@@ -239,7 +239,8 @@ def cmd_start(args):
 
 def _cluster_state_path(name: str) -> str:
     import os
-    root = os.path.expanduser("~/.ray_tpu/clusters")
+    root = os.environ.get("RAY_TPU_CLUSTER_STATE_DIR") or \
+        os.path.expanduser("~/.ray_tpu/clusters")
     os.makedirs(root, exist_ok=True)
     return os.path.join(root, f"{name}.json")
 
@@ -279,38 +280,53 @@ def cmd_up(args):
         sys.exit(1)
     from ray_tpu._private.attach import AttachClient
     c = AttachClient(session)
+    provider_cfg = dict(cfg.get("provider") or {"type": "local"})
+    provider_cfg.setdefault("cluster_name", name)
     autoscaler_cfg = {
         "max_workers": cfg.get("max_workers", 8),
         "idle_timeout_minutes": cfg.get("idle_timeout_minutes", 5.0),
         "available_node_types": cfg.get("available_node_types", {}),
+        "provider": provider_cfg,
     }
-    # node_config defaults to the declared resources
+    # node_config always carries the declared resources: local providers
+    # spawn daemons with them, the gcp-tpu provider forwards the custom
+    # ones through the slice startup script
     for spec in autoscaler_cfg["available_node_types"].values():
-        spec.setdefault("node_config",
-                        {"resources": spec.get("resources", {})})
+        spec.setdefault("node_config", {})
+        spec["node_config"].setdefault(
+            "resources", spec.get("resources", {}))
     c.control("attach_autoscaler", autoscaler_cfg)
 
     with open(_cluster_state_path(name), "w") as f:
         json.dump({"session": session, "config_file":
                    os.path.abspath(args.file)}, f)
 
-    # wait for min_workers to come up
+    # wait for min_workers to come up. Local providers become cluster
+    # nodes directly; cloud providers (gcp-tpu) report provisioned
+    # slices through the autoscaler while their hosts boot and join, so
+    # the readiness signal is provider-side there.
     want = sum(s.get("min_workers", 0)
                for s in autoscaler_cfg["available_node_types"].values())
+    cloud = provider_cfg.get("type", "local") not in ("local",)
     deadline = _time.time() + 120
+    n_up = 0
     while _time.time() < deadline:
-        alive = [n for n in c.control("list_nodes")
-                 if n["alive"] and not n.get("head")]
-        if len(alive) >= want:
+        if cloud:
+            st = c.control("autoscaler_status")
+            n_up = sum((st.get("workers_by_type") or {}).values())
+        else:
+            n_up = len([n for n in c.control("list_nodes")
+                        if n["alive"] and not n.get("head")])
+        if n_up >= want:
             break
         _time.sleep(1.0)
     c.close()
-    if len(alive) < want:
-        print(f"cluster {name!r}: only {len(alive)}/{want} min_workers "
+    if n_up < want:
+        print(f"cluster {name!r}: only {n_up}/{want} min_workers "
               f"came up within 120s", file=sys.stderr)
         sys.exit(1)
     print(f"cluster {name!r} up: session={session}, "
-          f"{len(alive)} worker node(s)")
+          f"{n_up} worker {'slice' if cloud else 'node'}(s)")
 
 
 def _cluster_session(args) -> str:

@@ -1,0 +1,190 @@
+"""In-process fake of the TPU-VM REST surface for provider tests.
+
+Emulates the subset of https://tpu.googleapis.com/v2 the provider uses:
+node create (async long-running operation), list (with paging), get,
+delete, and operation polling — plus failure injection (transient 503s,
+operation-level errors) so retry and gang-atomicity behavior can be
+tested without a cloud. Reference counterpart: the recorded-API unit
+tests around `autoscaler/_private/gcp/node_provider.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeTpuApi:
+    """State + behavior; serve() binds an ephemeral HTTP port."""
+
+    def __init__(self, create_delay_s: float = 0.0,
+                 fail_creates: int = 0,
+                 fail_create_operation: bool = False,
+                 page_size: int = 2):
+        self.lock = threading.Lock()
+        self.nodes: dict[str, dict] = {}          # nodeId -> node body
+        self.operations: dict[str, dict] = {}     # opId -> op
+        self.create_delay_s = create_delay_s
+        self.fail_creates = fail_creates          # N leading 503s
+        self.fail_create_operation = fail_create_operation
+        self.page_size = page_size
+        self.requests: list[tuple] = []           # (method, path)
+        self._op_counter = 0
+        self._server: ThreadingHTTPServer | None = None
+
+    # ---- REST behavior -----------------------------------------------
+
+    def handle(self, method: str, path: str, body: dict):
+        with self.lock:
+            self.requests.append((method, path))
+        m = re.match(r".*/locations/[^/]+/nodes(.*)$", path)
+        if m:
+            rest = m.group(1)
+            if method == "POST":
+                return self._create(rest, body)
+            if method == "GET" and rest.startswith("/"):
+                return self._get(rest[1:])
+            if method == "GET":
+                return self._list(path)
+            if method == "DELETE":
+                return self._delete(rest[1:])
+        m = re.match(r".*/(operations/[^/?]+)$", path)
+        if m and method == "GET":
+            return self._get_op(m.group(1).split("/")[-1])
+        return 404, {"error": f"unhandled {method} {path}"}
+
+    def _create(self, rest: str, body: dict):
+        qm = re.search(r"nodeId=([^&]+)", rest)
+        node_id = qm.group(1) if qm else f"node-{len(self.nodes)}"
+        with self.lock:
+            if self.fail_creates > 0:
+                self.fail_creates -= 1
+                return 503, {"error": "transient unavailability"}
+            self._op_counter += 1
+            op_id = f"op-{self._op_counter}"
+            if self.fail_create_operation:
+                # the async op fails: gang atomicity means NO node exists
+                self.operations[op_id] = {
+                    "name": f"projects/p/locations/z/operations/{op_id}",
+                    "done": True,
+                    "error": {"message": "no capacity for slice"},
+                }
+                return 200, self.operations[op_id]
+            ready_at = time.time() + self.create_delay_s
+            node = dict(body)
+            node["name"] = f"projects/p/locations/z/nodes/{node_id}"
+            node["state"] = "CREATING"
+            node["_ready_at"] = ready_at
+            node["networkEndpoints"] = [{"ipAddress": "10.0.0.%d"
+                                         % (len(self.nodes) + 2)}]
+            self.nodes[node_id] = node
+            self.operations[op_id] = {
+                "name": f"projects/p/locations/z/operations/{op_id}",
+                "done": self.create_delay_s <= 0,
+                "_node_id": node_id,
+                "_ready_at": ready_at,
+            }
+            return 200, self._op_view(op_id)
+
+    def _tick(self):
+        now = time.time()
+        for node in self.nodes.values():
+            if node["state"] == "CREATING" and now >= node["_ready_at"]:
+                node["state"] = "READY"
+        for op in self.operations.values():
+            if not op.get("done") and now >= op.get("_ready_at", 0):
+                op["done"] = True
+
+    def _op_view(self, op_id: str):
+        op = self.operations[op_id]
+        return {k: v for k, v in op.items() if not k.startswith("_")}
+
+    def _node_view(self, node: dict):
+        return {k: v for k, v in node.items() if not k.startswith("_")}
+
+    def _get_op(self, op_id: str):
+        with self.lock:
+            self._tick()
+            if op_id not in self.operations:
+                return 404, {}
+            return 200, self._op_view(op_id)
+
+    def _get(self, node_id: str):
+        node_id = node_id.split("?")[0]
+        with self.lock:
+            self._tick()
+            node = self.nodes.get(node_id)
+            if node is None:
+                return 404, {}
+            return 200, self._node_view(node)
+
+    def _list(self, path: str):
+        qm = re.search(r"pageToken=(\d+)", path)
+        start = int(qm.group(1)) if qm else 0
+        with self.lock:
+            self._tick()
+            items = [self._node_view(n) for n in self.nodes.values()]
+        page = items[start:start + self.page_size]
+        out = {"nodes": page}
+        if start + self.page_size < len(items):
+            out["nextPageToken"] = str(start + self.page_size)
+        return 200, out
+
+    def _delete(self, node_id: str):
+        node_id = node_id.split("?")[0]
+        with self.lock:
+            node = self.nodes.pop(node_id, None)
+            if node is None:
+                return 404, {}
+            self._op_counter += 1
+            op_id = f"op-{self._op_counter}"
+            self.operations[op_id] = {
+                "name": f"projects/p/locations/z/operations/{op_id}",
+                "done": True,
+            }
+            return 200, self._op_view(op_id)
+
+    # ---- HTTP plumbing ------------------------------------------------
+
+    def serve(self) -> str:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _go(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = {}
+                if length:
+                    body = json.loads(self.rfile.read(length))
+                status, payload = api.handle(method, self.path, body)
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_POST(self):
+                self._go("POST")
+
+            def do_DELETE(self):
+                self._go("DELETE")
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

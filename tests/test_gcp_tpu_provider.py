@@ -1,0 +1,279 @@
+"""TPU-VM node provider against a fake TPU REST API.
+
+Covers the reference-parity behaviors of
+`autoscaler/_private/gcp/node_provider.py`: create/list/terminate with
+label-based tag filtering, transient-error retry, gang-atomic slice
+creation (operation failure leaves NO node), autoscaler integration
+(demand scales slices up, idle scales down), and `ray-tpu up` driving a
+gcp-tpu provider end-to-end through a standalone head.
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fake_tpu_api import FakeTpuApi
+from ray_tpu.autoscaler.gcp_tpu import (
+    TpuVmNodeProvider,
+    bootstrap_gcp_tpu,
+    default_startup_script,
+)
+from ray_tpu.autoscaler.node_provider import (
+    TAG_NODE_KIND,
+    TAG_NODE_TYPE,
+    make_node_provider,
+)
+
+
+def _provider(api_url, **kw):
+    return TpuVmNodeProvider(
+        {"project_id": "proj", "zone": "us-central2-b",
+         "api_endpoint": api_url, "token": "fake-token",
+         "operation_poll_interval_s": 0.05, **kw},
+        cluster_name="testcluster")
+
+
+def test_bootstrap_validation():
+    with pytest.raises(ValueError, match="project_id"):
+        bootstrap_gcp_tpu({"zone": "us-central2-b"})
+    cfg = bootstrap_gcp_tpu({"project_id": "p", "zone": "z"})
+    assert cfg["api_endpoint"].startswith("https://tpu.googleapis")
+    assert cfg["api_version"] == "v2"
+
+
+def test_create_list_terminate_lifecycle():
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        p = _provider(url)
+        tags = {TAG_NODE_KIND: "worker", TAG_NODE_TYPE: "v5e_16"}
+        p.create_node({"accelerator_type": "v5litepod-16"}, tags, 2)
+        nodes = p.non_terminated_nodes({})
+        assert len(nodes) == 2
+        # tag filters ride GCP labels (sanitized keys/values)
+        assert p.non_terminated_nodes({TAG_NODE_TYPE: "v5e_16"}) == nodes
+        assert p.non_terminated_nodes({TAG_NODE_TYPE: "other"}) == []
+        assert p.is_running(nodes[0])
+        assert p.internal_ip(nodes[0]).startswith("10.0.0.")
+        labels = p.node_tags(nodes[0])
+        assert labels["ray-tpu-cluster"] == "testcluster"
+        # the node body carried the accelerator config
+        assert api.nodes[nodes[0]]["acceleratorType"] == "v5litepod-16"
+        p.terminate_node(nodes[0])
+        assert len(p.non_terminated_nodes({})) == 1
+    finally:
+        api.close()
+
+
+def test_list_paging():
+    api = FakeTpuApi(page_size=2)
+    url = api.serve()
+    try:
+        p = _provider(url)
+        p.create_node({"accelerator_type": "v5litepod-8"},
+                      {TAG_NODE_KIND: "worker"}, 5)
+        assert len(p.non_terminated_nodes({})) == 5
+    finally:
+        api.close()
+
+
+def test_transient_errors_retried():
+    api = FakeTpuApi(fail_creates=2)   # first two creates 503
+    url = api.serve()
+    try:
+        p = _provider(url)
+        p.create_node({"accelerator_type": "v5litepod-8"},
+                      {TAG_NODE_KIND: "worker"}, 1)
+        assert len(p.non_terminated_nodes({})) == 1
+    finally:
+        api.close()
+
+
+def test_gang_atomic_create_failure():
+    """A failed slice operation must leave NO node behind and surface the
+    error (whole-slice atomicity: SURVEY §7.4#3)."""
+    api = FakeTpuApi(fail_create_operation=True)
+    url = api.serve()
+    try:
+        p = _provider(url)
+        with pytest.raises(RuntimeError, match="no capacity"):
+            p.create_node({"accelerator_type": "v5litepod-16"},
+                          {TAG_NODE_KIND: "worker"}, 1)
+        assert p.non_terminated_nodes({}) == []
+    finally:
+        api.close()
+
+
+def test_async_operation_polling():
+    api = FakeTpuApi(create_delay_s=0.3)
+    url = api.serve()
+    try:
+        p = _provider(url)
+        t0 = time.monotonic()
+        p.create_node({"accelerator_type": "v5litepod-8"},
+                      {TAG_NODE_KIND: "worker"}, 1)
+        assert time.monotonic() - t0 >= 0.3    # blocked on the operation
+        nid = p.non_terminated_nodes({})[0]
+        assert p.is_running(nid)
+    finally:
+        api.close()
+
+
+def test_startup_script_injected():
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        p = TpuVmNodeProvider(
+            {"project_id": "p", "zone": "z", "api_endpoint": url,
+             "token": "t", "operation_poll_interval_s": 0.05,
+             "head_address": "10.0.0.1:6379", "authkey_hex": "ab12"},
+            cluster_name="c")
+        p.create_node({"accelerator_type": "v5litepod-8", "num_tpus": 4},
+                      {TAG_NODE_KIND: "worker"}, 1)
+        nid = p.non_terminated_nodes({})[0]
+        script = api.nodes[nid]["metadata"]["startup-script"]
+        assert "10.0.0.1:6379" in script and "ab12" in script
+        assert "--num-tpus 4" in script
+        # and the helper is the same text the provider injects
+        assert script == default_startup_script("10.0.0.1:6379", "ab12", 4)
+        # declared custom resources are forwarded; bare TPU declarations
+        # leave chip count to per-host auto-detection (no --num-tpus)
+        p.create_node({"accelerator_type": "v5litepod-8",
+                       "resources": {"CPU": 8, "TPU": 4, "fast_ssd": 1}},
+                      {TAG_NODE_KIND: "worker"}, 1)
+        nid2 = [n for n in p.non_terminated_nodes({}) if n != nid][0]
+        s2 = api.nodes[nid2]["metadata"]["startup-script"]
+        assert "--num-tpus" not in s2
+        assert "fast_ssd" in s2 and "TPU" not in s2.split("--resources")[1]
+    finally:
+        api.close()
+
+
+def test_label_unsafe_node_type_rejected():
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        p = _provider(url)
+        with pytest.raises(ValueError, match="label-safe"):
+            p.create_node({"accelerator_type": "v5litepod-8"},
+                          {TAG_NODE_TYPE: "TPU.Worker"}, 1)
+        assert p.non_terminated_nodes({}) == []
+    finally:
+        api.close()
+
+
+def test_make_node_provider_registry():
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        p = make_node_provider(
+            {"type": "gcp-tpu", "project_id": "p", "zone": "z",
+             "api_endpoint": url, "token": "t",
+             "cluster_name": "reg"})
+        assert isinstance(p, TpuVmNodeProvider)
+        assert p.cluster_name == "reg"
+        with pytest.raises(ValueError, match="unknown node provider"):
+            make_node_provider({"type": "nope"})
+    finally:
+        api.close()
+
+
+def test_autoscaler_scales_slices():
+    """StandardAutoscaler drives the TPU provider: min_workers brings
+    slices up; removing demand + idle timeout tears them down."""
+    from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.load_metrics import LoadMetrics
+
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        provider = _provider(url)
+        lm = LoadMetrics()
+        cfg = {
+            "max_workers": 4,
+            "idle_timeout_minutes": 0.0,
+            "available_node_types": {
+                "v5e_16": {
+                    "min_workers": 2,
+                    "max_workers": 4,
+                    "resources": {"CPU": 8, "TPU": 4},
+                    "node_config": {"accelerator_type": "v5litepod-16"},
+                },
+            },
+        }
+        a = StandardAutoscaler(provider, cfg, lm)
+        a.update()
+        assert len(provider.non_terminated_nodes({})) == 2
+        # idle slices above min_workers get reclaimed; min stays
+        a.update()
+        assert len(provider.non_terminated_nodes({})) == 2
+    finally:
+        api.close()
+
+
+_UP_DRIVER = """
+import json, os, subprocess, sys, time
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {testdir!r})
+from fake_tpu_api import FakeTpuApi
+
+api = FakeTpuApi()
+url = api.serve()
+cluster_yaml = os.path.join({tmp!r}, "cluster.yaml")
+open(cluster_yaml, "w").write(f'''
+cluster_name: tpuvm_e2e
+max_workers: 4
+idle_timeout_minutes: 60
+provider:
+  type: gcp-tpu
+  project_id: proj
+  zone: us-central2-b
+  api_endpoint: {{url}}
+  token: fake
+  operation_poll_interval_s: 0.05
+available_node_types:
+  v5e_8:
+    min_workers: 2
+    max_workers: 4
+    resources: {{{{"CPU": 8, "TPU": 4}}}}
+    node_config:
+      accelerator_type: v5litepod-8
+      num_tpus: 4
+''')
+env = dict(os.environ)
+env["RAY_TPU_CLUSTER_STATE_DIR"] = {tmp!r}
+r = subprocess.run(
+    [sys.executable, "-m", "ray_tpu.scripts.cli", "up", "-f", cluster_yaml],
+    env=env, capture_output=True, text=True, timeout=180)
+sys.stderr.write(r.stdout + r.stderr)
+assert r.returncode == 0, "up failed"
+# the fake cloud now holds two v5e-8 slices tagged for this cluster
+slices = {{nid: n for nid, n in api.nodes.items()}}
+assert len(slices) == 2, slices
+for n in slices.values():
+    assert n["acceleratorType"] == "v5litepod-8"
+    assert n["labels"]["ray-tpu-cluster"] == "tpuvm_e2e"
+    assert "startup-script" in n.get("metadata", {{}})
+r = subprocess.run(
+    [sys.executable, "-m", "ray_tpu.scripts.cli", "down", "tpuvm_e2e"],
+    env=env, capture_output=True, text=True, timeout=60)
+sys.stderr.write(r.stdout + r.stderr)
+assert r.returncode == 0, "down failed"
+api.close()
+print("UP-GCP-OK")
+"""
+
+
+def test_ray_tpu_up_with_gcp_provider(tmp_path):
+    import os
+    testdir = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(testdir)
+    script = _UP_DRIVER.format(repo=repo, testdir=testdir,
+                               tmp=str(tmp_path))
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-6000:]}"
+    assert "UP-GCP-OK" in r.stdout
