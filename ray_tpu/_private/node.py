@@ -496,9 +496,16 @@ class NodeServer:
             if isinstance(config, dict) else None
         if prov_spec and prov_spec.get("type") == "gcp-tpu":
             # booted slices need somewhere to register; the head is the
-            # only party that knows its own dialable address + authkey
-            prov_spec.setdefault("head_address",
-                                 self.tcp_address or self._address)
+            # only party that knows its own dialable address + authkey.
+            # A UNIX-socket-only head would bake an unjoinable path into
+            # every slice's startup script — refuse before billing starts.
+            if not prov_spec.get("head_address"):
+                if self.tcp_address is None:
+                    raise RuntimeError(
+                        "gcp-tpu provider requires the head to listen on "
+                        "TCP so slices can join; start it with --port "
+                        "(or RAY_TPU_TRANSPORT=tcp)")
+                prov_spec["head_address"] = self.tcp_address
             prov_spec.setdefault("authkey_hex", self._authkey.hex())
         with self.lock:
             if getattr(self, "_autoscaler", None) is not None:
@@ -518,6 +525,8 @@ class NodeServer:
         period = config.get("AUTOSCALER_UPDATE_INTERVAL_S")
         while not self._shutdown:
             time.sleep(period)
+            if self._autoscaler is None:     # torn down
+                return
             try:
                 self._update_load_metrics()
                 self._autoscaler.update()
@@ -555,6 +564,27 @@ class NodeServer:
                        if not t.deps and not t.cancelled]
             gangs = [[dict(b) for b in g] for g in self._pending_gangs]
             lm.set_demands(demands, gangs)
+
+    def autoscaler_teardown(self) -> dict:
+        """Terminate every provider node (cloud slices!) before the head
+        dies — `ray-tpu down` must never leak billed TPU capacity. The
+        head process is the only place the provider instance lives, so
+        teardown is a control verb, not a CLI-side loop."""
+        a = getattr(self, "_autoscaler", None)
+        if a is None:
+            return {"terminated": 0}
+        # stop the monitor loop first or min_workers would relaunch what
+        # we are about to terminate
+        with self.lock:
+            self._autoscaler = None
+        errs = []
+        nids = a.provider.non_terminated_nodes({})
+        for nid in nids:
+            try:
+                a.provider.terminate_node(nid)
+            except Exception as e:
+                errs.append(f"{nid}: {e!r}")
+        return {"terminated": len(nids) - len(errs), "errors": errs}
 
     def autoscaler_status(self) -> dict:
         a = getattr(self, "_autoscaler", None)
@@ -1128,6 +1158,8 @@ class NodeServer:
             return self.attach_autoscaler(payload or {})
         if method == "autoscaler_status":
             return self.autoscaler_status()
+        if method == "autoscaler_teardown":
+            return self.autoscaler_teardown()
         if method == "stack":
             p = payload or {}
             return self.collect_stacks(p.get("worker_id"),

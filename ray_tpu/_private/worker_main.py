@@ -5,9 +5,13 @@ Counterpart of the reference's worker main + Cython `execute_task` callback
 process that registers with its node, receives pushed tasks, resolves
 dependencies from the shared-memory store, runs user code, and seals results.
 
-The same process hosts either a pool ("generic") worker or a dedicated actor;
-actors with `max_concurrency > 1` run methods on a thread pool (the
-reference's threaded actor concurrency groups).
+The same process hosts either a pool ("generic") worker or a dedicated
+actor. Actor concurrency has two modes, mirroring the reference: classes
+with any `async def` method run every call as a coroutine on a per-actor
+event loop (max_concurrency = an asyncio.Semaphore; reference:
+`_private/async_compat.py:19` + async execute_task in `_raylet.pyx`),
+and plain classes with `max_concurrency > 1` use a thread pool (threaded
+concurrency groups).
 """
 
 from __future__ import annotations
@@ -24,6 +28,11 @@ from multiprocessing import connection
 from ray_tpu._private import netaddr, protocol, serialization
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import RayTpuError, TaskError
+
+import contextvars
+
+_ASYNC_TASK_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_async_task_id", default=None)
 
 
 class WorkerRuntime:
@@ -58,6 +67,9 @@ class WorkerRuntime:
         self._reply_cv = threading.Condition()
         self._send_lock = threading.Lock()
         self._executor: ThreadPoolExecutor | None = None
+        self._loop = None                # asyncio actors: per-actor loop
+        self._async_sem = None
+        self._io_executor: ThreadPoolExecutor | None = None
         self._current_task_ids = threading.local()
         self.shutdown = False
         # batched refcount events -> driver (hold/release/escape), flushed
@@ -240,6 +252,11 @@ class WorkerRuntime:
     # ---- execution --------------------------------------------------------
 
     def current_task_id(self):
+        # async actor methods record their id in a ContextVar (one per
+        # asyncio task); sync paths use the thread-local
+        tid = _ASYNC_TASK_ID.get()
+        if tid is not None:
+            return tid
         return getattr(self._current_task_ids, "task_id", None)
 
     def _resolve_fn(self, spec: protocol.TaskSpec):
@@ -309,6 +326,9 @@ class WorkerRuntime:
             error = True
         finally:
             self._current_task_ids.task_id = None
+        self._seal_and_send(spec, values, error)
+
+    def _seal_and_send(self, spec, values, error):
         descs = []
         for oid, value in zip(spec.return_ids, values):
             try:
@@ -334,8 +354,67 @@ class WorkerRuntime:
                 f"{len(out)} values")
         return out
 
+    # ---- asyncio actor runtime -------------------------------------------
+    # Async actors (any `async def` method) run their methods as
+    # coroutines on ONE per-actor event loop with max_concurrency as an
+    # asyncio.Semaphore — thousands of concurrent slow requests overlap
+    # on awaits instead of burning a thread each (reference:
+    # `_private/async_compat.py:19` get_new_event_loop + async task
+    # execution in `_raylet.pyx` execute_task; Serve's replica relies on
+    # exactly this).
+
+    def _start_actor_event_loop(self, max_concurrency: int):
+        import asyncio
+        self._loop = asyncio.new_event_loop()
+        self._async_sem = None
+        # blocking work (dependency resolution via store/network, result
+        # sealing) leaves the loop for this pool so awaits keep flowing
+        self._io_executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="actor-io")
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            self._async_sem = asyncio.Semaphore(max_concurrency)
+            self._loop.run_forever()
+        t = threading.Thread(target=run, daemon=True,
+                             name="actor-eventloop")
+        t.start()
+        while self._async_sem is None:   # loop thread publishing the sem
+            time.sleep(0.001)
+
+    async def _run_task_async(self, push: protocol.PushTask):
+        import asyncio
+        import inspect as _inspect
+        spec = push.spec
+        loop = asyncio.get_running_loop()
+        async with self._async_sem:
+            # each asyncio task has its own context, so the current-task
+            # id survives interleaving (a thread-local cannot)
+            _ASYNC_TASK_ID.set(spec.task_id)
+            try:
+                args, kwargs = await loop.run_in_executor(
+                    self._io_executor, self._resolve_args, spec,
+                    push.arg_locations)
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if _inspect.isawaitable(result):
+                    result = await result
+                values = self._split_returns(result, spec.num_returns)
+                error = False
+            except _DepFailed as df:
+                values = [df.cause] * spec.num_returns
+                error = True
+            except BaseException as e:
+                tb = traceback.format_exc()
+                te = TaskError(type(e).__name__, str(e), tb, cause=e)
+                values = [te] * spec.num_returns
+                error = True
+            await loop.run_in_executor(
+                self._io_executor, self._seal_and_send, spec, values,
+                error)
+
     def main_loop(self):
-        max_concurrency = 1
+        import asyncio
         while not self.shutdown:
             push = self.task_queue.get()
             if push is None:
@@ -344,11 +423,22 @@ class WorkerRuntime:
             if spec.actor_creation:
                 max_concurrency = (spec.actor_options or {}).get(
                     "max_concurrency", 1)
-                if max_concurrency > 1:
+                self.run_task(push)      # constructs the instance
+                # async-ness is decided from the CLASS with the same
+                # predicate the driver uses (actor.py _is_async_class):
+                # instance-level getattr would execute property getters,
+                # and dunder filtering would miss `async def __call__`
+                from ray_tpu.actor import _is_async_class
+                if self.actor_instance is not None and \
+                        _is_async_class(type(self.actor_instance)):
+                    self._start_actor_event_loop(max_concurrency)
+                elif max_concurrency > 1:
                     self._executor = ThreadPoolExecutor(
                         max_workers=max_concurrency,
                         thread_name_prefix="actor-method")
-                self.run_task(push)
+            elif self._loop is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._run_task_async(push), self._loop)
             elif self._executor is not None:
                 self._executor.submit(self.run_task, push)
             else:

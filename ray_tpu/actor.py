@@ -31,6 +31,14 @@ def method(**opts):
     return wrap
 
 
+def _is_async_class(cls) -> bool:
+    """An actor is ASYNC iff any of its methods is a coroutine function
+    (reference: `_private/async_compat.py:19` has_async_methods) — its
+    methods then run on a per-actor event loop instead of threads."""
+    return any(inspect.iscoroutinefunction(fn)
+               for _, fn in inspect.getmembers(cls, inspect.isfunction))
+
+
 def _collect_method_meta(cls) -> dict:
     meta = {}
     for name, fn in inspect.getmembers(cls, inspect.isfunction):
@@ -103,7 +111,14 @@ class ActorClass:
             actor_creation=True,
             runtime_env=o.get("runtime_env"),
             actor_options={
-                "max_concurrency": int(o.get("max_concurrency", 1)),
+                # async actors (any `async def` method) default to high
+                # concurrency — awaits overlap on one event loop, so
+                # serial pumping would defeat their whole point
+                # (reference: ray DEFAULT_MAX_CONCURRENCY_ASYNC=1000 vs 1
+                # for threaded actors, actor.py)
+                "max_concurrency": int(o.get(
+                    "max_concurrency",
+                    1000 if _is_async_class(self._cls) else 1)),
                 "max_restarts": int(o.get("max_restarts", 0)),
                 "max_task_retries": int(o.get("max_task_retries", 0)),
                 "name": o.get("name"),

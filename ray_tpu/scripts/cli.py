@@ -266,11 +266,18 @@ def cmd_up(args):
               "first", file=sys.stderr)
         sys.exit(1)
     head_cfg = cfg.get("head", {})
+    cloud_provider = (cfg.get("provider") or {}).get("type", "local") \
+        not in ("local",)
+    head_port = head_cfg.get("port")
+    if cloud_provider and head_port is None:
+        # slices join over TCP; an ephemeral bind is fine because the
+        # startup scripts embed the actual bound address
+        head_port = 0
 
     # start the head detached (same path as `start --head`)
     head_args = argparse.Namespace(
         head=True, address=None, authkey=None,
-        port=head_cfg.get("port"), num_cpus=head_cfg.get("num_cpus"),
+        port=head_port, num_cpus=head_cfg.get("num_cpus"),
         num_tpus=head_cfg.get("num_tpus"),
         resources=json.dumps(head_cfg.get("resources", {})),
         session_dir=None, block=False)
@@ -348,6 +355,20 @@ def cmd_down(args):
     import os
     import signal as _signal
     session = _cluster_session(args)
+    # terminate provider nodes FIRST: with a cloud provider (gcp-tpu)
+    # these are billed TPU slices that nothing else remembers once the
+    # state file is gone
+    try:
+        from ray_tpu._private.attach import AttachClient
+        c = AttachClient(session)
+        res = c.control("autoscaler_teardown")
+        c.close()
+        if res.get("terminated"):
+            print(f"terminated {res['terminated']} provider node(s)")
+        for e in res.get("errors") or []:
+            print(f"terminate failed: {e}", file=sys.stderr)
+    except Exception:
+        pass    # no autoscaler / head already gone
     try:
         with open(os.path.join(session, "driver.pid")) as f:
             pid = int(f.read().strip())

@@ -12,21 +12,27 @@ model's cache hits keep landing where it's already loaded.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from collections import OrderedDict
 from functools import wraps
 
-_MODEL_ID = threading.local()
+# ContextVar, not threading.local: replica requests run as asyncio tasks
+# interleaved on ONE event-loop thread, and each task carries its own
+# context (the replica's sync-callable executor propagates it with
+# copy_context)
+_MODEL_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_multiplexed_model_id", default="")
 
 
 def get_multiplexed_model_id() -> str:
     """Inside a replica: the model id of the CURRENT request (reference:
     serve.get_multiplexed_model_id)."""
-    return getattr(_MODEL_ID, "value", "")
+    return _MODEL_ID.get()
 
 
 def _set_model_id(value: str):
-    _MODEL_ID.value = value
+    _MODEL_ID.set(value)
 
 
 def multiplexed(func=None, *, max_num_models_per_replica: int = 3):

@@ -3,9 +3,11 @@
 Counterpart of the reference's `RayServeReplica`
 (`serve/_private/replica.py:429`, handle_request :695): wraps the user
 class/function, counts in-flight requests for autoscaling, and exposes
-health checks. Runs with max_concurrency > 1 so a slow request doesn't
-serialize the replica (the reference uses asyncio; our actor runtime uses
-a thread pool, worker_main.py max_concurrency).
+health checks. Async end-to-end: handle_request/handle_method are
+coroutines, so the replica runs as an asyncio actor (one event loop,
+max_concurrency as a semaphore — worker_main.py) and thousands of
+concurrent slow requests overlap on awaits; sync user callables execute
+on a worker thread so they can't stall the loop.
 """
 
 from __future__ import annotations
@@ -114,8 +116,30 @@ class Replica:
     def _pop_model_id(kwargs: dict) -> str:
         return kwargs.pop("__multiplexed_model_id__", "")
 
-    def handle_request(self, args: tuple, kwargs: dict):
-        """__call__ path (HTTP and plain handle calls)."""
+    async def _invoke(self, target, args, kwargs):
+        """Run the user callable without stalling the replica: coroutine
+        functions are awaited on the replica's event loop; sync callables
+        leave the loop for a worker thread (carrying the request context,
+        so get_multiplexed_model_id still resolves there)."""
+        import asyncio
+        import contextvars
+        import inspect
+        result = None
+        if inspect.iscoroutinefunction(target):
+            result = await target(*args, **kwargs)
+        else:
+            ctx = contextvars.copy_context()
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                None, lambda: ctx.run(target, *args, **kwargs))
+        if inspect.isawaitable(result):   # sync fn returning a coroutine
+            result = await result
+        return result
+
+    async def handle_request(self, args: tuple, kwargs: dict):
+        """__call__ path (HTTP and plain handle calls). Async end-to-end
+        (reference: `serve/_private/replica.py:429` — the replica IS an
+        asyncio actor; thousands of slow requests overlap on awaits)."""
         from ray_tpu.serve.multiplex import _set_model_id
         kwargs = dict(kwargs)
         _set_model_id(self._pop_model_id(kwargs))
@@ -123,28 +147,38 @@ class Replica:
         try:
             target = (self.callable if self._is_function
                       else self.callable.__call__)
-            return self._maybe_stream(target(*args, **kwargs))
+            return self._maybe_stream(
+                await self._invoke(target, args, kwargs))
         finally:
             self._exit()
 
-    def handle_method(self, method: str, args: tuple, kwargs: dict):
+    async def handle_method(self, method: str, args: tuple, kwargs: dict):
         """handle.method.remote path (model composition)."""
         from ray_tpu.serve.multiplex import _set_model_id
         kwargs = dict(kwargs)
         _set_model_id(self._pop_model_id(kwargs))
         self._enter()
         try:
-            return self._maybe_stream(
-                getattr(self.callable, method)(*args, **kwargs))
+            return self._maybe_stream(await self._invoke(
+                getattr(self.callable, method), args, kwargs))
         finally:
             self._exit()
 
-    def next_chunks(self, stream_id: int, max_chunks: int = _STREAM_BATCH):
+    async def next_chunks(self, stream_id: int,
+                          max_chunks: int = _STREAM_BATCH):
         """Pull the next batch of chunks from a registered stream.
         Returns (chunks, done); the stream is dropped when done. An
         unknown/TTL-reaped id returns (None, True) — consumers must treat
         that as an ERROR, not a clean EOF, or a reaped stream looks like
-        a complete (truncated) response."""
+        a complete (truncated) response. Async wrapper: the user's
+        generator may block per chunk (inference, I/O), which must not
+        stall the replica's event loop."""
+        import asyncio
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._next_chunks_sync, stream_id, max_chunks)
+
+    def _next_chunks_sync(self, stream_id: int, max_chunks: int):
         with self._lock:
             entry = self._streams.get(stream_id)
             if entry is not None:

@@ -261,6 +261,9 @@ r = subprocess.run(
     env=env, capture_output=True, text=True, timeout=60)
 sys.stderr.write(r.stdout + r.stderr)
 assert r.returncode == 0, "down failed"
+# down must terminate the billed slices, not just kill the head
+assert api.nodes == {{}}, f"leaked slices: {{list(api.nodes)}}"
+assert "terminated 2 provider node(s)" in r.stdout, r.stdout
 api.close()
 print("UP-GCP-OK")
 """

@@ -264,3 +264,24 @@ def test_proxy_concurrent_requests(serve_session):
     elapsed = time.time() - t0
     assert all(r == b"ok" for r in results)
     assert elapsed < 2.4, f"proxy serialized requests: {elapsed:.2f}s"
+
+
+def test_async_replica_soak_1k_concurrent(ray_session):
+    """1000 concurrent slow requests overlap on ONE replica's event loop
+    (reference: serve's async replica, `serve/_private/replica.py:429`).
+    Thread-per-call would need 1000 threads; serialized execution would
+    take ~1000s. The async replica holds them all on awaits."""
+    @serve.deployment(max_concurrent_queries=1000)
+    class Slow:
+        async def __call__(self, i):
+            import asyncio
+            await asyncio.sleep(1.0)
+            return i
+
+    h = serve.run(Slow.bind(), name="t_soak")
+    assert ray_tpu.get(h.remote(-1), timeout=60) == -1   # warm
+    t0 = time.time()
+    out = ray_tpu.get([h.remote(i) for i in range(1000)], timeout=240)
+    dt = time.time() - t0
+    assert out == list(range(1000))
+    assert dt < 60, f"requests serialized: {dt:.1f}s for 1000x1s"
