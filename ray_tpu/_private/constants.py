@@ -110,8 +110,11 @@ MEMORY_MONITOR_INTERVAL_S = define(
     "Memory monitor poll interval in seconds.")
 
 OBJECT_STORE_BYTES = define(
-    "OBJECT_STORE_BYTES", int, 512 * 1024 * 1024,
-    "Shared-memory arena capacity per node (plasma store size analog).")
+    "OBJECT_STORE_BYTES", int, 0,
+    "Shared-memory arena capacity per node (plasma store size analog). "
+    "0 = auto: 20% of system memory, min 512 MiB (the reference sizes "
+    "plasma at 30% of RAM by default; the arena file is sparse, so "
+    "unused capacity costs nothing).")
 
 RUNTIME_ENV_CACHE = define(
     "RUNTIME_ENV_CACHE", str, "/tmp/ray_tpu_runtime_envs",

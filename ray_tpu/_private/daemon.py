@@ -316,15 +316,21 @@ class HostDaemon:
             threading.Thread(target=self._run_lease, args=(msg,),
                              daemon=True).start()
         elif isinstance(msg, protocol.PullRequest):
-            # chunks are a lossy stream: the puller re-requests on stall,
-            # and retaining MB-sized chunks in the replay ring would
-            # balloon it
+            # chunks are a lossy raw-framed stream on the head channel:
+            # the puller re-requests on stall, and retaining MB-sized
+            # chunks in the replay ring would balloon it
+            with self._head_lock:
+                raw = (self._head, self._head_lock)
             threading.Thread(
-                target=self._serve_pull,
-                args=(lambda m: self._head_send(m, reliable=False), msg),
+                target=self._serve_pull, args=(raw, msg),
                 daemon=True).start()
         elif isinstance(msg, protocol.PullChunk):
-            self._pull_client.on_chunk(msg)
+            if msg.data is None:
+                # raw body frame follows NOW on this channel; land it
+                # before the next recv
+                self._pull_client.on_chunk_raw(msg, self._head)
+            else:
+                self._pull_client.on_chunk(msg)
         elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
                               protocol.SubmitReply, protocol.ActorCallReply,
                               protocol.ErrorReply)):
@@ -384,6 +390,7 @@ class HostDaemon:
             self._worker_loop(w)
         elif isinstance(reg, protocol.RegisterPeer):
             psend = protocol.SafeConn(conn)
+            raw = (conn, psend._lock)
             while True:
                 try:
                     msg = conn.recv()
@@ -391,7 +398,7 @@ class HostDaemon:
                     return
                 if isinstance(msg, protocol.PullRequest):
                     threading.Thread(target=self._serve_pull,
-                                     args=(psend, msg), daemon=True).start()
+                                     args=(raw, msg), daemon=True).start()
         else:
             conn.close()
 
@@ -724,9 +731,28 @@ class HostDaemon:
                     self._pulling.add(oid)
                     break
                 self.cv.wait(0.2)
+        seal_box = {}
+
+        def alloc(total: int):
+            buf, seal = self.store.create_serialized(oid, total)
+            if buf is not None:
+                seal_box["seal"] = seal
+            return buf
+
         try:
-            payload = self._pull(desc.node, oid)
-            local = self.store.put_serialized(oid, payload)
+            # on pull failure the PullClient owns releasing the arena
+            # allocation (a late in-flight frame may still be landing in
+            # it — freeing here would corrupt whatever recycles the
+            # block); we only seal on success
+            payload, in_arena = self._pull(
+                desc.node, oid, alloc,
+                cleanup=lambda: self.store.abort_create(oid))
+            if in_arena:
+                # bytes landed straight in the arena: seal, done — the
+                # pull WAS the put (zero staging copies)
+                local = seal_box["seal"]()
+            else:
+                local = self.store.put_serialized(oid, payload)
             # publish BEFORE dropping the _pulling claim, or a waiter can
             # wake to no-copy/no-claim and start a duplicate pull
             with self.lock:
@@ -764,32 +790,40 @@ class HostDaemon:
                 except (EOFError, OSError, TypeError):
                     return
                 if isinstance(msg, protocol.PullChunk):
-                    self._pull_client.on_chunk(msg)
+                    if msg.data is None:
+                        self._pull_client.on_chunk_raw(msg, _c)
+                    else:
+                        self._pull_client.on_chunk(msg)
         threading.Thread(target=reader, daemon=True,
                          name=f"peer-{node_id}").start()
         with self.lock:
             self._peers[node_id] = (send, conn)
         return send
 
-    def _pull(self, source_node: str | None, oid: str) -> bytes:
+    def _pull(self, source_node: str | None, oid: str, alloc=None,
+              cleanup=None):
+        """-> (payload, landed_in_alloc). Outbound pull REQUESTS stay
+        reliable on purpose (a blip-swallowed request hangs the puller);
+        the chunk replies are the lossy part."""
         if source_node is None:
             send = self._head_send
         else:
             send = self._peer_send(source_node)
-        return self._pull_client.pull(send, oid)
+        return self._pull_client.pull_into(send, oid, alloc=alloc,
+                                           cleanup=cleanup)
 
-    def _serve_pull(self, send, msg: protocol.PullRequest):
+    def _serve_pull(self, raw, msg: protocol.PullRequest):
         with self.lock:
             desc = self._objs.get(msg.object_id) or \
                 self._copies.get(msg.object_id)
         if desc is None:
-            serve_pull(send, msg, None)
+            serve_pull(raw, msg, None)
             return
         try:
             payload = self.store.raw_view(desc)
         except (ObjectLostError, OSError) as e:
             payload = e
-        serve_pull(send, msg, payload)
+        serve_pull(raw, msg, payload)
 
     def _spill_loop(self):
         """Above the arena high-water mark, move sealed local objects to
@@ -829,18 +863,25 @@ class HostDaemon:
         with self.lock:
             desc = self._objs.pop(oid, None)
             copy = self._copies.pop(oid, None)
-            origin = self._origin.pop(oid, None)
+            self._origin.pop(oid, None)
+            workers = [w for w in self.workers.values() if w.alive]
+        gone = desc or copy
         for d in (desc, copy):
             if d is not None:
                 try:
                     self.store.delete(d)
                 except Exception:
                     pass
-        if origin is not None and origin != "daemon" and desc is not None:
-            with self.lock:
-                w = self.workers.get(origin)
-            if w is not None and w.alive:
-                w.send(protocol.FreeObject(oid, desc))
+        if gone is not None:
+            # EVERY worker that read the object holds a pinned view of
+            # the arena block (zero-copy reads) or a cached mmap; until
+            # they all drop it the block is condemned, its offset can't
+            # be reused, and the arena grows cold pages forever. Fan the
+            # free out to the whole local pool (no-op for workers that
+            # never read it) — the origin-only version leaked reader
+            # pins.
+            for w in workers:
+                w.send(protocol.FreeObject(oid, gone))
 
     # ------------------------------------------------------------------
     # lifecycle

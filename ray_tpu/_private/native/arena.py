@@ -21,7 +21,16 @@ def _default_capacity() -> int:
     # happens after process start, and tests/operators set the override in
     # an already-running process
     from ray_tpu._private import config, constants  # noqa: F401
-    return config.get("OBJECT_STORE_BYTES")
+    v = config.get("OBJECT_STORE_BYTES")
+    if v:
+        return v
+    # auto: 20% of system RAM, min 512 MiB — tmpfs-backed and sparse, so
+    # the file costs only the pages actually written
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+        return max(512 << 20, int(pages * 0.20))
+    except (ValueError, OSError):
+        return 512 << 20
 
 
 class _Lib:

@@ -111,6 +111,9 @@ class _WorkerConn:
     # True for conns accepted on the TCP listener from another machine:
     # they can't mmap this host's store, so get/put payloads ride inline
     remote: bool = False
+    # set exactly once when RegisterWorker lands: spawn waiters block on
+    # THIS, not the global cv (a notify_all herd under creation bursts)
+    reg_event: threading.Event = field(default_factory=threading.Event)
 
     def send(self, msg) -> bool:
         # conn is None between spawn and registration
@@ -306,6 +309,15 @@ class NodeServer:
         self._sched_event = threading.Event()
         threading.Thread(target=self._scheduler_loop,
                          name="ray_tpu-scheduler", daemon=True).start()
+        # free-fanout outbox: _maybe_free_locked runs under self.lock,
+        # and O(workers) blocking sends in there would let one full pipe
+        # stall the whole head during a release storm — a dedicated
+        # thread drains the sends outside the lock
+        import collections as _collections
+        self._free_outbox: _collections.deque = _collections.deque()
+        self._free_event = threading.Event()
+        threading.Thread(target=self._free_fanout_loop,
+                         name="ray_tpu-free-fanout", daemon=True).start()
         self._listener = connection.Listener(
             family="AF_UNIX", address=self._address, authkey=self._authkey)
         self._accept_thread = threading.Thread(
@@ -793,6 +805,7 @@ class NodeServer:
                 w.conn = conn
             w.remote = remote
             w.alive = True
+            w.reg_event.set()
             self.cv.notify_all()
         self._reader_loop(w)
 
@@ -1068,7 +1081,12 @@ class NodeServer:
             threading.Thread(target=self._serve_pull, args=(node, msg),
                              daemon=True).start()
         elif isinstance(msg, protocol.PullChunk):
-            self._pull_client.on_chunk(msg)
+            if msg.data is None:
+                # raw body frame follows NOW on this channel (we're in
+                # the node reader, synchronously before the next recv)
+                self._pull_client.on_chunk_raw(msg, node.conn)
+            else:
+                self._pull_client.on_chunk(msg)
         elif isinstance(msg, protocol.PutRequest):
             if msg.origin:
                 self.ref_hold(msg.object_id, msg.origin)
@@ -1404,11 +1422,18 @@ class NodeServer:
         copies = self.copy_nodes.pop(oid, ())
         if desc.node is None:
             self.store.delete(desc)
-            if origin != "driver" and not origin.startswith("node:"):
-                w = self.workers.get(origin)
-                if w is not None and w.alive:
-                    # origin worker still holds the put-time owner pin
-                    w.send(protocol.FreeObject(oid, desc))
+            # every LOCAL worker that read the object holds a pinned
+            # arena view (or a cached mmap for file-backed descs); the
+            # block's offset can't recycle until they all drop it —
+            # origin-only fanout leaked reader pins and grew the arena
+            # cold forever. Sends ride the outbox thread: O(workers)
+            # blocking writes under self.lock would stall the head.
+            targets = [w for w in self.workers.values()
+                       if w.alive and not w.remote and w.kind != "attach"]
+            if targets:
+                self._free_outbox.append(
+                    (targets, protocol.FreeObject(oid, desc)))
+                self._free_event.set()
         else:
             node = self.nodes.get(desc.node)
             if node is not None and node.alive:
@@ -1440,6 +1465,18 @@ class NodeServer:
             waiter["n"] -= 1
         self.cv.notify_all()
         return bool(waiting)
+
+    def _free_fanout_loop(self):
+        while not self._shutdown:
+            self._free_event.wait(timeout=1.0)
+            self._free_event.clear()
+            while self._free_outbox:
+                try:
+                    targets, msg = self._free_outbox.popleft()
+                except IndexError:
+                    break
+                for w in targets:
+                    w.send(msg)     # safe_send: dead workers are a no-op
 
     def _poke_get_waiters(self, oid: str) -> None:
         """Flag blocked get()s that `oid` was freed/lost so they re-check
@@ -1649,9 +1686,26 @@ class NodeServer:
                     if node is None or not node.alive:
                         raise ObjectLostError(
                             f"object {oid} lives on dead node {desc.node}")
-                    payload = self._pull_bytes(node, oid,
-                                               timeout=budget(constants.PULL_TIMEOUT_S))
-                    local = self.store.put_serialized(oid, payload)
+                    seal_box = {}
+
+                    def alloc(total: int, _oid=oid):
+                        buf, seal = self.store.create_serialized(
+                            _oid, total)
+                        if buf is not None:
+                            seal_box["seal"] = seal
+                        return buf
+
+                    # failure-path release belongs to the PullClient (a
+                    # late frame may still be landing in the buffer)
+                    payload, in_arena = self._pull_bytes(
+                        node, oid, alloc=alloc,
+                        cleanup=lambda _oid=oid:
+                            self.store.abort_create(_oid),
+                        timeout=budget(constants.PULL_TIMEOUT_S))
+                    if in_arena:
+                        local = seal_box["seal"]()
+                    else:
+                        local = self.store.put_serialized(oid, payload)
                     with self.lock:
                         # freed while we pulled? drop the stray copy now
                         if oid in self.freed_refs:
@@ -1706,9 +1760,10 @@ class NodeServer:
                 self.cv.wait(min(rem, 0.5))
 
     def _pull_bytes(self, node: _RemoteNode, oid: str,
-                    timeout: float | None = None) -> bytes:
-        return self._pull_client.pull(
-            node.send, oid, timeout=timeout,
+                    timeout: float | None = None, alloc=None,
+                    cleanup=None):
+        return self._pull_client.pull_into(
+            node.send, oid, timeout=timeout, alloc=alloc, cleanup=cleanup,
             abort_check=lambda: None if node.alive
             else f"hit dead node {node.node_id}")
 
@@ -1720,13 +1775,13 @@ class NodeServer:
             if desc is not None and desc.node is not None:
                 desc = self.local_copies.get(msg.object_id)
         if desc is None:
-            serve_pull(node.send, msg, None)
+            serve_pull((node.conn, node.send_lock), msg, None)
             return
         try:
             payload = self.store.raw_view(desc)
         except (ObjectLostError, OSError) as e:
             payload = e
-        serve_pull(node.send, msg, payload)
+        serve_pull((node.conn, node.send_lock), msg, payload)
 
     # ------------------------------------------------------------------
     # leased-task lifecycle + node failure (raylet-side events)
@@ -2848,14 +2903,15 @@ class NodeServer:
 
     def _await_registration(self, w: _WorkerConn) -> bool:
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
-        with self.cv:
-            while not w.alive:
-                rem = deadline - time.monotonic()
-                if rem <= 0 or self._shutdown:
-                    return False
-                if w.proc is not None and w.proc.poll() is not None:
-                    return False
-                self.cv.wait(min(rem, 0.2))
+        while not w.alive:
+            rem = deadline - time.monotonic()
+            if rem <= 0 or self._shutdown:
+                return False
+            if w.proc is not None and w.proc.poll() is not None:
+                return False
+            # per-worker event: registration wakes exactly this waiter
+            # (the global cv would thundering-herd under creation bursts)
+            w.reg_event.wait(min(rem, 0.2))
         return True
 
     # ------------------------------------------------------------------
@@ -2934,7 +2990,68 @@ class NodeServer:
             retire.send(protocol.KillWorker())
             with self.lock:
                 self.workers.pop(retire.worker_id, None)
+        # Completion fastpath (the submit path has the same shortcut,
+        # _submit_fastpath; reference: cluster_task_manager.cc:44
+        # QueueAndScheduleTask scoping): a completion frees exactly one
+        # slot, so fill exactly that slot instead of waking the full
+        # scheduler pass — on a deep homogeneous backlog the pass
+        # examines a whole dispatch window per completion, which caps
+        # drain throughput.
+        if a is not None:
+            # actor slot freed: pump exactly that actor's queue
+            to_send = []
+            with self.lock:
+                self._pump_actor(a, to_send)
+            for w2, m2 in to_send:
+                w2.send(m2)
+        elif self._dispatch_freed_fastpath():
+            return
         self._schedule()
+
+    def _dispatch_freed_fastpath(self) -> bool:
+        """Hand the just-freed slot the head-of-line pending task.
+        Bounded: one dispatch (or a couple of cancelled-task pops);
+        anything trickier falls back to the scheduler pass. Returns True
+        iff the slot was cleanly filled (or there is nothing to run) so
+        the scheduler event can be skipped — the next completion
+        continues the chain."""
+        to_send = []
+        ok = False
+        with self.lock:
+            if self._shutdown:
+                return True
+            for _ in range(64):        # bound: cancelled-task pops only
+                if not self.pending:
+                    ok = True          # nothing queued: slot stays free
+                    break
+                t = self.pending[0]
+                if t.cancelled:
+                    self.pending.popleft()
+                    continue
+                if (t.deps or t.spec.actor_creation
+                        or t.spec.actor_id is not None
+                        or t.spec.placement_group_id
+                        or t.spec.scheduling_strategy):
+                    break              # needs the real pass
+                self.pending.popleft()
+                if self._try_dispatch_generic(t, to_send) is True:
+                    # "consumed" is not "slot filled": infeasible tasks
+                    # return True with nothing sent, and a remote
+                    # dispatch leaves the LOCAL slot idle — both need
+                    # the real pass to keep draining
+                    ok = any(isinstance(w, _WorkerConn)
+                             for w, _ in to_send)
+                else:
+                    self.pending.appendleft(t)
+                break
+        for w, msg in to_send:
+            if not w.send(msg):
+                if isinstance(w, _RemoteNode):
+                    self._on_node_death(w)
+                else:
+                    self._on_worker_death(w)
+                ok = False
+        return ok
 
     def _requeue_after_failure(self, w, t, a):
         """Re-run a failed task (called under lock)."""

@@ -140,6 +140,36 @@ class ObjectStore:
         os.rename(tmp, path)  # atomic seal: object visible only when complete
         return Descriptor(object_id, n, path=path)
 
+    def create_serialized(self, object_id: str, nbytes: int):
+        """Preallocate arena space for an incoming serialized envelope
+        (chunked pulls land bytes straight in shared memory — no staging
+        buffer, no put copy). Returns (writable memoryview, seal_fn) or
+        (None, None) when the envelope should stage elsewhere (inline-
+        small, no arena, arena full). seal_fn() -> Descriptor."""
+        if nbytes <= INLINE_OBJECT_MAX_BYTES or self._arena is None:
+            return None, None
+        buf = self._arena.create(object_id, nbytes)
+        if buf is None:
+            return None, None
+
+        def seal() -> Descriptor:
+            self._arena.pin(object_id, 1)   # before seal; see put()
+            self._arena.seal(object_id)
+            with self._lock:
+                self._owned.add(object_id)
+            return Descriptor(object_id, nbytes, arena=True)
+
+        return buf, seal
+
+    def abort_create(self, object_id: str) -> None:
+        """Drop an unsealed create_serialized allocation (pull failed)."""
+        if self._arena is not None:
+            try:
+                self._arena.seal(object_id)
+                self._arena.delete(object_id)
+            except Exception:
+                pass
+
     def put_serialized(self, object_id: str, payload) -> Descriptor:
         """Store an already-serialized envelope (bytes-like, e.g. the
         preallocated buffer a chunked pull landed in)."""

@@ -330,13 +330,22 @@ class PullChunk:
     """Chunked reply to PullRequest (object_manager.h:130 HandlePush uses
     the same chunking; ObjectBufferPool's chunk size analog). `total`
     rides the first chunk so the receiver preallocates one buffer
-    instead of accumulating parts + a join copy."""
+    instead of accumulating parts + a join copy.
+
+    Zero-copy framing: when `data is None` and `nbytes >= 0`, this
+    header is immediately followed on the SAME channel by a raw
+    `send_bytes` frame of nbytes (written under one send-lock hold);
+    the receiver lands it with `recv_bytes_into` straight into the
+    pull's destination buffer — no pickle copy on either side. Error
+    and empty-object chunks keep `data=b""`."""
     req_id: int
     seq: int
-    data: bytes
+    data: bytes | None
     last: bool = False
     error: str | None = None
     total: int = -1
+    nbytes: int = -1
+    offset: int = 0
 
 
 @dataclass

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time as _time
 
 from ray_tpu._private import constants
 
@@ -85,18 +86,169 @@ def worker_log_file(log_dir: str | None, name: str):
     return open(os.path.join(log_dir, name + ".log"), "ab")
 
 
+class ForkedProc:
+    """Popen-compatible handle for a worker forked by the forkserver.
+    The factory reaps the child on SIGCHLD, so the bare pid is
+    recyclable the moment the child dies — every probe and signal is
+    therefore guarded by the start-ticks identity recorded at fork
+    (signal-0 alone would report a recycled pid as alive forever and
+    kill() could SIGKILL an unrelated process)."""
+
+    def __init__(self, pid: int, start_ticks=None):
+        self.pid = pid
+        self._start = start_ticks
+        self._dead = start_ticks is None
+
+    def _same_proc(self) -> bool:
+        from ray_tpu._private.forkserver import _proc_start
+        return _proc_start(self.pid) == self._start
+
+    def poll(self):
+        if self._dead:
+            return 0
+        if not self._same_proc():
+            self._dead = True
+            return 0
+        return None
+
+    def wait(self, timeout=None):
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and _time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            _time.sleep(0.02)
+        return 0
+
+    def _signal(self, sig):
+        if self._dead or not self._same_proc():
+            self._dead = True
+            return
+        try:
+            os.kill(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            self._dead = True
+
+    def terminate(self):
+        import signal as _signal
+        self._signal(_signal.SIGTERM)
+
+    def kill(self):
+        import signal as _signal
+        self._signal(_signal.SIGKILL)
+
+
+class _ForkServerClient:
+    """Lazy per-process handle on a forkserver child (forkserver.py).
+    Thread-safe: requests are serialized over one connection."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._proc = None
+        self._conn = None
+
+    def _ensure(self, authkey: bytes):
+        from multiprocessing import connection as mpc
+        if self._conn is not None and self._proc.poll() is None:
+            return True
+        sock = os.path.join(constants.SHM_ROOT,
+                            f"ray_tpu_fs_{os.getpid()}.sock")
+        env = propagate_pythonpath(dict(os.environ))
+        env["RAY_TPU_AUTHKEY"] = authkey.hex()
+        # the factory itself is a CPU process; the worker site hook keeps
+        # platform plugins (and their 2s jax import) out of it
+        env["RAY_TPU_WORKER_FORCE_CPU"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            # stdio INHERITED (not piped): forked children without a log
+            # file keep the spawner's real stdout/stderr — a pipe nobody
+            # drains would block a chatty worker at ~64KB
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.forkserver",
+                 sock],
+                env=env, stdin=subprocess.DEVNULL)
+            deadline = _time.monotonic() + 30.0
+            while True:
+                try:
+                    self._conn = mpc.Client(sock, family="AF_UNIX",
+                                            authkey=authkey)
+                    break
+                except (FileNotFoundError, ConnectionRefusedError,
+                        OSError):
+                    if (_time.monotonic() > deadline
+                            or self._proc.poll() is not None):
+                        raise OSError("forkserver failed to start")
+                    _time.sleep(0.05)
+            return True
+        except Exception:
+            if self._proc is not None:
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            self._proc = None
+            self._conn = None
+            return False
+
+    def spawn(self, address, authkey, worker_id, env, log_path):
+        with self._lock:
+            if not self._ensure(authkey):
+                return None
+            try:
+                self._conn.send({"address": address,
+                                 "worker_id": worker_id,
+                                 "env": env, "log_path": log_path})
+                reply = self._conn.recv()
+            except (OSError, EOFError, ValueError, TypeError):
+                self._conn = None
+                return None
+            pid = reply.get("pid")
+            if not pid:
+                return None
+            return ForkedProc(pid, reply.get("start"))
+
+
+_forkserver = _ForkServerClient()
+
+
+def _fork_eligible(env: dict, python_exe, cwd) -> bool:
+    """Fork only the common case: CPU worker, default interpreter, no
+    runtime-env path/cwd overrides. TPU workers must gate plugin
+    registration before ANY import (env decides at exec time), and venv
+    workers need their own interpreter."""
+    return (python_exe is None and cwd is None
+            and not env.get("RAY_TPU_RUNTIME_ENV_PATHS")
+            and constants.TPU_VISIBLE_CHIPS_ENV not in env
+            and env.get("JAX_PLATFORMS") == "cpu"
+            and env.get("RAY_TPU_DISABLE_FORKSERVER") != "1")
+
+
 def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
                       env: dict, python_exe: str | None = None,
                       cwd: str | None = None,
-                      log_dir: str | None = None) -> subprocess.Popen:
-    """Exec a worker process that will register at `address`. subprocess
-    (not mp.Process) so we control the child env exactly and never inherit
-    the parent's TPU runtime handles/locks. `python_exe`/`cwd` come from a
+                      log_dir: str | None = None):
+    """Start a worker process that will register at `address`. The
+    common (CPU, default-env) case forks from a warm factory —
+    milliseconds instead of a cold interpreter exec; everything else
+    execs a fresh python so the child env is exact and no TPU runtime
+    handles/locks are inherited. `python_exe`/`cwd` come from a
     materialized runtime environment (pip venv / working_dir)."""
-    cmd = [python_exe or sys.executable,
-           "-m", "ray_tpu._private.worker_main", address, worker_id]
     env = propagate_pythonpath(dict(env))
     env["RAY_TPU_AUTHKEY"] = authkey.hex()
+    from ray_tpu._private import config
+    if _fork_eligible(env, python_exe, cwd):
+        log_path = None
+        if log_dir is not None and config.get("WORKER_LOG_REDIRECT"):
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, worker_id + ".log")
+        proc = _forkserver.spawn(address, authkey, worker_id, env,
+                                 log_path)
+        if proc is not None:
+            return proc
+        # factory unavailable: fall through to exec
+    cmd = [python_exe or sys.executable,
+           "-m", "ray_tpu._private.worker_main", address, worker_id]
     logf = worker_log_file(log_dir, worker_id)   # ids carry their prefix
     try:
         return subprocess.Popen(

@@ -463,8 +463,10 @@ class _DepFailed(Exception):
         self.cause = cause
 
 
-def main():
-    address, worker_id = sys.argv[1], sys.argv[2]
+def run(address: str, worker_id: str):
+    """Worker entry, callable both from exec (main) and from a
+    forkserver child (forkserver.py) — the child passes args directly
+    instead of re-parsing argv."""
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     rt = WorkerRuntime(address, worker_id, authkey)
     rt.send(protocol.RegisterWorker(worker_id, os.getpid()))
@@ -477,6 +479,10 @@ def main():
     threading.Thread(target=rt.reader_loop, daemon=True,
                      name="ray_tpu-worker-reader").start()
     rt.main_loop()
+
+
+def main():
+    run(sys.argv[1], sys.argv[2])
 
 
 if __name__ == "__main__":

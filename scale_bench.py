@@ -44,6 +44,13 @@ def bench_queued_tasks(ray_tpu, n: int) -> dict:
     t1 = time.perf_counter()
     ray_tpu.get(refs)
     t_drain = time.perf_counter() - t1
+    # absorb the 100k-ObjectRef release storm HERE: the batched decref
+    # flood (and the head's free processing) otherwise lands in the
+    # middle of the next suite's window (the same isolation _settle
+    # exists for)
+    del refs
+    ray_tpu.get(ray_tpu.put(1))
+    time.sleep(3.0)
     return {
         "queued": n,
         "submit_per_s": round(n / t_submit, 1),
@@ -143,20 +150,36 @@ def bench_broadcast(ray_tpu, cluster, gib: float = 1.0,
                 for i in range(n_nodes)]
 
     payload = np.ones(int(gib * (1 << 30) // 4), np.float32)
-    t_put0 = time.perf_counter()
-    ref = ray_tpu.put(payload)
-    t_put = time.perf_counter() - t_put0
 
     @ray_tpu.remote
     def reduce_sum(a):
         return float(a[::4096].sum())
 
-    t0 = time.perf_counter()
-    refs = [reduce_sum.options(resources={f"bx{i}": 1}).remote(ref)
-            for i in range(n_nodes)]
-    out = ray_tpu.get(refs, timeout=600)
-    dt = time.perf_counter() - t0
-    assert all(abs(v - out[0]) < 1e-3 for v in out)
+    def fanout():
+        t_put0 = time.perf_counter()
+        ref = ray_tpu.put(payload)
+        t_put = time.perf_counter() - t_put0
+        t0 = time.perf_counter()
+        refs = [reduce_sum.options(resources={f"bx{i}": 1}).remote(ref)
+                for i in range(n_nodes)]
+        out = ray_tpu.get(refs, timeout=600)
+        dt = time.perf_counter() - t0
+        assert all(abs(v - out[0]) < 1e-3 for v in out)
+        del refs, ref
+        ray_tpu.get(ray_tpu.put(1))   # drain the decref batch promptly
+        return t_put, dt
+
+    # Steady state, not first touch: this box is a microVM with lazy
+    # host memory — the FIRST write of any page costs a hypervisor
+    # fault (~0.26 GB/s); recycled arena blocks run at memory speed.
+    # A real cluster streams through warm, recycled blocks, so the
+    # steady-state number is the framework's throughput and the cold
+    # pass would measure the hypervisor. Two warm passes to converge.
+    fanout()
+    time.sleep(3)
+    fanout()
+    time.sleep(3)
+    t_put, dt = fanout()
     for nid in node_ids:
         cluster.kill_node(nid)
     total_bytes = payload.nbytes * n_nodes
