@@ -16,6 +16,8 @@ from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.bandits import (
     LinTS, LinTSConfig, LinUCB, LinUCBConfig)
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -25,4 +27,5 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3", "TD3Config",
            "MultiAgentPPO", "MAPPOConfig", "ES", "ESConfig",
            "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
-           "ApexDQN", "ApexDQNConfig"]
+           "ApexDQN", "ApexDQNConfig", "R2D2", "R2D2Config",
+           "QMIX", "QMIXConfig"]

@@ -1,0 +1,280 @@
+"""QMIX — cooperative multi-agent Q-learning with monotonic value
+factorization (Rashid et al. 2018).
+
+Counterpart of the reference's `rllib/algorithms/qmix/qmix.py` +
+`qmix_policy.py`/`model.py`: per-agent Q-networks whose chosen Qs are
+mixed into Q_tot by a hypernetwork-conditioned MONOTONIC mixer (weights
+forced positive via abs), trained end-to-end with a TD target on the
+SHARED team reward. Monotonicity means each agent's greedy argmax over
+its own Q is the team-optimal joint action — centralized training,
+decentralized execution.
+
+TPU-first shape: the multi-agent rollout is one compiled scan
+(per-agent epsilon-greedy inline, fixed agent set = pytree structure),
+joint transitions replay host-side, and the QMIX update — agent nets +
+hypernet mixer + double-Q targets — is a single jitted function over
+[B, ...] batches.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.core.rl_module import QModule
+from ray_tpu.rllib.env.multi_agent import is_multi_agent_env
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class _MonotonicMixer(nn.Module):
+    """Q_tot(s, q_1..q_n): hypernetworks map the global state to
+    POSITIVE mixing weights (abs), so dQ_tot/dq_i >= 0 — the QMIX
+    monotonicity constraint (reference: qmix/model.py QMixer)."""
+    n_agents: int
+    embed: int = 32
+
+    @nn.compact
+    def __call__(self, state, agent_qs):
+        # agent_qs: [B, n_agents]; state: [B, state_dim]
+        w1 = jnp.abs(nn.Dense(self.n_agents * self.embed)(state))
+        w1 = w1.reshape(-1, self.n_agents, self.embed)
+        b1 = nn.Dense(self.embed)(state)
+        hidden = nn.elu(
+            jnp.einsum("ba,bae->be", agent_qs, w1) + b1)
+        w2 = jnp.abs(nn.Dense(self.embed)(state))
+        b2 = nn.Dense(1)(nn.relu(nn.Dense(self.embed)(state)))
+        q_tot = jnp.einsum("be,be->b", hidden, w2) + b2[:, 0]
+        return q_tot
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or QMIX)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.buffer_size = 50_000
+        self.learning_starts = 500
+        self.target_network_update_freq = 200   # gradient updates
+        self.double_q = True
+        self.mixing_embed_dim = 32
+        self.n_updates_per_iter = 32
+        self.rollout_fragment_length = 16
+        self.num_envs_per_worker = 32
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 20_000
+        self.model = {"fcnet_hiddens": (64,), "fcnet_activation": "relu"}
+
+
+class QMIX(Algorithm):
+    _config_class = QMIXConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_multi_agent_env(self.env):
+            raise ValueError("QMIX requires a MultiAgentJaxEnv "
+                             "(cooperative, shared reward)")
+        self.agent_ids = tuple(self.env.agent_ids)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        # one Q-module per agent (the reference shares parameters via
+        # agent one-hot; separate nets are the general case and the
+        # fixed agent set keeps it one compiled program either way)
+        self.modules = {
+            aid: QModule(self.env.observation_space(aid),
+                         self.env.action_space(aid), dict(cfg.model))
+            for aid in self.agent_ids}
+        self.params = {aid: m.init(self.next_key())
+                       for aid, m in self.modules.items()}
+        state_dim = sum(
+            int(np.prod(self.env.observation_space(a).shape))
+            for a in self.agent_ids)
+        self.mixer = _MonotonicMixer(len(self.agent_ids),
+                                     cfg.mixing_embed_dim)
+        self.params["__mixer__"] = self.mixer.init(
+            self.next_key(), jnp.zeros((1, state_dim)),
+            jnp.zeros((1, len(self.agent_ids))))["params"]
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        keys = jax.random.split(self.next_key(), cfg.num_envs_per_worker)
+        state, obs = jax.vmap(self.env.reset)(keys)
+        self._carry = {"env_state": state, "obs": obs,
+                       "ep_ret": jnp.zeros(cfg.num_envs_per_worker),
+                       "ep_len": jnp.zeros(cfg.num_envs_per_worker,
+                                           jnp.int32)}
+        self._sample_fn = jax.jit(self._unroll)
+        self._update_fn = jax.jit(self._qmix_update)
+        self._steps_sampled = 0
+        self._num_updates = 0
+        self._last_target_update = 0
+        self._ep_returns: list = []
+        self._ep_lens: list = []
+
+    # -- compiled joint rollout -------------------------------------------
+
+    def _unroll(self, params, carry, key, epsilon):
+        cfg = self.algo_config
+
+        def one_step(carry, step_key):
+            k_act, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            actions = {}
+            akeys = jax.random.split(k_act, len(self.agent_ids))
+            for i, aid in enumerate(self.agent_ids):
+                a, _, _ = self.modules[aid].compute_actions(
+                    params[aid], obs[aid], akeys[i], epsilon=epsilon)
+                actions[aid] = a
+            env_keys = jax.random.split(k_env, cfg.num_envs_per_worker)
+            state, next_obs, rewards, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], actions, env_keys)
+            # cooperative: the TEAM reward is the (identical) shared
+            # scalar; use the first agent's stream
+            team_r = rewards[self.agent_ids[0]]
+            ep_ret = carry["ep_ret"] + team_r
+            ep_len = carry["ep_len"] + 1
+            out = {"obs": obs, "actions": actions,
+                   "next_obs": next_obs, "reward": team_r,
+                   "done": done,
+                   "episode_return": jnp.where(done, ep_ret, jnp.nan),
+                   "episode_len": jnp.where(done, ep_len, -1)}
+            new_carry = {"env_state": state, "obs": next_obs,
+                         "ep_ret": jnp.where(done, 0.0, ep_ret),
+                         "ep_len": jnp.where(done, 0, ep_len)}
+            return new_carry, out
+
+        keys = jax.random.split(key, cfg.rollout_fragment_length)
+        return jax.lax.scan(one_step, carry, keys)
+
+    # -- compiled QMIX update ---------------------------------------------
+
+    def _global_state(self, obs):
+        return jnp.concatenate(
+            [obs[a].reshape(obs[a].shape[0], -1)
+             for a in self.agent_ids], axis=-1)
+
+    def _q_tot(self, params, obs, actions):
+        qs = []
+        for aid in self.agent_ids:
+            q = self.modules[aid].q_values(params[aid], obs[aid])
+            qs.append(jnp.take_along_axis(
+                q, actions[aid][..., None].astype(jnp.int32),
+                axis=-1)[..., 0])
+        agent_qs = jnp.stack(qs, axis=-1)
+        return self.mixer.apply({"params": params["__mixer__"]},
+                                self._global_state(obs), agent_qs)
+
+    def _greedy_joint(self, params, obs):
+        return {aid: jnp.argmax(
+            self.modules[aid].q_values(params[aid], obs[aid]), axis=-1)
+            for aid in self.agent_ids}
+
+    def _qmix_update(self, params, target_params, opt_state, batch):
+        cfg = self.algo_config
+        obs = {a: batch[f"obs_{a}"] for a in self.agent_ids}
+        next_obs = {a: batch[f"next_obs_{a}"] for a in self.agent_ids}
+        actions = {a: batch[f"act_{a}"] for a in self.agent_ids}
+
+        # decentralized greedy argmax (monotonicity makes it the joint
+        # argmax of Q_tot); double-Q: argmax under ONLINE params, value
+        # under TARGET params
+        sel_params = params if cfg.double_q else target_params
+        next_acts = self._greedy_joint(sel_params, next_obs)
+        q_tot_next = self._q_tot(target_params, next_obs, next_acts)
+        nonterm = 1.0 - batch["done"].astype(jnp.float32)
+        target = batch["reward"] + cfg.gamma * nonterm * \
+            jax.lax.stop_gradient(q_tot_next)
+
+        def loss_fn(p):
+            q_tot = self._q_tot(p, obs, actions)
+            return jnp.mean(optax.huber_loss(q_tot, target))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # ---------------------------------------------------------------------
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0,
+                   self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        losses = []
+        self._carry, traj = self._sample_fn(
+            self.params, self._carry, self.next_key(),
+            jnp.asarray(self._epsilon()))
+        host = jax.tree.map(np.asarray, traj)
+        rets = host.pop("episode_return").ravel()
+        lens = host.pop("episode_len").ravel()
+        fin = ~np.isnan(rets)
+        self._ep_returns.extend(rets[fin].tolist())
+        self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
+        self._ep_returns = self._ep_returns[-100:]
+        self._ep_lens = self._ep_lens[-100:]
+        flat = {"reward": host["reward"].reshape(-1),
+                "done": host["done"].reshape(-1)}
+        for a in self.agent_ids:
+            for src, dst in (("obs", "obs"), ("next_obs", "next_obs"),
+                             ("actions", "act")):
+                v = host[src][a]
+                flat[f"{dst}_{a}"] = v.reshape((-1,) + v.shape[2:])
+        self.buffer.add_batch(flat)
+        self._steps_sampled += len(flat["reward"])
+
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.n_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                device_batch = {k: jnp.asarray(v)
+                                for k, v in batch.items()}
+                self.params, self.opt_state, loss = self._update_fn(
+                    self.params, self.target_params, self.opt_state,
+                    device_batch)
+                losses.append(float(loss))
+                self._num_updates += 1
+                if (self._num_updates - self._last_target_update
+                        >= cfg.target_network_update_freq):
+                    self.target_params = jax.tree.map(
+                        jnp.copy, self.params)
+                    self._last_target_update = self._num_updates
+
+        return {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(self._ep_lens))
+                                 if self._ep_lens else float("nan")),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "num_env_steps_sampled": self._steps_sampled,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "target_params": self.target_params,
+                "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("QMIX", QMIX)

@@ -1,0 +1,245 @@
+"""R2D2 — Recurrent Replay Distributed DQN (Kapturowski et al. 2019).
+
+Counterpart of the reference's `rllib/algorithms/r2d2/r2d2.py` +
+`r2d2_torch_policy.py`: LSTM Q-network, SEQUENCE replay with the
+stored-state strategy, burn-in unroll to refresh stale recurrent state,
+double-Q targets, and the paper's eta-mix sequence priority
+(eta*max|td| + (1-eta)*mean|td|).
+
+TPU-first shape: sampling is one compiled scan that carries the LSTM
+state and emits fixed-length fragments WITH their fragment-start state
+(core/recurrent.py) — the replay row IS the scan output, no host-side
+rnn_sequencing repacking. Burn-in + train unrolls are a single jitted
+update over [B, T, ...] sequences, so the MXU sees batched matmuls,
+and the only host work is the sum-tree bookkeeping.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.core.recurrent import (
+    RecurrentInGraphSampler, RecurrentQModule)
+from ray_tpu.rllib.env.jax_env import is_jax_env
+from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or R2D2)
+        self.lr = 1e-3
+        self.train_batch_size = 32          # sequences per update
+        self.buffer_size = 4000             # sequences
+        self.learning_starts = 200          # sequences
+        self.target_network_update_freq = 400   # gradient updates
+        self.double_q = True
+        # sequence shape: burn_in prefix refreshes the stored state with
+        # CURRENT params (no grads), the remainder trains
+        self.burn_in = 8
+        self.rollout_fragment_length = 40   # burn_in + trained steps
+        self.num_envs_per_worker = 32
+        self.n_updates_per_iter = 32
+        self.priority_eta = 0.9             # paper's eta-mix
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 30_000
+        self.model = {"fcnet_hiddens": (64,), "lstm_cell_size": 64}
+
+
+class R2D2(Algorithm):
+    _config_class = R2D2Config
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_jax_env(self.env):
+            raise ValueError("R2D2 v1 requires a JaxEnv (the compiled "
+                             "recurrent sampler carries LSTM state "
+                             "through the scan)")
+        if cfg.burn_in >= cfg.rollout_fragment_length:
+            raise ValueError("burn_in must be < rollout_fragment_length")
+        self.module = RecurrentQModule(self.env.observation_space,
+                                       self.env.action_space, cfg.model)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._rng, k = jax.random.split(self._rng)
+        self.params = self.module.init(k)
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # rows are whole sequences: columns arrive [M, T, ...] plus the
+        # stored state (c0/h0 [M, hidden]); the transition PER buffer
+        # handles sequence-shaped items unchanged
+        self.buffer = PrioritizedReplayBuffer(
+            cfg.buffer_size, cfg.prioritized_replay_alpha,
+            cfg.prioritized_replay_beta, seed=cfg.seed)
+        self.sampler = RecurrentInGraphSampler(
+            self.env, self.module, cfg.num_envs_per_worker,
+            cfg.rollout_fragment_length)
+        self._carry = self.sampler.init_state(self.next_key())
+        self._update_fn = jax.jit(self._sequence_update)
+        self._steps_sampled = 0
+        self._num_updates = 0
+        self._last_target_update = 0
+        self._ep_returns: list = []
+        self._ep_lens: list = []
+
+    # -- compiled sequence update -----------------------------------------
+
+    def _sequence_update(self, params, target_params, opt_state, batch):
+        """One double-Q update over [B, T, ...] sequences with burn-in.
+        Returns per-sequence priorities (eta-mix of |td|)."""
+        cfg = self.algo_config
+        # scan wants time-major
+        obs = jnp.swapaxes(batch[sb.OBS], 0, 1)          # [T, B, ...]
+        actions = jnp.swapaxes(batch[sb.ACTIONS], 0, 1)
+        rewards = jnp.swapaxes(batch[sb.REWARDS], 0, 1)
+        dones = jnp.swapaxes(batch[sb.DONES], 0, 1).astype(jnp.float32)
+        state0 = (batch["state_c"], batch["state_h"])
+        bi = cfg.burn_in
+
+        def unroll(p, s0):
+            # burn-in with current params refreshes the stale stored
+            # state (paper: "burn-in" beats zero-state start); no grads
+            if bi > 0:
+                _, s = self.module.q_unroll(
+                    p, obs[:bi], dones[:bi], s0)
+                s = jax.lax.stop_gradient(s)
+            else:
+                s = s0
+            q, _ = self.module.q_unroll(p, obs[bi:], dones[bi:], s)
+            return q                                      # [L, B, A]
+
+        def loss_fn(p):
+            q = unroll(p, state0)
+            q_target = unroll(target_params, state0)
+            a = actions[bi:].astype(jnp.int32)
+            q_sel = jnp.take_along_axis(
+                q[:-1], a[:-1][..., None], axis=-1)[..., 0]   # [L-1, B]
+            if cfg.double_q:
+                best = jnp.argmax(q[1:], axis=-1)
+            else:
+                best = jnp.argmax(q_target[1:], axis=-1)
+            q_next = jnp.take_along_axis(
+                q_target[1:], best[..., None], axis=-1)[..., 0]
+            nonterm = 1.0 - dones[bi:-1]
+            target = rewards[bi:-1] + cfg.gamma * nonterm * q_next
+            td = q_sel - jax.lax.stop_gradient(target)
+            weights = batch.get(
+                "weights", jnp.ones(td.shape[1]))[None, :]
+            loss = jnp.mean(weights * optax.huber_loss(
+                q_sel, jax.lax.stop_gradient(target)))
+            # paper's sequence priority: eta*max + (1-eta)*mean over time
+            abs_td = jnp.abs(td)
+            prio = (cfg.priority_eta * abs_td.max(axis=0)
+                    + (1.0 - cfg.priority_eta) * abs_td.mean(axis=0))
+            return loss, prio
+
+        (loss, prio), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, prio
+
+    # ---------------------------------------------------------------------
+
+    def _epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0,
+                   self._steps_sampled / max(cfg.epsilon_timesteps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def compute_single_action(self, obs, state=None, explore: bool = False):
+        """Stateful single-step action; returns (action, state) so
+        callers thread the LSTM state (reference: Policy.compute_single_
+        action state in/out)."""
+        if not hasattr(self, "_act_fn"):
+            self._act_fn = jax.jit(
+                lambda p, o, s, k, e: self.module.compute_actions(
+                    p, o, s, k, epsilon=e))
+        if state is None:
+            state = self.module.initial_state(1)
+        eps = self._epsilon() if explore else 0.0
+        a, _, new_state = self._act_fn(
+            self.params, jnp.asarray(obs)[None], state, self.next_key(),
+            jnp.asarray(eps))
+        return int(np.asarray(a)[0]), new_state
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        losses = []
+        self._carry, traj, state0 = self.sampler.sample(
+            self.params, self._carry, self.next_key(),
+            jnp.asarray(self._epsilon()))
+        host = {k: np.asarray(v) for k, v in traj.items()}
+        rets = host.pop("episode_return").ravel()
+        lens = host.pop("episode_len").ravel()
+        fin = ~np.isnan(rets)
+        self._ep_returns.extend(rets[fin].tolist())
+        self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
+        self._ep_returns = self._ep_returns[-100:]
+        self._ep_lens = self._ep_lens[-100:]
+        # fragments [T, num_envs, ...] -> sequence rows [num_envs, T, ...]
+        rows = {k: np.swapaxes(v, 0, 1) for k, v in host.items()}
+        rows["state_c"] = np.asarray(state0[0])
+        rows["state_h"] = np.asarray(state0[1])
+        self.buffer.add_batch(rows)
+        self._steps_sampled += (cfg.rollout_fragment_length
+                                * cfg.num_envs_per_worker)
+
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.n_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                device_batch = {k: jnp.asarray(v)
+                                for k, v in batch.items()
+                                if k != "batch_indexes"}
+                self.params, self.opt_state, loss, prio = self._update_fn(
+                    self.params, self.target_params, self.opt_state,
+                    device_batch)
+                losses.append(float(loss))
+                self._num_updates += 1
+                self.buffer.update_priorities(
+                    batch["batch_indexes"], np.asarray(prio))
+                if (self._num_updates - self._last_target_update
+                        >= cfg.target_network_update_freq):
+                    self.target_params = jax.tree.map(
+                        jnp.copy, self.params)
+                    self._last_target_update = self._num_updates
+
+        return {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(self._ep_lens))
+                                 if self._ep_lens else float("nan")),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "num_env_steps_sampled": self._steps_sampled,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "target_params": self.target_params,
+                "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("R2D2", R2D2)

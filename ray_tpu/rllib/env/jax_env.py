@@ -277,6 +277,69 @@ class EagerJaxEnv:
         return np.asarray(obs), float(r), bool(done), info
 
 
+class StatelessCartPole(CartPole):
+    """CartPole with the velocity components masked out — position and
+    angle only, so the policy must INFER velocities from memory. The
+    classic recurrent-policy benchmark (reference:
+    rllib/examples/env/stateless_cartpole.py)."""
+
+    def __init__(self, env_config: dict | None = None):
+        super().__init__(env_config)
+        self.observation_space = Box(-jnp.inf, jnp.inf, (2,))
+
+    @staticmethod
+    def _mask(obs):
+        return jnp.stack([obs[0], obs[2]])   # x, theta (no derivatives)
+
+    def reset(self, key):
+        state, obs = super().reset(key)
+        return state, self._mask(obs)
+
+    def step(self, state, action, key):
+        state, obs, r, done, info = super().step(state, action, key)
+        return state, self._mask(obs), r, done, info
+
+
+class MemoryRecall(JaxEnv):
+    """Memory probe: a one-hot cue is shown ONLY at t=0; matching the
+    cue's action pays 1 every step for the rest of the episode. The
+    memoryless ceiling is ~(1 + (T-1)/2) in expectation, so beating it
+    requires carrying the cue in recurrent state (reference analogue:
+    rllib/examples/env/repeat_after_me_env.py)."""
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.episode_len = int(cfg.get("episode_len", 10))
+        # obs = [cue0, cue1, t/T]; cue channels nonzero only at t=0
+        self.observation_space = Box(-jnp.inf, jnp.inf, (3,))
+        self.action_space = Discrete(2)
+
+    def _obs(self, cue, t):
+        show = (t == 0).astype(jnp.float32)
+        onehot = jax.nn.one_hot(cue, 2) * show
+        return jnp.concatenate(
+            [onehot, (t / self.episode_len)[None].astype(jnp.float32)])
+
+    def reset(self, key):
+        cue = jax.random.randint(key, (), 0, 2)
+        t = jnp.asarray(0, jnp.int32)
+        return {"cue": cue, "t": t}, self._obs(cue, t)
+
+    def step(self, state, action, key):
+        reward = (action == state["cue"]).astype(jnp.float32)
+        t = state["t"] + 1
+        done = t >= self.episode_len
+        reset_state, reset_obs = self.reset(key)
+        new_state = {"cue": jnp.where(done, reset_state["cue"],
+                                      state["cue"]),
+                     "t": jnp.where(done, reset_state["t"], t)}
+        obs = jnp.where(done, reset_obs,
+                        self._obs(new_state["cue"], new_state["t"]))
+        return new_state, obs, reward, done, {}
+
+
 register_env("CartPole-v1", lambda cfg: CartPole(cfg))
 register_env("Pendulum-v1", lambda cfg: Pendulum(cfg))
 register_env("Acrobot-v1", lambda cfg: Acrobot(cfg))
+register_env("StatelessCartPole", lambda cfg: StatelessCartPole(cfg))
+register_env("MemoryRecall", lambda cfg: MemoryRecall(cfg))

@@ -1,0 +1,138 @@
+"""Recurrent-policy path + R2D2 (reference: rllib/algorithms/r2d2/).
+
+The memory probe is the decisive test: in MemoryRecall the cue appears
+ONLY at t=0 and the rewarded action depends on it for the rest of the
+episode, so a feedforward policy is capped at chance after the first
+step while an LSTM can hold the cue — R2D2 clearing the feedforward
+ceiling proves recurrent state actually flows through rollout, replay
+(stored state + burn-in), and the train unroll.
+"""
+
+import numpy as np
+import pytest
+
+
+def _train(algo_name, env, stop_reward, max_iters, **overrides):
+    from ray_tpu.rllib.algorithms.algorithm import get_algorithm_class
+    cls = get_algorithm_class(algo_name)
+    cfg = cls.get_default_config()
+    cfg.environment(env)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    algo = cfg.build()
+    best = -np.inf
+    for _ in range(max_iters):
+        res = algo.train()
+        r = res.get("episode_reward_mean", float("nan"))
+        if np.isfinite(r):
+            best = max(best, r)
+        if best >= stop_reward:
+            break
+    return best
+
+
+def test_recurrent_module_state_flow():
+    """Unit: LSTM state changes across steps, resets on done, and the
+    step/unroll paths agree (the training unroll must reproduce what the
+    sampler computed)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.core.recurrent import RecurrentQModule
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    mod = RecurrentQModule(Box(-1, 1, (3,)), Discrete(2),
+                           {"fcnet_hiddens": (8,), "lstm_cell_size": 8})
+    params = mod.init(jax.random.PRNGKey(0))
+    s0 = mod.initial_state(2)
+    obs_seq = jax.random.normal(jax.random.PRNGKey(1), (5, 2, 3))
+    dones = jnp.zeros((5, 2))
+
+    # unroll == repeated steps
+    q_unrolled, sT = mod.q_unroll(params, obs_seq, dones, s0)
+    s = s0
+    qs = []
+    for t in range(5):
+        q, s = mod.q_step(params, obs_seq[t], s)
+        qs.append(q)
+    np.testing.assert_allclose(np.asarray(q_unrolled),
+                               np.asarray(jnp.stack(qs)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sT[0]), np.asarray(s[0]),
+                               rtol=1e-5)
+    # state is live (changes between steps)...
+    assert float(jnp.abs(s[1]).sum()) > 0
+    # ...and a done in the middle resets it: the post-done state must
+    # equal a fresh unroll of the suffix from zeros
+    dones_mid = dones.at[2].set(1.0)
+    q_r, s_r = mod.q_unroll(params, obs_seq, dones_mid, s0)
+    q_fresh, s_fresh = mod.q_unroll(
+        params, obs_seq[3:], dones[3:], mod.initial_state(2))
+    np.testing.assert_allclose(np.asarray(q_r[3:]),
+                               np.asarray(q_fresh), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_r[0]),
+                               np.asarray(s_fresh[0]), rtol=1e-5)
+
+
+def test_recurrent_sampler_carries_and_stores_state():
+    """The in-graph sampler threads LSTM state through the scan and
+    returns the fragment-START state (R2D2's stored-state strategy)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.core.recurrent import (
+        RecurrentInGraphSampler, RecurrentQModule)
+    from ray_tpu.rllib.env.jax_env import make_env
+
+    env = make_env("MemoryRecall", {"episode_len": 6})
+    mod = RecurrentQModule(env.observation_space, env.action_space,
+                           {"fcnet_hiddens": (8,), "lstm_cell_size": 8})
+    params = mod.init(jax.random.PRNGKey(0))
+    sampler = RecurrentInGraphSampler(env, mod, num_envs=4,
+                                      rollout_length=5)
+    carry = sampler.init_state(jax.random.PRNGKey(1))
+    c2, traj, state0 = sampler.sample(params, carry,
+                                      jax.random.PRNGKey(2),
+                                      jnp.asarray(0.1))
+    # fragment-start state is the INITIAL zero state on the first call
+    assert float(jnp.abs(state0[0]).sum()) == 0.0
+    # after 5 steps (episode_len 6: nothing done yet) state is nonzero
+    assert float(jnp.abs(c2["policy_state"][0]).sum()) > 0.0
+    _, _, state1 = sampler.sample(params, c2, jax.random.PRNGKey(3),
+                                  jnp.asarray(0.1))
+    # second fragment's stored state == carry state at its start
+    np.testing.assert_allclose(np.asarray(state1[0]),
+                               np.asarray(c2["policy_state"][0]))
+    assert traj["obs"].shape[:2] == (5, 4)
+
+
+def test_r2d2_learns_memory_task():
+    """R2D2 beats the feedforward ceiling on MemoryRecall.
+
+    episode_len=10, cue at t=0 only: acting on the cue every step pays
+    1/step. A memoryless policy earns at most ~1 + 9*0.5 = 5.5 in
+    expectation (chance after t=0); threshold 8 requires genuinely
+    remembered cue bits (reference parity:
+    rllib/algorithms/r2d2 tuned on RepeatAfterMeEnv/stateless envs)."""
+    best = _train(
+        "R2D2", "MemoryRecall", stop_reward=8.0, max_iters=60,
+        train_batch_size=16, buffer_size=2000, learning_starts=64,
+        num_envs_per_worker=16, rollout_fragment_length=12, burn_in=2,
+        n_updates_per_iter=16, target_network_update_freq=100,
+        epsilon_timesteps=6000, lr=2e-3,
+        model={"fcnet_hiddens": (32,), "lstm_cell_size": 32},
+        env_config={"episode_len": 10})
+    assert best >= 8.0, f"R2D2 failed the memory task: best={best:.2f}"
+
+
+def test_dqn_feedforward_fails_memory_task():
+    """Control: the same budget with feedforward DQN stays at the
+    memoryless ceiling — proving the task actually requires memory (and
+    the R2D2 pass isn't an artifact of the env being trivially
+    solvable)."""
+    best = _train(
+        "DQN", "MemoryRecall", stop_reward=8.0, max_iters=25,
+        train_batch_size=64, buffer_size=5000, learning_starts=200,
+        num_envs_per_worker=16, rollout_fragment_length=12,
+        n_updates_per_iter=16, epsilon_timesteps=4000,
+        env_config={"episode_len": 10})
+    assert best < 8.0, (
+        f"feedforward DQN 'solved' the memory task ({best:.2f}) — the "
+        "env no longer requires memory")
