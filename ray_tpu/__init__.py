@@ -26,7 +26,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "get_actor", "kill", "cancel", "method", "ObjectRef", "ActorHandle",
+    "get_actor", "kill", "cancel", "free", "method", "ObjectRef",
+    "ActorHandle",
     "available_resources", "cluster_resources", "get_runtime_context",
     "exceptions", "__version__",
 ]
@@ -250,6 +251,18 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     Running tasks are not interrupted in v1."""
     return _worker.get_client().control(
         "cancel", {"object_id": ref._id, "force": force})
+
+
+def free(refs) -> int:
+    """Unconditionally release objects (reference:
+    `_private/internal_api.py free()`): the caller asserts nothing will
+    read these refs again. Exists for bulk-intermediate lifecycles
+    (e.g. shuffle shards) whose refs rode inside other objects and
+    therefore escaped normal refcounting; returns how many objects were
+    still live."""
+    from ray_tpu._private.worker import ObjectRef as _Ref
+    oids = [r._id if isinstance(r, _Ref) else str(r) for r in refs]
+    return _worker.get_client().control("free_objects", oids)
 
 
 def cluster_resources() -> dict:

@@ -247,6 +247,31 @@ ARGS_RELEASED_CAP = define(
     "Bounded FIFO of task ids whose args were already released "
     "(exactly-once guard on the refcount decrement).")
 
+DATA_PUSH_SHUFFLE_MIN_BLOCKS = define(
+    "DATA_PUSH_SHUFFLE_MIN_BLOCKS", int, 32,
+    "Input-block count above which all-to-all data exchanges insert the "
+    "push-based merge tier (push_based_shuffle.py analog): ~sqrt(M) "
+    "merger fan-in instead of every reducer fetching from all M maps.")
+
+RUNTIME_ENV_CACHE_BYTES = define(
+    "RUNTIME_ENV_CACHE_BYTES", int, 10 << 30,
+    "Total-bytes cap on the runtime-env cache; least-recently-used "
+    "entries are evicted above it (uri_cache.py byte budget analog).")
+
+RUNTIME_ENV_CONDA_TIMEOUT_S = define(
+    "RUNTIME_ENV_CONDA_TIMEOUT_S", float, 1800.0,
+    "Timeout for `conda env create` when materializing a conda "
+    "runtime environment.")
+
+CONDA_BINARY = define(
+    "CONDA_BINARY", str, "conda",
+    "Conda executable used for runtime_env={'conda': ...}.")
+
+CONTAINER_RUNTIME = define(
+    "CONTAINER_RUNTIME", str, "",
+    "Container runtime for runtime_env={'container': ...}; empty = "
+    "autodetect docker then podman.")
+
 HEAD_BACKLOG_CAP = define(
     "HEAD_BACKLOG_CAP", int, 10_000,
     "Max daemon->head messages buffered during a head-channel blip for "

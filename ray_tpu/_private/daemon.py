@@ -629,14 +629,16 @@ class HostDaemon:
         env = spawn.worker_env(chips=chips or None, runtime_env=runtime_env)
         env["RAY_TPU_NODE_ID"] = self.node_id
         try:
-            env, python_exe, cwd = spawn.setup_runtime_env(runtime_env, env)
+            env, python_exe, cwd, cmd_prefix = \
+                spawn.setup_runtime_env(runtime_env, env)
         except RuntimeEnvSetupError:
             with self.lock:
                 self.workers.pop(wid, None)
             raise
         w.proc = spawn.spawn_worker_proc(
             self.address, self.authkey, wid, env, python_exe, cwd,
-            log_dir=os.path.join(self.node_dir, "logs"))
+            log_dir=os.path.join(self.node_dir, "logs"),
+            cmd_prefix=cmd_prefix)
         deadline = time.monotonic() + constants.WORKER_REGISTER_TIMEOUT_S
         with self.cv:
             while not w.alive:

@@ -577,6 +577,28 @@ class NodeServer:
             gangs = [[dict(b) for b in g] for g in self._pending_gangs]
             lm.set_demands(demands, gangs)
 
+    def dashboard_snapshot(self) -> dict:
+        """One cheap gauge sample for the dashboard's timeseries charts
+        (reference: dashboard/modules/metrics/ feeds grafana; here the
+        UI buffers these client-side and draws its own sparklines)."""
+        with self.lock:
+            snap = {
+                "ts": time.time(),
+                "nodes_alive": 1 + sum(
+                    1 for n in self.nodes.values() if n.alive),
+                "workers_alive": sum(
+                    1 for w in self.workers.values()
+                    if w.alive and w.kind != "attach"),
+                "actors_alive": sum(
+                    1 for a in self.actors.values() if not a.dead),
+                "tasks_pending": len(self.pending),
+                "objects_tracked": len(self.directory),
+            }
+        st = self.store.arena_stats() or {}
+        snap["store_used_bytes"] = int(st.get("used", 0))
+        snap["store_num_objects"] = int(st.get("num_objects", 0))
+        return snap
+
     def autoscaler_teardown(self) -> dict:
         """Terminate every provider node (cloud slices!) before the head
         dies — `ray-tpu down` must never leak billed TPU capacity. The
@@ -1318,6 +1340,10 @@ class NodeServer:
             with self.lock:
                 self.metrics_by_proc[wid] = snap
             return True
+        if method == "dashboard_snapshot":
+            return self.dashboard_snapshot()
+        if method == "free_objects":
+            return self.free_objects(payload or [])
         if method == "get_metrics":
             from ray_tpu.util import metrics as _metrics
             with self.lock:
@@ -1365,6 +1391,23 @@ class NodeServer:
     def ref_escape(self, oid: str) -> None:
         with self.lock:
             self.escaped_refs.add(oid)
+
+    def free_objects(self, oids) -> int:
+        """Explicit unconditional release (reference:
+        `_private/internal_api.py free()`): drops the escape pin and all
+        holder records so the normal free path runs. The caller asserts
+        nothing will read these refs again — the API exists for
+        bulk-intermediate lifecycles (shuffle shards) whose nested refs
+        otherwise escape to session lifetime."""
+        n = 0
+        with self.lock:
+            for oid in oids:
+                self.escaped_refs.discard(oid)
+                self.ref_holders.pop(oid, None)
+                if oid in self.directory:
+                    n += 1
+                self._maybe_free_locked(oid)
+        return n
 
     def _pin_task_args_locked(self, spec) -> None:
         for kind, v in list(spec.args) + list(spec.kwargs.values()):
@@ -2711,12 +2754,13 @@ class NodeServer:
         try:
             env = self._worker_env(chips=t.tpu_chips,
                                    runtime_env=t.spec.runtime_env)
-            env, python_exe, cwd = spawn_mod.setup_runtime_env(
-                t.spec.runtime_env, env)
+            env, python_exe, cwd, cmd_prefix = \
+                spawn_mod.setup_runtime_env(t.spec.runtime_env, env)
             w.proc = spawn_mod.spawn_worker_proc(
                 self._address, self._authkey, worker_id, env,
                 python_exe, cwd,
-                log_dir=os.path.join(self.session_dir, "logs"))
+                log_dir=os.path.join(self.session_dir, "logs"),
+                cmd_prefix=cmd_prefix)
         except RuntimeEnvSetupError as e:
             with self.lock:
                 self._release_task_resources(t)
@@ -2878,12 +2922,14 @@ class NodeServer:
             env = self._worker_env(
                 chips=a.tpu_chips,
                 runtime_env=a.creation_spec.runtime_env)
-            env, python_exe, cwd = spawn_mod.setup_runtime_env(
-                a.creation_spec.runtime_env, env)
+            env, python_exe, cwd, cmd_prefix = \
+                spawn_mod.setup_runtime_env(
+                    a.creation_spec.runtime_env, env)
             w.proc = spawn_mod.spawn_worker_proc(
                 self._address, self._authkey, worker_id, env,
                 python_exe, cwd,
-                log_dir=os.path.join(self.session_dir, "logs"))
+                log_dir=os.path.join(self.session_dir, "logs"),
+                cmd_prefix=cmd_prefix)
         except RuntimeEnvSetupError as e:
             with self.lock:
                 self.workers.pop(worker_id, None)

@@ -19,6 +19,22 @@ Supported runtime_env keys (same schema shape as the reference):
                    when requirements are local wheels; anything needing
                    egress fails with RuntimeEnvSetupError.
 - ``py_modules``:  list of local module dirs/files appended to sys.path.
+- ``conda``:       an environment spec dict (environment.yml content) or
+                   a path to one — materialized once into a cached env
+                   via the `conda` binary (reference:
+                   `_private/runtime_env/conda.py`); the worker execs
+                   that env's python. Requires conda on PATH (override:
+                   RAY_TPU_CONDA_BINARY).
+- ``container``:   {"image": ..., "run_options": [...]} — the worker
+                   command is wrapped in `<runtime> run` (docker or
+                   podman, RAY_TPU_CONTAINER_RUNTIME) with /dev/shm and
+                   the checkout mounted so the containerized worker
+                   reaches the node socket and shm arena (reference:
+                   `_private/runtime_env/container.py` worker command
+                   wrapping).
+
+The cache is doubly bounded: entry count AND total bytes
+(RUNTIME_ENV_CACHE_BYTES), LRU-evicted (reference: uri_cache.py).
 """
 
 from __future__ import annotations
@@ -40,7 +56,8 @@ from ray_tpu._private.constants import (
     RUNTIME_ENV_CACHE_ENTRIES as _MAX_CACHE_ENTRIES,
 )
 
-_SETUP_KEYS = ("working_dir", "pip", "py_modules", "env_vars")
+_SETUP_KEYS = ("working_dir", "pip", "py_modules", "env_vars", "conda",
+               "container")
 
 
 def is_trivial(runtime_env: dict | None) -> bool:
@@ -56,6 +73,43 @@ def _normalize_pip(spec) -> list[str]:
     if isinstance(spec, dict):
         spec = spec.get("packages", [])
     return [str(p) for p in spec]
+
+
+_SIZE_SIDECAR = ".rtpu_size"
+
+
+def _entry_bytes(path: str) -> int:
+    """Cached entry size: the sidecar written at commit time, or one
+    walk (then memoized to the sidecar) for pre-sidecar entries."""
+    sidecar = os.path.join(path, _SIZE_SIDECAR)
+    try:
+        with open(sidecar) as f:
+            return int(f.read())
+    except (OSError, ValueError):
+        pass
+    n = _tree_bytes(path)
+    try:
+        with open(sidecar, "w") as f:
+            f.write(str(n))
+    except OSError:
+        pass
+    return n
+
+
+def _tree_bytes(path: str) -> int:
+    if os.path.isfile(path):
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
 
 
 def _dir_fingerprint(path: str) -> str:
@@ -93,13 +147,29 @@ class RuntimeEnvManager:
 
     def setup(self, runtime_env: dict | None):
         """Materialize `runtime_env`. Returns (env_overrides, cwd,
-        python_exe) — python_exe is None unless a pip venv applies.
+        python_exe, cmd_prefix) — python_exe is None unless a pip venv /
+        conda env applies; cmd_prefix is a command-line wrapper (the
+        container runtime invocation) or None.
         Raises RuntimeEnvSetupError on any failure."""
         env: dict[str, str] = {}
         cwd = None
         python_exe = None
+        cmd_prefix = None
         if not runtime_env:
-            return env, cwd, python_exe
+            return env, cwd, python_exe, cmd_prefix
+        # validate the SHAPE before materializing anything — a rejected
+        # combination must not first burn minutes building a venv
+        if runtime_env.get("conda") and runtime_env.get("pip"):
+            raise RuntimeEnvSetupError(
+                "runtime_env cannot combine 'pip' and 'conda' "
+                "(pin pip packages inside the conda spec instead)")
+        if runtime_env.get("container") and (
+                runtime_env.get("pip") or runtime_env.get("conda")):
+            # host-side venv/conda paths don't exist inside the image;
+            # silently mounting them would half-work at best
+            raise RuntimeEnvSetupError(
+                "runtime_env cannot combine 'container' with "
+                "'pip'/'conda' — bake the packages into the image")
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         pypath: list[str] = []
@@ -116,11 +186,18 @@ class RuntimeEnvManager:
                 # the venv's site-packages must SHADOW the parent's
                 # propagated sys.path or version pins are silently ignored
                 pypath.append(site_dir)
+        conda = runtime_env.get("conda")
+        if conda:
+            python_exe = self._setup_conda(conda)
+        container = runtime_env.get("container")
+        if container:
+            cmd_prefix = self._container_prefix(
+                container, runtime_env.get("env_vars") or {})
         if pypath:
             # spawn.propagate_pythonpath places these first (after the
             # worker sitecustomize) so the env wins over inherited paths
             env["RAY_TPU_RUNTIME_ENV_PATHS"] = os.pathsep.join(pypath)
-        return env, cwd, python_exe
+        return env, cwd, python_exe, cmd_prefix
 
     # -- working_dir ------------------------------------------------------
 
@@ -212,6 +289,113 @@ class RuntimeEnvManager:
             venv_dir, "lib", "python*", "site-packages"))
         return python_exe, (sites[0] if sites else None)
 
+    # -- conda ------------------------------------------------------------
+
+    def _setup_conda(self, spec) -> str:
+        """Materialize a conda env into the cache; returns its python.
+        `spec` is an environment.yml dict or a path to one (reference:
+        `_private/runtime_env/conda.py` get_or_create_conda_env)."""
+        from ray_tpu._private import config as _config
+        conda_bin = shutil.which(_config.get("CONDA_BINARY"))
+        if conda_bin is None:
+            raise RuntimeEnvSetupError(
+                "runtime_env 'conda' requires the conda binary on PATH "
+                "(or RAY_TPU_CONDA_BINARY); it is not installed here")
+        if isinstance(spec, str):
+            spec = os.path.abspath(os.path.expanduser(spec))
+            if not os.path.isfile(spec):
+                raise RuntimeEnvSetupError(
+                    f"conda spec file {spec!r} does not exist")
+            with open(spec) as f:
+                content = f.read()
+        else:
+            content = json.dumps(spec, sort_keys=True)
+        key = "conda_" + hashlib.sha1(content.encode()).hexdigest()[:16]
+        dest = os.path.join(self.cache_root, key)
+        python_exe = os.path.join(dest, "bin", "python")
+        with self._entry_lock(key):
+            if not os.path.exists(python_exe):
+                os.makedirs(self.cache_root, exist_ok=True)
+                import tempfile
+                tmp = dest + ".tmp.%d" % os.getpid()
+                shutil.rmtree(tmp, ignore_errors=True)
+                # spec lives OUTSIDE the cache (a sidecar in cache_root
+                # would count as its own LRU entry and skew eviction)
+                with tempfile.NamedTemporaryFile(
+                        "w", suffix=".yml", delete=False) as f:
+                    f.write(content)
+                    spec_path = f.name
+                try:
+                    subprocess.run(
+                        [conda_bin, "env", "create", "--yes",
+                         "-p", tmp, "-f", spec_path],
+                        check=True, capture_output=True,
+                        timeout=constants.RUNTIME_ENV_CONDA_TIMEOUT_S)
+                except subprocess.CalledProcessError as e:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeEnvSetupError(
+                        "conda runtime_env setup failed: "
+                        f"{(e.stderr or b'').decode()[-2000:]}") from None
+                except subprocess.TimeoutExpired:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise RuntimeEnvSetupError(
+                        "conda runtime_env setup timed out") from None
+                finally:
+                    try:
+                        os.unlink(spec_path)
+                    except OSError:
+                        pass
+                self._commit(tmp, dest)
+                if not os.path.exists(python_exe):
+                    raise RuntimeEnvSetupError(
+                        f"conda env at {dest} has no bin/python")
+            self._touch(dest)
+        self._prune()
+        return python_exe
+
+    # -- container --------------------------------------------------------
+
+    @staticmethod
+    def _container_prefix(spec, env_vars: dict | None = None) -> list[str]:
+        """Command prefix wrapping the worker in a container (reference:
+        `_private/runtime_env/container.py` worker command wrapping).
+        /dev/shm (session dirs, arena, node sockets) and the checkout
+        ride host mounts so the containerized worker still reaches its
+        daemon and shares the zero-copy store. Bare `--env NAME` entries
+        forward values from the spawner's Popen env, which carries the
+        worker-env decisions (CPU gating, chip visibility, node id) and
+        the runtime_env env_vars."""
+        from ray_tpu._private import config as _config
+        if isinstance(spec, str):
+            spec = {"image": spec}
+        image = spec.get("image")
+        if not image:
+            raise RuntimeEnvSetupError(
+                "runtime_env 'container' needs an 'image'")
+        runtime = _config.get("CONTAINER_RUNTIME")
+        if not runtime:
+            runtime = ("docker" if shutil.which("docker")
+                       else "podman" if shutil.which("podman") else None)
+        if runtime is None or shutil.which(runtime) is None:
+            raise RuntimeEnvSetupError(
+                "runtime_env 'container' requires docker or podman on "
+                "PATH (or RAY_TPU_CONTAINER_RUNTIME)")
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        prefix = [runtime, "run", "--rm", "--network=host",
+                  "-v", "/dev/shm:/dev/shm",
+                  "-v", f"{pkg_root}:{pkg_root}:ro"]
+        forward = ["RAY_TPU_AUTHKEY", "PYTHONPATH", "RAY_TPU_WORKER",
+                   "RAY_TPU_WORKER_FORCE_CPU", "JAX_PLATFORMS",
+                   "RAY_TPU_NODE_ID", "RAY_TPU_RUNTIME_ENV_PATHS",
+                   constants.TPU_VISIBLE_CHIPS_ENV, "TPU_PROCESS_BOUNDS"]
+        forward += [str(k) for k in (env_vars or {})]
+        for name in forward:
+            prefix += ["--env", name]
+        prefix += [str(o) for o in spec.get("run_options") or []]
+        prefix.append(image)
+        return prefix
+
     # -- cache plumbing ---------------------------------------------------
 
     @staticmethod
@@ -219,7 +403,14 @@ class RuntimeEnvManager:
         """Publish a finished cache entry. The entry locks are
         per-process; another daemon on this host may have won the same
         key — losing the rename race just means the entry already exists
-        (content-addressed, so identical)."""
+        (content-addressed, so identical). The entry's tree size is
+        recorded once here so _prune never re-walks big trees (a conda
+        env is easily 100k files)."""
+        try:
+            with open(os.path.join(tmp, _SIZE_SIDECAR), "w") as f:
+                f.write(str(_tree_bytes(tmp)))
+        except OSError:
+            pass
         try:
             os.rename(tmp, dest)
         except OSError:
@@ -240,7 +431,10 @@ class RuntimeEnvManager:
             pass
 
     def _prune(self) -> None:
-        """Drop least-recently-used cache entries above the cap."""
+        """Drop least-recently-used cache entries above the caps: entry
+        COUNT and total BYTES (reference: uri_cache.py evicts on a byte
+        budget)."""
+        from ray_tpu._private import config as _config
         try:
             entries = [
                 os.path.join(self.cache_root, e)
@@ -248,11 +442,22 @@ class RuntimeEnvManager:
                 if ".tmp." not in e]       # in-flight builds carry pids
         except FileNotFoundError:
             return
-        if len(entries) <= _MAX_CACHE_ENTRIES:
+        max_bytes = _config.get("RUNTIME_ENV_CACHE_BYTES")
+        sizes = {p: _entry_bytes(p) for p in entries}
+        total = sum(sizes.values())
+        if len(entries) <= _MAX_CACHE_ENTRIES and total <= max_bytes:
             return
         entries.sort(key=lambda p: os.path.getmtime(p))
-        for path in entries[:len(entries) - _MAX_CACHE_ENTRIES]:
+        while entries and (len(entries) > _MAX_CACHE_ENTRIES
+                           or total > max_bytes):
+            path = entries.pop(0)
+            total -= sizes.get(path, 0)
             shutil.rmtree(path, ignore_errors=True)
+            if os.path.isfile(path):           # spec sidecars (.yml)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
 
 _manager: RuntimeEnvManager | None = None

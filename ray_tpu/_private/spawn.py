@@ -212,12 +212,14 @@ class _ForkServerClient:
 _forkserver = _ForkServerClient()
 
 
-def _fork_eligible(env: dict, python_exe, cwd) -> bool:
+def _fork_eligible(env: dict, python_exe, cwd,
+                   cmd_prefix=None) -> bool:
     """Fork only the common case: CPU worker, default interpreter, no
-    runtime-env path/cwd overrides. TPU workers must gate plugin
-    registration before ANY import (env decides at exec time), and venv
-    workers need their own interpreter."""
-    return (python_exe is None and cwd is None
+    runtime-env path/cwd overrides, no container wrapper. TPU workers
+    must gate plugin registration before ANY import (env decides at
+    exec time), and venv/conda/container workers need their own
+    interpreter/command line."""
+    return (python_exe is None and cwd is None and cmd_prefix is None
             and not env.get("RAY_TPU_RUNTIME_ENV_PATHS")
             and constants.TPU_VISIBLE_CHIPS_ENV not in env
             and env.get("JAX_PLATFORMS") == "cpu"
@@ -227,7 +229,8 @@ def _fork_eligible(env: dict, python_exe, cwd) -> bool:
 def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
                       env: dict, python_exe: str | None = None,
                       cwd: str | None = None,
-                      log_dir: str | None = None):
+                      log_dir: str | None = None,
+                      cmd_prefix: list | None = None):
     """Start a worker process that will register at `address`. The
     common (CPU, default-env) case forks from a warm factory —
     milliseconds instead of a cold interpreter exec; everything else
@@ -237,7 +240,7 @@ def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
     env = propagate_pythonpath(dict(env))
     env["RAY_TPU_AUTHKEY"] = authkey.hex()
     from ray_tpu._private import config
-    if _fork_eligible(env, python_exe, cwd):
+    if _fork_eligible(env, python_exe, cwd, cmd_prefix):
         log_path = None
         if log_dir is not None and config.get("WORKER_LOG_REDIRECT"):
             os.makedirs(log_dir, exist_ok=True)
@@ -247,8 +250,12 @@ def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
         if proc is not None:
             return proc
         # factory unavailable: fall through to exec
-    cmd = [python_exe or sys.executable,
-           "-m", "ray_tpu._private.worker_main", address, worker_id]
+    # inside a container the HOST interpreter path means nothing; the
+    # image's python3 + the mounted checkout (PYTHONPATH forwarded by
+    # the runtime's --env passthrough) resolve the worker
+    exe = python_exe or ("python3" if cmd_prefix else sys.executable)
+    cmd = list(cmd_prefix or []) + [
+        exe, "-m", "ray_tpu._private.worker_main", address, worker_id]
     logf = worker_log_file(log_dir, worker_id)   # ids carry their prefix
     try:
         return subprocess.Popen(
@@ -261,14 +268,15 @@ def spawn_worker_proc(address: str, authkey: bytes, worker_id: str,
 
 def setup_runtime_env(runtime_env: dict | None, env: dict):
     """Materialize a runtime environment (runtime_env.py) and merge its
-    env overrides into `env`. Returns (env, python_exe, cwd); raises
-    RuntimeEnvSetupError on failure."""
+    env overrides into `env`. Returns (env, python_exe, cwd,
+    cmd_prefix); raises RuntimeEnvSetupError on failure."""
     from ray_tpu._private.runtime_env import get_manager, is_trivial
     from ray_tpu.exceptions import RuntimeEnvSetupError
     if is_trivial(runtime_env):
-        return env, None, None
+        return env, None, None, None
     try:
-        overrides, cwd, python_exe = get_manager().setup(runtime_env)
+        overrides, cwd, python_exe, cmd_prefix = \
+            get_manager().setup(runtime_env)
     except RuntimeEnvSetupError:
         raise
     except Exception as e:
@@ -277,4 +285,4 @@ def setup_runtime_env(runtime_env: dict | None, env: dict):
         raise RuntimeEnvSetupError(
             f"runtime env setup failed: {e!r}") from e
     env.update(overrides)
-    return env, python_exe, cwd
+    return env, python_exe, cwd, cmd_prefix

@@ -87,6 +87,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, text, "text/plain; version=0.0.4")
             if path == "/api/summary":
                 return self._send(200, type(self).control("summarize_tasks"))
+            if path == "/api/metrics_snapshot":
+                # gauge sample for the UI's client-side timeseries
+                return self._send(
+                    200, type(self).control("dashboard_snapshot"))
             if path == "/api/timeline":
                 return self._send(200, type(self).control("timeline"))
             if path == "/api/jobs":

@@ -1,10 +1,20 @@
 """All-to-all (barrier) operators: repartition, shuffle, sort, groupby.
 
 Counterpart of the reference's exchange ops (`_internal/shuffle.py`,
-`push_based_shuffle.py`, `sort.py`, `fast_repartition.py`). Two-phase
-exchange: map-side partition tasks write shard lists to the object store;
-reduce-side tasks fetch their shard index from each list (worker->store->
-worker; the driver only moves refs and tiny boundary samples, never data).
+`push_based_shuffle.py`, `sort.py`, `fast_repartition.py`).
+
+Exchange layout: map-side tasks put EVERY output shard as its own
+object and return only refs, so a reduce task's arguments are exactly
+its own shards — the store localizes them shard-by-shard (never a
+whole mapper output). Above PUSH_SHUFFLE_MIN_BLOCKS input blocks a
+PUSH-BASED merge tier slots in (reference:
+`push_based_shuffle.py`): mappers are grouped ~sqrt(M) wide, merger
+tasks pre-concatenate each group's shards per partition WHILE other
+mappers still run (the task graph pipelines map->merge naturally), and
+the final reduce fans in over mergers instead of all M mappers —
+O(sqrt(M)) fan-in per task instead of O(M), which is what keeps
+hundreds-of-blocks exchanges off the quadratic cliff. The driver only
+ever moves refs and tiny boundary samples.
 """
 
 from __future__ import annotations
@@ -78,15 +88,44 @@ def _sample_task(block, key, k):
     return np.sort(col)[idx]
 
 
-# -- reduce side ------------------------------------------------------------
+# -- merge / reduce side ----------------------------------------------------
 
-def _fetch_shards(shard_list_refs, index):
-    return [ray_tpu.get(r)[index] for r in shard_list_refs]
+def _put_shards(shards):
+    """Map/merge tail: every shard becomes its own object so a consumer
+    pulls exactly the shards addressed to it."""
+    return [ray_tpu.put(s) for s in shards]
 
 
-def _concat_task(shard_list_refs, index, shuffle_seed=None, sort_key=None,
+def _split_put_task(split_fn, block, args):
+    """Map tail shared by every exchange: split, then one object PER
+    shard (a consumer pulls exactly the shards addressed to it)."""
+    return _put_shards(split_fn(block, *args))
+
+
+def _merge_task(n_part, *ref_lists):
+    """Push-based merge of one mapper group: the args are the group's
+    per-mapper [shard refs] lists (tiny — the scheduler starts this task
+    the moment ITS group's mappers finish, while other groups still
+    map). Concatenates per partition; returns one ref per partition."""
+    out = []
+    for p in range(n_part):
+        shards = ray_tpu.get([lst[p] for lst in ref_lists])
+        out.append(concat_blocks(shards))
+    return _put_shards(out)
+
+
+def _fetch_partition(list_refs, index):
+    """Two tiny hops: resolve each upstream [shard refs] list (bytes),
+    then fetch ONLY partition `index`'s shard from each."""
+    lists = ray_tpu.get(list(list_refs))
+    return ray_tpu.get([lst[index] for lst in lists])
+
+
+def _concat_task(ref_lists, index, shuffle_seed=None, sort_key=None,
                  descending=False):
-    block = concat_blocks(_fetch_shards(shard_list_refs, index))
+    """Reduce: fetch partition `index`'s shard from every upstream
+    [refs] list (mapper or merger outputs) and concatenate."""
+    block = concat_blocks(_fetch_partition(ref_lists, index))
     acc = BlockAccessor.for_block(block)
     if shuffle_seed is not None:
         rng = np.random.default_rng(shuffle_seed)
@@ -100,11 +139,11 @@ def _concat_task(shard_list_refs, index, shuffle_seed=None, sort_key=None,
     return _store(block)
 
 
-def _groupby_task(shard_list_refs, index, key, aggs):
+def _groupby_task(ref_lists, index, key, aggs):
     """Per-partition pandas groupby (equal keys are co-located by the hash
     exchange, so per-partition aggregation is exact)."""
     import pandas as pd
-    block = concat_blocks(_fetch_shards(shard_list_refs, index))
+    block = concat_blocks(_fetch_partition(ref_lists, index))
     df = BlockAccessor.for_block(block).to_pandas()
     if df.empty:
         return _store({})
@@ -126,19 +165,57 @@ def _collect(task_refs):
     return [ray_tpu.get(r, timeout=600) for r in task_refs]
 
 
-def _exchange(blocks, n_out, split_fn, split_args, concat_fn, concat_args):
-    """Generic 2-phase exchange skeleton."""
-    split = ray_tpu.remote(split_fn)
-    # shard-list refs stay refs: reduce tasks fetch them from the store.
-    shard_list_refs = [split.remote(ref, *split_args(i))
-                       for i, (ref, _) in enumerate(blocks)]
+def _exchange(blocks, n_out, split_fn, split_args, concat_fn,
+              concat_args, stats_op=None):
+    """Generic exchange skeleton: map -> [push-based merge ->] reduce.
+    Everything between the stages is refs; shard data moves worker->
+    store->worker only."""
+    import math
+
+    from ray_tpu._private import config as _config
+
+    split = ray_tpu.remote(_split_put_task)
+    shard_lists = [split.remote(split_fn, ref, list(split_args(i)))
+                   for i, (ref, _) in enumerate(blocks)]
+    m = len(shard_lists)
+    threshold = _config.get("DATA_PUSH_SHUFFLE_MIN_BLOCKS")
+    note = f"direct exchange: {m} maps -> {n_out} partitions"
+    sources = shard_lists
+    if m >= threshold and n_out > 1:
+        # push tier: ~sqrt(M) mappers per merger; a merger starts the
+        # moment its own group finishes (pipelined against later maps)
+        group = max(2, int(math.ceil(math.sqrt(m))))
+        merge = ray_tpu.remote(_merge_task)
+        sources = [merge.remote(n_out, *shard_lists[g:g + group])
+                   for g in range(0, m, group)]
+        note = (f"push-based shuffle: {m} maps -> {len(sources)} "
+                f"mergers (fan-in {group}) -> {n_out} partitions")
+    if stats_op is not None:
+        stats_op.extra = note
     concat = ray_tpu.remote(concat_fn)
-    out = [concat.remote(list(shard_list_refs), i, *concat_args(i))
+    out = [concat.remote(list(sources), i, *concat_args(i))
            for i in range(n_out)]
-    return _collect(out)
+    result = _collect(out)
+    # Intermediate lifecycle: shard refs rode INSIDE list objects, which
+    # marks them escaped (session-lifetime) — per-epoch shuffles would
+    # leak a dataset's worth of arena per epoch. The reduce is done with
+    # every shard, so free them all explicitly (the reference's
+    # push_based_shuffle frees its intermediates the same way).
+    inter_lists = list(shard_lists)
+    if sources is not shard_lists:
+        inter_lists += list(sources)
+    try:
+        nested = ray_tpu.get(inter_lists, timeout=600)
+        ray_tpu.free([r for lst in nested for r in lst] + inter_lists)
+    except Exception:
+        pass    # cleanup only; the exchange result is already safe
+    # NOTE: the OUTPUT block refs (inside `result`) remain
+    # session-lifetime — dataset results have no destructor-driven
+    # lifecycle yet; wiring Dataset GC to ray_tpu.free is future work.
+    return result
 
 
-def run(op, blocks):
+def run(op, blocks, stats_op=None):
     kind = op.kind
     o = op.options
     if kind == "repartition":
@@ -161,7 +238,8 @@ def run(op, blocks):
         return _exchange(
             blocks, n, _range_split_task,
             lambda i: (per_block_bounds[i],),
-            _concat_task, lambda i: (None, None, False))
+            _concat_task, lambda i: (None, None, False),
+            stats_op=stats_op)
     if kind == "random_shuffle":
         n = o.get("num_blocks") or max(len(blocks), 1)
         seed = o.get("seed")
@@ -171,7 +249,8 @@ def run(op, blocks):
         return _exchange(blocks, n, _split_task,
                          lambda i: (n, seed + i),
                          _concat_task,
-                         lambda i: (seed + 31 * i + 7, None, False))
+                         lambda i: (seed + 31 * i + 7, None, False),
+                         stats_op=stats_op)
     if kind == "sort":
         key, desc = o["key"], o.get("descending", False)
         n = max(len(blocks), 1)
@@ -188,12 +267,14 @@ def run(op, blocks):
         return _exchange(
             blocks, len(boundaries) + 1,
             _boundary_split_task, lambda i: (boundaries, key, desc),
-            _concat_task, lambda i: (None, key, desc))
+            _concat_task, lambda i: (None, key, desc),
+            stats_op=stats_op)
     if kind == "groupby_agg":
         key, aggs = o["key"], o["aggs"]
         n = min(max(len(blocks), 1), 8)
         out = _exchange(blocks, n, _hash_split_task, lambda i: (n, key),
-                        _groupby_task, lambda i: (key, aggs))
+                        _groupby_task, lambda i: (key, aggs),
+                        stats_op=stats_op)
         return [(r, m) for r, m in out if m.num_rows > 0]
     raise ValueError(kind)
 

@@ -338,10 +338,14 @@ def execute_plan(plan: plan_mod.ExecutionPlan):
             i = j
         elif isinstance(op, plan_mod.AllToAll):
             # materialize INSIDE a generator so the timed wrapper charges
-            # the barrier's compute to this op, not ~0s
-            def _run_barrier(_op=op, _up=stream):
-                yield from allops.run(_op, list(_up))
-            stream = timed(_run_barrier(), op.name)
+            # the barrier's compute to this op, not ~0s; the op stats
+            # object rides along so the exchange can record its strategy
+            # (direct vs push-based + merge fan-in)
+            op_stats = stats.new_op(op.name)
+
+            def _run_barrier(_op=op, _up=stream, _os=op_stats):
+                yield from allops.run(_op, list(_up), stats_op=_os)
+            stream = timed_stage(_run_barrier(), op_stats, stats)
             i += 1
         elif isinstance(op, plan_mod.Limit):
             stream = timed(_limit_stream(stream, op.n), op.name)

@@ -18,6 +18,7 @@ class OpStats:
     rows: int = 0
     bytes: int = 0
     wall_s: float = 0.0
+    extra: str = ""     # op-specific note (e.g. shuffle strategy/fan-in)
 
 
 @dataclass
@@ -39,9 +40,10 @@ class PlanStats:
                  "(stage times include upstream pull)"]
         for op in self.ops:
             mb = op.bytes / (1024 * 1024)
+            tail = f" [{op.extra}]" if op.extra else ""
             lines.append(
                 f"  {op.name}: {op.wall_s:.3f}s, {op.blocks} blocks, "
-                f"{op.rows} rows, {mb:.2f} MiB")
+                f"{op.rows} rows, {mb:.2f} MiB{tail}")
         return "\n".join(lines)
 
 

@@ -55,6 +55,13 @@ def test_dashboard_healthz_and_state(cluster, dashboard_port):
     m.Counter("dash_probe", "d").inc(1.0)
     text = _get(dashboard_port, "/metrics")
     assert "ray_tpu_dash_probe 1.0" in text   # prometheus exposition
+    # timeseries gauge sample feeding the UI's sparkline charts
+    snap = _get(dashboard_port, "/api/metrics_snapshot")
+    assert snap["nodes_alive"] >= 1 and snap["workers_alive"] >= 1
+    assert snap["ts"] > 0 and "store_used_bytes" in snap
+    # and the page itself carries the chart machinery
+    page = _get(dashboard_port, "/")
+    assert "metrics_snapshot" in page and "sparkline" in page
 
 
 def test_job_submit_success_and_logs(cluster):
