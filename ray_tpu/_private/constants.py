@@ -247,6 +247,12 @@ ARGS_RELEASED_CAP = define(
     "Bounded FIFO of task ids whose args were already released "
     "(exactly-once guard on the refcount decrement).")
 
+COLLECTIVE_MAX_BYTES = define(
+    "COLLECTIVE_MAX_BYTES", int, 64 << 20,
+    "Per-payload cap on host-side util.collective verbs — the rendezvous "
+    "actor is a control-plane funnel; device tensors belong in-graph "
+    "(psum/all_gather over a Mesh axis).")
+
 DATA_PUSH_SHUFFLE_MIN_BLOCKS = define(
     "DATA_PUSH_SHUFFLE_MIN_BLOCKS", int, 32,
     "Input-block count above which all-to-all data exchanges insert the "

@@ -163,13 +163,17 @@ class RuntimeEnvManager:
             raise RuntimeEnvSetupError(
                 "runtime_env cannot combine 'pip' and 'conda' "
                 "(pin pip packages inside the conda spec instead)")
-        if runtime_env.get("container") and (
-                runtime_env.get("pip") or runtime_env.get("conda")):
-            # host-side venv/conda paths don't exist inside the image;
-            # silently mounting them would half-work at best
-            raise RuntimeEnvSetupError(
-                "runtime_env cannot combine 'container' with "
-                "'pip'/'conda' — bake the packages into the image")
+        if runtime_env.get("container"):
+            clash = [k for k in ("pip", "conda", "working_dir",
+                                 "py_modules") if runtime_env.get(k)]
+            if clash:
+                # host-side cache paths (venvs, conda envs, staged
+                # working dirs) don't exist inside the image; forwarding
+                # them would fail at import time with no hint why
+                raise RuntimeEnvSetupError(
+                    f"runtime_env cannot combine 'container' with "
+                    f"{clash} — bake packages and code into the image "
+                    "(env_vars still apply)")
         for k, v in (runtime_env.get("env_vars") or {}).items():
             env[str(k)] = str(v)
         pypath: list[str] = []
@@ -448,8 +452,12 @@ class RuntimeEnvManager:
         if len(entries) <= _MAX_CACHE_ENTRIES and total <= max_bytes:
             return
         entries.sort(key=lambda p: os.path.getmtime(p))
+        # never evict the newest entry for the BYTE budget: a single
+        # entry larger than the budget was just handed to a spawner —
+        # deleting it would strand the worker on a vanished interpreter
+        # (and rebuild/evict forever)
         while entries and (len(entries) > _MAX_CACHE_ENTRIES
-                           or total > max_bytes):
+                           or (total > max_bytes and len(entries) > 1)):
             path = entries.pop(0)
             total -= sizes.get(path, 0)
             shutil.rmtree(path, ignore_errors=True)

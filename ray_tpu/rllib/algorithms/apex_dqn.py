@@ -3,11 +3,13 @@
 Counterpart of the reference's `rllib/algorithms/apex_dqn/` (Horgan et
 al. 2018): N rollout actors explore with PER-ACTOR epsilons
 (eps_i = eps^(1 + alpha * i / (N-1)), the paper's diversity schedule),
-their experience lands in one central prioritized replay buffer, and
-the learner takes many TD-update steps per collection round, feeding
-updated priorities back. The TD update and target-network machinery are
-DQN's own jitted functions; what Ape-X adds is the actor fan-out and
-priority feedback loop.
+their experience round-robins into a fleet of SHARDED prioritized
+replay actors (reference: `apex_dqn.py:328-337` ReplayActor fleet), and
+the learner pipelines sampled batches — the next shard's sample is in
+flight while the current batch trains — feeding updated priorities back
+to the shard that served each batch. The TD update and target-network
+machinery are DQN's own jitted functions; what Ape-X adds is the actor
+fan-out, sharded replay, and the priority feedback loop.
 """
 
 from __future__ import annotations
@@ -19,7 +21,6 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import register_algorithm
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib import sample_batch as sb
-from ray_tpu.rllib.replay_buffers import PrioritizedReplayBuffer
 from ray_tpu.rllib.worker_set import WorkerSet, merge_episode_stats
 
 
@@ -34,6 +35,10 @@ class ApexDQNConfig(DQNConfig):
         self.exploration_epsilon_alpha = 7.0
         self.n_updates_per_iter = 32
         self.learning_starts = 500
+        # replay shards as ACTORS (reference: apex ReplayActor fleet) —
+        # ingest/sampling scale with shards instead of funneling through
+        # the learner process
+        self.num_replay_shards = 2
 
 
 class _EpsilonPolicy:
@@ -89,13 +94,23 @@ class ApexDQN(DQN):
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
-        if cfg.prioritized_replay:
-            self.buffer = PrioritizedReplayBuffer(
-                cfg.buffer_size, cfg.prioritized_replay_alpha,
-                cfg.prioritized_replay_beta, seed=cfg.seed)
-        else:
-            from ray_tpu.rllib.replay_buffers import ReplayBuffer
-            self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        # SHARDED replay: one ReplayActor per shard; adds round-robin
+        # from collection, samples round-robin into the learner, and
+        # priorities flow back to the shard that served the batch
+        import ray_tpu as _rt
+        from ray_tpu.rllib.replay_buffers import ReplayActor
+        n_shards = max(1, cfg.num_replay_shards)
+        shard_cap = max(1, cfg.buffer_size // n_shards)
+        actor_cls = _rt.remote(num_cpus=0)(ReplayActor)
+        self.replay_shards = [
+            actor_cls.remote(shard_cap, cfg.prioritized_replay_alpha,
+                             cfg.prioritized_replay_beta,
+                             seed=cfg.seed + i,
+                             prioritized=cfg.prioritized_replay)
+            for i in range(n_shards)]
+        self._add_rr = 0
+        self._sample_rr = 0
+        self._pending_adds: list = []
         self._steps_sampled = 0
         self._num_updates = 0
         self._last_target_update = 0
@@ -125,21 +140,59 @@ class ApexDQN(DQN):
             num_cpus_per_worker=cfg.num_cpus_per_worker,
             connectors=cfg.connector_dict())
 
+    def cleanup(self) -> None:
+        import ray_tpu as _rt
+        super().cleanup()
+        # the replay fleet is ours: without this, repeated build/cleanup
+        # cycles (tune sweeps) accumulate dead shard actors + buffers
+        for s in getattr(self, "replay_shards", []):
+            try:
+                _rt.kill(s)
+            except Exception:
+                pass
+        self.replay_shards = []
+
     def training_step(self) -> dict:
+        import ray_tpu as _rt
         cfg = self.algo_config
+        n_shards = len(self.replay_shards)
         batches, _last_vals, stats_list = self.workers.sample_all(
             self.params)
+        # backpressure from LAST round's adds (one round in flight keeps
+        # collection and shard ingest overlapped without unbounded queues)
+        if self._pending_adds:
+            _rt.get(self._pending_adds, timeout=300)
+        self._pending_adds = []
         for batch in batches:
             flat = {k: np.asarray(batch[k])
                     for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
                               sb.NEXT_OBS)}
-            self.buffer.add_batch(flat)
+            shard = self.replay_shards[self._add_rr % n_shards]
+            self._add_rr += 1
+            self._pending_adds.append(shard.add_batch.remote(flat))
             self._steps_sampled += len(flat[sb.OBS])
 
+        sizes = _rt.get([s.size.remote() for s in self.replay_shards],
+                        timeout=300)
         losses = []
-        if len(self.buffer) >= cfg.learning_starts:
-            for _ in range(cfg.n_updates_per_iter):
-                batch = self.buffer.sample(cfg.train_batch_size)
+        if sum(sizes) >= cfg.learning_starts:
+            # pipeline: next shard's sample is in flight while the
+            # learner updates on the current batch
+            def req():
+                shard_i = self._sample_rr % n_shards
+                self._sample_rr += 1
+                shard = self.replay_shards[shard_i]
+                return shard_i, shard.sample.remote(cfg.train_batch_size)
+            inflight = req()
+            for i in range(cfg.n_updates_per_iter):
+                shard_i, ref = inflight
+                batch = _rt.get(ref, timeout=300)
+                # prefetch ONLY while iterations remain: a trailing
+                # request would serialize a whole batch just to discard
+                inflight = (req() if i + 1 < cfg.n_updates_per_iter
+                            else None)
+                if batch is None:       # shard still filling
+                    continue
                 device_batch = {k: jnp.asarray(v)
                                 for k, v in batch.items()
                                 if k != "batch_indexes"}
@@ -148,8 +201,9 @@ class ApexDQN(DQN):
                     device_batch)
                 losses.append(float(loss))
                 self._num_updates += 1
-                if isinstance(self.buffer, PrioritizedReplayBuffer):
-                    self.buffer.update_priorities(
+                if cfg.prioritized_replay:
+                    # fire-and-forget back to the OWNING shard
+                    self.replay_shards[shard_i].update_priorities.remote(
                         batch["batch_indexes"], np.asarray(td))
                 if (self._num_updates - self._last_target_update
                         >= cfg.target_network_update_freq):
@@ -161,7 +215,8 @@ class ApexDQN(DQN):
         metrics.update({
             "loss": float(np.mean(losses)) if losses else float("nan"),
             "num_env_steps_sampled": self._steps_sampled,
-            "buffer_size": len(self.buffer),
+            "buffer_size": int(sum(sizes)),
+            "replay_shard_sizes": [int(s) for s in sizes],
             "actor_epsilons": [
                 self._actor_epsilon(i)
                 for i in range(max(1, cfg.num_rollout_workers))],

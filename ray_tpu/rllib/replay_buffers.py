@@ -121,3 +121,38 @@ class PrioritizedReplayBuffer(ReplayBuffer):
                                  float(priorities.max()))
         self._tree.set(np.asarray(idx),
                        priorities.astype(np.float64) ** self.alpha)
+
+
+class ReplayActor:
+    """One shard of a distributed prioritized replay. Ape-X runs N of
+    these as actors (reference: `apex_dqn/apex_dqn.py:328-337`
+    ReplayActor fleet): rollout workers add round-robin, the learner
+    samples shards round-robin and feeds priorities back to the owning
+    shard — ingest and sampling scale with shards instead of funneling
+    through the learner process."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0,
+                 prioritized: bool = True):
+        if prioritized:
+            self._buf = PrioritizedReplayBuffer(capacity, alpha, beta,
+                                                seed=seed)
+        else:
+            self._buf = ReplayBuffer(capacity, seed=seed)
+
+    def add_batch(self, batch) -> int:
+        self._buf.add_batch(batch)
+        return len(self._buf)
+
+    def size(self) -> int:
+        return len(self._buf)
+
+    def sample(self, batch_size: int):
+        if len(self._buf) < batch_size:
+            return None
+        return self._buf.sample(batch_size)
+
+    def update_priorities(self, idx, priorities) -> bool:
+        if isinstance(self._buf, PrioritizedReplayBuffer):
+            self._buf.update_priorities(idx, priorities)
+        return True

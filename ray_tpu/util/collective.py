@@ -16,6 +16,14 @@ TPU-native split (SURVEY.md §5.8):
   rendezvous actor per group. This dogfoods the actor runtime the same way
   the reference's GLOOGroup rides its own store.
 
+SCALE BOUNDARY: every rank's array funnels through the one rendezvous
+actor — O(world_size * bytes) through a single process per op. That is
+the right shape for control-plane payloads (histograms, metrics,
+rendezvous blobs) and the WRONG shape for gradients or activations;
+arrays above COLLECTIVE_MAX_BYTES are refused with a pointer to the
+in-graph mapping below, so nobody ships model state through this path
+by accident.
+
 In-graph mapping (for code inside shard_map/pjit over a Mesh axis ``ax``):
 
     allreduce(t, op=SUM)   ->  jax.lax.psum(t, ax)        # or pmean
@@ -119,6 +127,35 @@ class _RendezvousActor:
 _local = threading.local()
 
 
+
+def _guard_size(arr):
+    """Refuse model-state-sized payloads: the rendezvous actor is a
+    control-plane funnel (O(world * bytes) through one process). Big
+    tensors belong in-graph — see the mapping table in the module
+    docstring — or in the object store directly."""
+    from ray_tpu._private import config as _config
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes is None:
+        # non-buffer payloads (lists, dicts of arrays): len() counts
+        # ELEMENTS, not bytes — measure the actual wire size instead
+        # (control-plane payloads are small; one extra pickle is cheap)
+        try:
+            import cloudpickle
+            nbytes = len(cloudpickle.dumps(arr))
+        except Exception:
+            return arr      # unpicklable: the send itself will say so
+    cap = _config.get("COLLECTIVE_MAX_BYTES")
+    if nbytes > cap:
+        raise RayTpuError(
+            f"host-side collective payload is {nbytes} bytes "
+            f"(> COLLECTIVE_MAX_BYTES={cap}): this path funnels every "
+            "rank through one rendezvous actor and is for control-plane "
+            "data only. Move device tensors in-graph (jax.lax.psum/"
+            "all_gather over a Mesh axis; ray_tpu.parallel) or ship "
+            "them via the object store.")
+    return arr
+
+
 class CollectiveGroup:
     """Client handle bound to (group_name, rank)."""
 
@@ -140,23 +177,28 @@ class CollectiveGroup:
                 self._actor = ray_tpu.get_actor(actor_name)
 
     def allreduce(self, arr, op: str = "sum"):
-        return ray_tpu.get(self._actor.allreduce.remote(self.rank, arr, op))
+        return ray_tpu.get(self._actor.allreduce.remote(
+            self.rank, _guard_size(arr), op))
 
     def allgather(self, arr):
-        return ray_tpu.get(self._actor.allgather.remote(self.rank, arr))
+        return ray_tpu.get(self._actor.allgather.remote(
+            self.rank, _guard_size(arr)))
 
     def reducescatter(self, arr, op: str = "sum"):
         return ray_tpu.get(
-            self._actor.reducescatter.remote(self.rank, arr, op))
+            self._actor.reducescatter.remote(
+                self.rank, _guard_size(arr), op))
 
     def broadcast(self, arr, src: int = 0):
-        return ray_tpu.get(self._actor.broadcast.remote(self.rank, arr, src))
+        return ray_tpu.get(self._actor.broadcast.remote(
+            self.rank, _guard_size(arr), src))
 
     def barrier(self):
         return ray_tpu.get(self._actor.barrier_op.remote(self.rank))
 
     def send(self, arr, dst: int, tag: int = 0):
-        return ray_tpu.get(self._actor.put_p2p.remote(dst, tag, arr))
+        return ray_tpu.get(self._actor.put_p2p.remote(
+            dst, tag, _guard_size(arr)))
 
     def recv(self, src: int, tag: int = 0, timeout: float = 60.0):
         return ray_tpu.get(

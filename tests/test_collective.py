@@ -44,3 +44,19 @@ def test_collective_send_recv(ray_session):
     r = ray_tpu.remote(receiver).remote()
     assert ray_tpu.get(r, timeout=120) == 7.0
     assert ray_tpu.get(s, timeout=120)
+
+
+def test_collective_refuses_big_tensors(ray_session):
+    """The host-side group is a control-plane funnel (one rendezvous
+    actor); model-state-sized payloads must be refused with a pointer at
+    the in-graph path, not silently bottlenecked."""
+    import numpy as np
+    import pytest
+
+    from ray_tpu.exceptions import RayTpuError
+    from ray_tpu.util.collective import CollectiveGroup
+
+    g = CollectiveGroup("cap_test", world_size=1, rank=0)
+    assert g.allreduce(np.ones(8)).sum() == 8.0          # small: fine
+    with pytest.raises(RayTpuError, match="in-graph"):
+        g.allreduce(np.zeros(80 << 20, np.uint8))        # 80MB: refused

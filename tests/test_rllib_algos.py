@@ -331,8 +331,9 @@ def test_linucb_and_lints_low_regret():
 
 
 def test_apex_dqn_cartpole_learns(ray_session):
-    """Ape-X: actor fan-out with per-actor epsilons feeding one central
-    prioritized replay (reference: rllib/algorithms/apex_dqn/)."""
+    """Ape-X: actor fan-out with per-actor epsilons feeding SHARDED
+    replay actors (reference: rllib/algorithms/apex_dqn/ ReplayActor
+    fleet)."""
     from ray_tpu.rllib.algorithms.apex_dqn import ApexDQNConfig
 
     algo = (ApexDQNConfig().environment("CartPole-v1")
@@ -357,5 +358,9 @@ def test_apex_dqn_cartpole_learns(ray_session):
                 break
         assert best > 80, best
         assert r["buffer_size"] > 0
+        # replay is genuinely sharded and roughly balanced (round-robin)
+        sizes = r["replay_shard_sizes"]
+        assert len(sizes) == 2 and all(s > 0 for s in sizes), sizes
+        assert max(sizes) < 4 * max(min(sizes), 1), sizes
     finally:
         algo.cleanup()
