@@ -18,6 +18,7 @@ from ray_tpu.rllib.algorithms.bandits import (
     LinTS, LinTSConfig, LinUCB, LinUCBConfig)
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -28,4 +29,4 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "MultiAgentPPO", "MAPPOConfig", "ES", "ESConfig",
            "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
            "ApexDQN", "ApexDQNConfig", "R2D2", "R2D2Config",
-           "QMIX", "QMIXConfig"]
+           "QMIX", "QMIXConfig", "DT", "DTConfig"]

@@ -1,0 +1,328 @@
+"""Decision Transformer — offline RL as return-conditioned sequence
+modeling (Chen et al. 2021).
+
+Counterpart of the reference's `rllib/algorithms/dt/` (dt.py +
+`segmentation_buffer.py` + `dt_torch_model.py`): episodes become
+(return-to-go, state, action) token triples, a small causal transformer
+is trained to predict the action at each state token, and acting means
+conditioning the context on a TARGET return — ask for expert return,
+get expert behavior, even when the dataset mixes qualities.
+
+TPU-first shape: window sampling pads to a fixed K so every batch is
+one static-shape [B, 3K, D] causal-attention program (the reference's
+segmentation buffer does the same padding for its torch GPT); training
+is a single jitted update and evaluation's per-step forward is jitted
+once. The transformer is plain flax (LN -> causal MHA -> MLP blocks) —
+small enough to live here, shaped like models/gpt's blocks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.env.spaces import Discrete
+
+
+class _Block(nn.Module):
+    embed: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.embed)(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(4 * self.embed)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.embed)(h)
+        return x + h
+
+
+class _DTNet(nn.Module):
+    """(rtg, obs, action) triples -> per-state-token action logits."""
+    obs_dim: int
+    num_actions: int
+    embed: int
+    heads: int
+    layers: int
+    max_len: int          # K (timesteps per window)
+
+    @nn.compact
+    def __call__(self, rtg, obs, act, timesteps):
+        # rtg [B,K,1], obs [B,K,obs_dim], act [B,K] — the TRUE action of
+        # each step (-1 where unknown/padded, e.g. the current step at
+        # act time): the causal mask already hides a_t from its own
+        # prediction at the s_t token, while a_{t-1} in slot t-1 stays
+        # visible — the canonical (R, s, a) DT ordering. timesteps [B,K].
+        B, K = rtg.shape[0], rtg.shape[1]
+        t_emb = nn.Embed(self.max_len + 1, self.embed)(
+            jnp.clip(timesteps, 0, self.max_len))
+        e_rtg = nn.Dense(self.embed)(rtg) + t_emb
+        e_obs = nn.Dense(self.embed)(obs) + t_emb
+        a_onehot = jax.nn.one_hot(jnp.clip(act, 0, None),
+                                  self.num_actions) * \
+            (act >= 0).astype(jnp.float32)[..., None]
+        e_act = nn.Dense(self.embed)(a_onehot) + t_emb
+        # interleave (rtg_t, s_t, a_t): [B, 3K, D]
+        toks = jnp.stack([e_rtg, e_obs, e_act], axis=2).reshape(
+            B, 3 * K, self.embed)
+        causal = nn.make_causal_mask(jnp.ones((B, 3 * K)))
+        x = toks
+        for _ in range(self.layers):
+            x = _Block(self.embed, self.heads)(x, causal)
+        x = nn.LayerNorm()(x)
+        # action predicted at each STATE token position (index 3t+1)
+        state_tok = x.reshape(B, K, 3, self.embed)[:, :, 1, :]
+        return nn.Dense(self.num_actions)(state_tok)     # [B, K, A]
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DT)
+        self.lr = 1e-3
+        self.train_batch_size = 64
+        self.context_len = 20              # K
+        self.embed_dim = 64
+        self.n_layers = 2
+        self.n_heads = 2
+        self.n_updates_per_iter = 50
+        self.target_return = None          # None -> best in dataset
+        self.eval_episodes = 4
+        self.offline_max_batches = 1000    # cap on cycling readers
+        # offline data: list of SampleBatch-like dicts, a callable
+        # yielding them, or an object with .next() (JsonReader)
+        self.input_ = None
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class DT(Algorithm):
+    _config_class = DTConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("DT v1 supports Discrete action spaces")
+        if cfg.input_ is None:
+            raise ValueError(
+                "DT is an OFFLINE algorithm: pass experience via "
+                "config.offline_data(input_=...) (reference: dt.py "
+                "requires offline input)")
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.num_actions = self.env.action_space.n
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._episodes = self._segment_episodes(self._drain_input())
+        if not self._episodes:
+            raise ValueError("offline input contained no complete episodes")
+        self._ep_returns = np.asarray(
+            [float(ep["rtg"][0]) for ep in self._episodes])
+        self.net = _DTNet(self.obs_dim, self.num_actions, cfg.embed_dim,
+                          cfg.n_heads, cfg.n_layers,
+                          max(len(ep["obs"]) for ep in self._episodes))
+        K = cfg.context_len
+        self.params = self.net.init(
+            self.next_key(), jnp.zeros((1, K, 1)),
+            jnp.zeros((1, K, self.obs_dim)),
+            jnp.zeros((1, K), jnp.int32), jnp.zeros((1, K), jnp.int32))
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = jax.jit(self._update)
+        self._act_fn = jax.jit(self.net.apply)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._iter = 0
+
+    # -- offline ingestion -------------------------------------------------
+
+    def _drain_input(self):
+        src = self.algo_config.input_
+        if callable(src):
+            batches = []
+            out = src()
+            batches = list(out) if isinstance(out, (list, tuple)) else [out]
+        elif hasattr(src, "next"):
+            # BOUNDED drain: this repo's JsonReader.next() cycles over
+            # its shards forever (offline.py) and never raises — cap at
+            # offline_max_batches so setup() can't spin/OOM
+            cap = int(getattr(self.algo_config, "offline_max_batches",
+                              1000))
+            batches = []
+            try:
+                for _ in range(cap):
+                    batches.append(src.next())
+            except StopIteration:
+                pass
+        else:
+            batches = list(src)
+        return batches
+
+    def _segment_episodes(self, batches):
+        """Concatenate batches, split on dones, attach returns-to-go
+        (reference: dt segmentation_buffer.py)."""
+        obs = np.concatenate([np.asarray(b[sb.OBS]) for b in batches])
+        act = np.concatenate([np.asarray(b[sb.ACTIONS]) for b in batches])
+        rew = np.concatenate([np.asarray(b[sb.REWARDS]) for b in batches])
+        done = np.concatenate(
+            [np.asarray(b[sb.DONES]) for b in batches]).astype(bool)
+        episodes, start = [], 0
+        for i in range(len(done)):
+            if done[i]:
+                r = rew[start:i + 1].astype(np.float64)
+                rtg = np.cumsum(r[::-1])[::-1]
+                episodes.append({
+                    "obs": obs[start:i + 1].reshape(i + 1 - start, -1)
+                    .astype(np.float32),
+                    "act": act[start:i + 1].astype(np.int32),
+                    "rtg": rtg.astype(np.float32),
+                })
+                start = i + 1
+        return episodes
+
+    def _sample_windows(self, batch_size):
+        cfg = self.algo_config
+        K = cfg.context_len
+        # episodes weighted by length (every timestep equally likely)
+        lens = np.asarray([len(e["act"]) for e in self._episodes])
+        p = lens / lens.sum()
+        rtg = np.zeros((batch_size, K, 1), np.float32)
+        obs = np.zeros((batch_size, K, self.obs_dim), np.float32)
+        act = np.full((batch_size, K), -1, np.int32)   # true actions
+        tgt = np.zeros((batch_size, K), np.int32)
+        ts = np.zeros((batch_size, K), np.int32)
+        mask = np.zeros((batch_size, K), np.float32)
+        for b in range(batch_size):
+            ep = self._episodes[self._np_rng.choice(len(self._episodes),
+                                                    p=p)]
+            T = len(ep["act"])
+            end = int(self._np_rng.integers(1, T + 1))   # window end (excl)
+            lo = max(0, end - K)
+            n = end - lo
+            sl = slice(K - n, K)                          # right-align
+            rtg[b, sl, 0] = ep["rtg"][lo:end]
+            obs[b, sl] = ep["obs"][lo:end]
+            tgt[b, sl] = ep["act"][lo:end]
+            act[b, sl] = ep["act"][lo:end]
+            ts[b, sl] = np.arange(lo, end)
+            mask[b, sl] = 1.0
+        return rtg, obs, act, tgt, ts, mask
+
+    # -- training ----------------------------------------------------------
+
+    def _update(self, params, opt_state, rtg, obs, act, tgt, ts, mask):
+        def loss_fn(p):
+            logits = self.net.apply(p, rtg, obs, act, ts)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1)[..., 0]
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        losses = []
+        for _ in range(cfg.n_updates_per_iter):
+            w = self._sample_windows(cfg.train_batch_size)
+            self.params, self.opt_state, loss = self._update_fn(
+                self.params, self.opt_state,
+                *(jnp.asarray(x) for x in w))
+            losses.append(float(loss))
+        self._iter += 1
+        metrics = {
+            "loss": float(np.mean(losses)),
+            "num_episodes_offline": len(self._episodes),
+            "dataset_return_mean": float(self._ep_returns.mean()),
+            "dataset_return_max": float(self._ep_returns.max()),
+        }
+        if cfg.eval_episodes:
+            rews = [self._eval_episode() for _ in range(cfg.eval_episodes)]
+            metrics["episode_reward_mean"] = float(np.mean(rews))
+        return metrics
+
+    # -- return-conditioned acting -----------------------------------------
+
+    def _eval_episode(self) -> float:
+        """Roll one episode conditioning on the target return
+        (reference: dt.py evaluation with rtg decay)."""
+        cfg = self.algo_config
+        K = cfg.context_len
+        target = (cfg.target_return if cfg.target_return is not None
+                  else float(self._ep_returns.max()))
+        from ray_tpu.rllib.env.jax_env import is_jax_env
+        env = self.env
+        key = self.next_key()
+        if is_jax_env(env):
+            state, obs0 = env.reset(key)
+        else:
+            out = env.reset()
+            obs0 = out[0] if isinstance(out, tuple) else out
+        obs_hist = [np.asarray(obs0, np.float32).reshape(-1)]
+        act_hist: list[int] = []
+        rtg_hist = [target]
+        total, t, done = 0.0, 0, False
+        while not done and t < 1000:
+            lo = max(0, len(obs_hist) - K)
+            window = obs_hist[lo:]
+            n = len(window)
+            rtg = np.zeros((1, K, 1), np.float32)
+            obs = np.zeros((1, K, self.obs_dim), np.float32)
+            act = np.full((1, K), -1, np.int32)
+            ts = np.zeros((1, K), np.int32)
+            sl = slice(K - n, K)
+            rtg[0, sl, 0] = rtg_hist[lo:]
+            obs[0, sl] = np.stack(window)
+            # TRUE actions for the window's past steps; the current
+            # step's slot stays -1 (unknown — and causally invisible to
+            # its own prediction anyway)
+            known = act_hist[lo:]
+            if known:
+                act[0, K - n:K - n + len(known)] = known
+            ts[0, sl] = np.arange(lo, lo + n)
+            logits = self._act_fn(self.params, jnp.asarray(rtg),
+                                  jnp.asarray(obs), jnp.asarray(act),
+                                  jnp.asarray(ts))
+            a = int(np.asarray(jnp.argmax(logits[0, K - 1])))
+            if is_jax_env(env):
+                key, k = jax.random.split(key)
+                state, nxt, r, d, _ = env.step(state, jnp.asarray(a), k)
+                nxt = np.asarray(nxt)
+                r, done = float(r), bool(d)
+            else:
+                out = env.step(a)
+                if len(out) == 5:
+                    nxt, r, term, trunc, _ = out
+                    done = bool(term or trunc)
+                else:
+                    nxt, r, done, _ = out
+            total += r
+            t += 1
+            act_hist.append(a)
+            obs_hist.append(np.asarray(nxt, np.float32).reshape(-1))
+            rtg_hist.append(rtg_hist[-1] - r)
+        return total
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("DT", DT)

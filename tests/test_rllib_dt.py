@@ -1,0 +1,72 @@
+"""Decision Transformer — offline return-conditioned control
+(reference: rllib/algorithms/dt/).
+
+The decisive property: trained on a MIXED-quality dataset, conditioning
+on the expert return must recover near-expert behavior — i.e. DT beats
+the dataset average, which plain behavior cloning of the same data
+cannot (BC regresses to the mixture)."""
+
+import numpy as np
+
+JAX_ENV_CFG = {"max_steps": 200}
+
+
+def _collect_episodes(policy, n_eps, seed):
+    """Roll CartPole eagerly with a python policy; returns SampleBatch
+    columns."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.env.jax_env import make_env
+    env = make_env("CartPole-v1", JAX_ENV_CFG)
+    key = jax.random.PRNGKey(seed)
+    cols = {"obs": [], "actions": [], "rewards": [], "dones": []}
+    for _ in range(n_eps):
+        key, k = jax.random.split(key)
+        state, obs = env.reset(k)
+        done = False
+        while not done:
+            a = policy(np.asarray(obs))
+            key, k = jax.random.split(key)
+            state, nxt, r, d, _ = env.step(state, jnp.asarray(a), k)
+            cols["obs"].append(np.asarray(obs, np.float32))
+            cols["actions"].append(np.int32(a))
+            cols["rewards"].append(np.float32(r))
+            cols["dones"].append(bool(d))
+            obs, done = nxt, bool(d)
+    return {k: np.asarray(v) for k, v in cols.items()}
+
+
+def _expert(obs):
+    # classic angle + angular-velocity controller: ~max return
+    return 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+
+
+def test_dt_return_conditioning_beats_dataset():
+    rng = np.random.default_rng(0)
+    expert = _collect_episodes(_expert, 12, seed=1)
+    random_ = _collect_episodes(
+        lambda o: int(rng.integers(0, 2)), 12, seed=2)
+
+    from ray_tpu.rllib.algorithms.dt import DTConfig
+    cfg = DTConfig().environment("CartPole-v1", env_config=JAX_ENV_CFG)
+    cfg.offline_data(input_=[expert, random_])
+    cfg.train_batch_size = 64
+    cfg.context_len = 20
+    cfg.n_updates_per_iter = 60
+    cfg.eval_episodes = 3
+    cfg.seed = 0
+    algo = cfg.build()
+    best = -np.inf
+    res = {}
+    for _ in range(10):
+        res = algo.train()
+        best = max(best, res["episode_reward_mean"])
+        if best >= 150:
+            break
+    ds_mean = res["dataset_return_mean"]
+    assert res["dataset_return_max"] > 150       # expert data present
+    assert ds_mean < 130                          # genuinely mixed
+    # conditioning on the expert return recovers near-expert control
+    assert best >= 150, (best, ds_mean)
+    assert best >= ds_mean + 20, (best, ds_mean)
