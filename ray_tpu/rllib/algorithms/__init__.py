@@ -19,6 +19,7 @@ from ray_tpu.rllib.algorithms.bandits import (
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
+from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -29,4 +30,5 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "MultiAgentPPO", "MAPPOConfig", "ES", "ESConfig",
            "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
            "ApexDQN", "ApexDQNConfig", "R2D2", "R2D2Config",
-           "QMIX", "QMIXConfig", "DT", "DTConfig"]
+           "QMIX", "QMIXConfig", "DT", "DTConfig",
+           "AlphaZero", "AlphaZeroConfig"]

@@ -1,0 +1,367 @@
+"""AlphaZero — self-play MCTS + policy/value network (Silver et al.).
+
+Counterpart of the reference's `rllib/algorithms/alpha_zero/`
+(alpha_zero.py + `mcts.py` + `alpha_zero_policy.py`): PUCT tree search
+over a perfect-information game, self-play targets (visit-count policy,
+final outcome value), and a joint policy+value network trained on the
+replayed games. Like the reference, the SEARCH runs host-side (its
+mcts.py is a python tree too); the TPU-first part is batching — every
+network evaluation during search and self-play batches across all
+parallel games/leaves into one jitted call, and the train step is one
+jitted program. (A fully in-graph mctx-style fixed-array search is the
+natural next step on this substrate; the host tree keeps v1 honest.)
+
+Ships with TicTacToe as the canonical two-player JaxEnv-style game
+(board from the CURRENT player's perspective: +1 own, -1 opponent), the
+same role CartPole plays for the single-agent algorithms.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+
+
+# ---------------------------------------------------------------------------
+# TicTacToe (canonical perspective: +1 = to-move player's stones)
+# ---------------------------------------------------------------------------
+
+_LINES = np.array(
+    [[0, 1, 2], [3, 4, 5], [6, 7, 8],
+     [0, 3, 6], [1, 4, 7], [2, 5, 8],
+     [0, 4, 8], [2, 4, 6]])
+
+
+class TicTacToe:
+    """Perfect-information 2-player game API used by the search:
+    initial() -> board; legal(board) -> mask; step(board, a) ->
+    (next_board_from_OPPONENT_view, reward_for_mover, done)."""
+
+    num_actions = 9
+    obs_shape = (9,)
+
+    def initial(self) -> np.ndarray:
+        return np.zeros(9, np.float32)
+
+    @staticmethod
+    def legal(board: np.ndarray) -> np.ndarray:
+        return (board == 0).astype(np.float32)
+
+    @staticmethod
+    def step(board: np.ndarray, action: int):
+        b = board.copy()
+        b[action] = 1.0
+        won = bool((b[_LINES] == 1).all(axis=1).any())
+        full = bool((b != 0).all())
+        if won:
+            return -b, 1.0, True          # mover wins
+        if full:
+            return -b, 0.0, True          # draw
+        return -b, 0.0, False             # flip perspective for opponent
+
+
+# ---------------------------------------------------------------------------
+# network
+# ---------------------------------------------------------------------------
+
+class _AZNet(nn.Module):
+    num_actions: int
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        h = x
+        for _ in range(2):
+            h = nn.relu(nn.Dense(self.hidden)(h))
+        logits = nn.Dense(self.num_actions)(h)
+        value = jnp.tanh(nn.Dense(1)(nn.relu(nn.Dense(self.hidden)(h))))
+        return logits, value[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# PUCT search (host tree, batched network evals)
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("prior", "visits", "value_sum", "children", "board",
+                 "terminal", "reward")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.visits = 0
+        self.value_sum = 0.0
+        self.children: dict[int, "_Node"] = {}
+        self.board = None
+        self.terminal = False
+        self.reward = 0.0
+
+    @property
+    def q(self) -> float:
+        return self.value_sum / self.visits if self.visits else 0.0
+
+
+class MCTS:
+    """PUCT search (reference: alpha_zero/mcts.py). `evaluate` is a
+    BATCHED callable boards[B,obs] -> (priors[B,A], values[B]) so many
+    concurrent searches share one device call per wave."""
+
+    def __init__(self, game, evaluate, num_sims: int = 64,
+                 c_puct: float = 1.5, dirichlet_alpha: float = 0.6,
+                 noise_frac: float = 0.25, rng=None):
+        self.game = game
+        self.evaluate = evaluate
+        self.num_sims = num_sims
+        self.c_puct = c_puct
+        self.dirichlet_alpha = dirichlet_alpha
+        self.noise_frac = noise_frac
+        self.rng = rng or np.random.default_rng(0)
+
+    def _select_child(self, node: _Node):
+        total = max(1, node.visits)
+        best, best_score = None, -np.inf
+        for a, child in node.children.items():
+            # child.q is from the OPPONENT's view after the move: negate
+            u = (-child.q + self.c_puct * child.prior
+                 * np.sqrt(total) / (1 + child.visits))
+            if u > best_score:
+                best, best_score = a, u
+        return best, node.children[best]
+
+    def _expand(self, node: _Node, priors: np.ndarray):
+        mask = self.game.legal(node.board)
+        p = priors * mask
+        p = p / p.sum() if p.sum() > 0 else mask / mask.sum()
+        for a in np.nonzero(mask)[0]:
+            child = _Node(float(p[a]))
+            nxt, reward, done = self.game.step(node.board, int(a))
+            child.board = nxt
+            child.terminal = done
+            child.reward = reward
+            node.children[int(a)] = child
+
+    def run_batch(self, boards: list[np.ndarray], add_noise: bool = True):
+        """Search every board; -> (visit_policies [B,A], root_values [B]).
+        All network evaluations across the batch happen in ONE device
+        call per simulation wave."""
+        roots = []
+        out = self.evaluate(np.stack(boards))
+        priors0, _vals0 = np.asarray(out[0]), np.asarray(out[1])
+        for b, board in enumerate(boards):
+            root = _Node(1.0)
+            root.board = board
+            pri = priors0[b]
+            if add_noise:
+                mask = self.game.legal(board)
+                noise = np.zeros_like(pri)
+                idx = np.nonzero(mask)[0]
+                noise[idx] = self.rng.dirichlet(
+                    [self.dirichlet_alpha] * len(idx))
+                pri = (1 - self.noise_frac) * pri + self.noise_frac * noise
+            self._expand(root, pri)
+            roots.append(root)
+
+        for _ in range(self.num_sims):
+            paths, leaves, eval_idx = [], [], []
+            for root in roots:
+                node, path = root, []
+                while node.children:
+                    a, node = self._select_child(node)
+                    path.append(node)
+                paths.append(path)
+                leaves.append(node)
+                if not node.terminal:
+                    eval_idx.append(len(leaves) - 1)
+            if eval_idx:
+                boards_b = np.stack([leaves[i].board for i in eval_idx])
+                pri_b, val_b = self.evaluate(boards_b)
+                pri_b, val_b = np.asarray(pri_b), np.asarray(val_b)
+            k = 0
+            for i, (leaf, path) in enumerate(zip(leaves, paths)):
+                if leaf.terminal:
+                    # terminal value is the REWARD to the player who
+                    # moved INTO the leaf, from the leaf mover's view
+                    value = -leaf.reward
+                else:
+                    self._expand(leaf, pri_b[k])
+                    value = float(val_b[k])
+                    k += 1
+                # backup: value alternates sign up the path
+                node_value = value
+                for node in reversed(path):
+                    node.visits += 1
+                    node.value_sum += node_value
+                    node_value = -node_value
+                roots[i].visits += 1
+                roots[i].value_sum += node_value
+        pis, vals = [], []
+        for root in roots:
+            pi = np.zeros(self.game.num_actions, np.float32)
+            for a, child in root.children.items():
+                pi[a] = child.visits
+            pi = pi / pi.sum() if pi.sum() else pi
+            pis.append(pi)
+            vals.append(root.q)
+        return np.stack(pis), np.asarray(vals)
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+class AlphaZeroConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or AlphaZero)
+        self.lr = 3e-3
+        self.num_sims = 48
+        self.c_puct = 1.5
+        self.games_per_iter = 24          # self-play games per iteration
+        self.parallel_games = 24          # searched as one eval batch
+        self.train_batch_size = 128
+        self.n_updates_per_iter = 20
+        self.buffer_size = 20_000
+        self.temperature_moves = 4        # sample pi before, argmax after
+        self.hidden = 128
+        self.game = TicTacToe             # class or instance
+
+
+class AlphaZero(Algorithm):
+    _config_class = AlphaZeroConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.game = cfg.game() if isinstance(cfg.game, type) else cfg.game
+        self.net = _AZNet(self.game.num_actions, cfg.hidden)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.params = self.net.init(
+            self.next_key(), jnp.zeros((1, *self.game.obs_shape)))
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._apply = jax.jit(self.net.apply)
+        self._update_fn = jax.jit(self._update)
+        # replay of (board, pi, z)
+        self._obs: list = []
+        self._pi: list = []
+        self._z: list = []
+        self._games_played = 0
+
+    # -- network seam for the search --------------------------------------
+
+    def _evaluate(self, boards: np.ndarray):
+        logits, values = self._apply(self.params, jnp.asarray(boards))
+        return np.asarray(jax.nn.softmax(logits)), np.asarray(values)
+
+    # -- self-play ---------------------------------------------------------
+
+    def _self_play(self, n_games: int):
+        cfg = self.algo_config
+        mcts = MCTS(self.game, self._evaluate, cfg.num_sims, cfg.c_puct,
+                    rng=self._np_rng)
+        boards = [self.game.initial() for _ in range(n_games)]
+        # per-game trajectories: (board, pi, mover_sign)
+        traj: list[list] = [[] for _ in range(n_games)]
+        outcome = [None] * n_games      # +1 mover-at-end won, 0 draw
+        move_no = 0
+        live = list(range(n_games))
+        while live:
+            live_boards = [boards[g] for g in live]
+            pis, _ = mcts.run_batch(live_boards)
+            next_live = []
+            for j, g in enumerate(live):
+                pi = pis[j]
+                if move_no < cfg.temperature_moves:
+                    a = int(self._np_rng.choice(len(pi), p=pi))
+                else:
+                    a = int(np.argmax(pi))
+                traj[g].append((boards[g].copy(), pi))
+                nxt, reward, done = self.game.step(boards[g], a)
+                boards[g] = nxt
+                if done:
+                    outcome[g] = reward     # reward to the LAST mover
+                else:
+                    next_live.append(g)
+            live = next_live
+            move_no += 1
+        # value targets: z from each position's MOVER perspective —
+        # the last mover got `outcome`; alternate backwards
+        for g in range(n_games):
+            z = outcome[g]
+            for board, pi in reversed(traj[g]):
+                self._obs.append(board)
+                self._pi.append(pi)
+                self._z.append(z)
+                z = -z
+        cap = self.algo_config.buffer_size
+        self._obs = self._obs[-cap:]
+        self._pi = self._pi[-cap:]
+        self._z = self._z[-cap:]
+        self._games_played += n_games
+        return [o for o in outcome]
+
+    # -- training ----------------------------------------------------------
+
+    def _update(self, params, opt_state, obs, pi, z):
+        def loss_fn(p):
+            logits, value = self.net.apply(p, obs)
+            policy_loss = -jnp.mean(
+                jnp.sum(pi * jax.nn.log_softmax(logits), axis=-1))
+            value_loss = jnp.mean((value - z) ** 2)
+            return policy_loss + value_loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        outcomes = self._self_play(cfg.games_per_iter)
+        losses = []
+        n = len(self._obs)
+        for _ in range(cfg.n_updates_per_iter):
+            idx = self._np_rng.integers(0, n, min(cfg.train_batch_size, n))
+            obs = jnp.asarray(np.stack([self._obs[i] for i in idx]))
+            pi = jnp.asarray(np.stack([self._pi[i] for i in idx]))
+            z = jnp.asarray(np.asarray([self._z[i] for i in idx],
+                                       np.float32))
+            self.params, self.opt_state, loss = self._update_fn(
+                self.params, self.opt_state, obs, pi, z)
+            losses.append(float(loss))
+        wins = sum(1 for o in outcomes if o > 0)
+        draws = sum(1 for o in outcomes if o == 0)
+        return {
+            "loss": float(np.mean(losses)),
+            "games_played": self._games_played,
+            "selfplay_decisive_frac": wins / max(1, len(outcomes)),
+            "selfplay_draw_frac": draws / max(1, len(outcomes)),
+            "replay_positions": len(self._obs),
+            "episode_reward_mean": float("nan"),   # 2-player: n/a
+        }
+
+    # -- acting ------------------------------------------------------------
+
+    def compute_single_action(self, board, num_sims: int | None = None):
+        """Best move for `board` (current-player perspective) by search."""
+        cfg = self.algo_config
+        mcts = MCTS(self.game, self._evaluate,
+                    num_sims or cfg.num_sims, cfg.c_puct,
+                    rng=self._np_rng)
+        pi, _ = mcts.run_batch([np.asarray(board, np.float32)],
+                               add_noise=False)
+        return int(np.argmax(pi[0]))
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("AlphaZero", AlphaZero)
