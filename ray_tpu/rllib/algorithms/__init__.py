@@ -20,6 +20,7 @@ from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -31,4 +32,5 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
            "ApexDQN", "ApexDQNConfig", "R2D2", "R2D2Config",
            "QMIX", "QMIXConfig", "DT", "DTConfig",
-           "AlphaZero", "AlphaZeroConfig"]
+           "AlphaZero", "AlphaZeroConfig",
+           "DreamerV3", "DreamerV3Config"]

@@ -1,0 +1,41 @@
+"""DreamerV3 — world-model RL (reference: rllib/algorithms/dreamerv3/).
+
+Two claims, tested separately: the WORLD MODEL learns (reconstruction
+loss collapses — the RSSM actually models CartPole dynamics), and the
+IMAGINATION-trained policy improves the real-environment return well
+beyond the random baseline. Time-bounded thresholds: from ~22 (random)
+the measured curve passes 60 around iteration 30-40 on this box."""
+
+import numpy as np
+
+
+def test_dreamerv3_world_model_and_policy_learn():
+    from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Config
+
+    cfg = DreamerV3Config().environment(
+        "CartPole-v1", env_config={"max_steps": 200})
+    cfg.seed = 0
+    cfg.num_envs_per_worker = 8
+    cfg.n_updates_per_iter = 10
+    cfg.learning_starts = 16
+    cfg.entropy_coeff = 1e-2
+    algo = cfg.build()
+
+    first_recon, best = None, 0.0
+    for i in range(40):
+        r = algo.train()
+        if first_recon is None and np.isfinite(r["recon_loss"]):
+            first_recon = r["recon_loss"]
+        best = max(best, r["episode_reward_mean"])
+        if best >= 60:
+            break
+    # the RSSM models the dynamics...
+    assert np.isfinite(r["world_model_loss"])
+    assert r["recon_loss"] < first_recon * 0.5, (
+        first_recon, r["recon_loss"])
+    # ...and acting from imagination beats the random baseline (~22) by
+    # a wide margin
+    assert best >= 60, best
+    # checkpoint roundtrip
+    st = algo.get_state()
+    algo.set_state(st)
