@@ -18,6 +18,8 @@ from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError
 from ray_tpu.train.torch_trainer import TorchTrainer
 from ray_tpu.train.sklearn_trainer import SklearnTrainer
+from ray_tpu.train.gbdt import GBDTTrainer, LightGBMTrainer, XGBoostTrainer
+from ray_tpu.train.tf_trainer import TensorflowTrainer
 
 # Session facade re-exports (reference: ray.air.session / ray.train.*)
 report = session.report
@@ -28,7 +30,8 @@ get_world_rank = session.get_world_rank
 get_mesh_spec = session.get_mesh_spec
 
 __all__ = [
-    "JaxTrainer", "TorchTrainer", "SklearnTrainer", "Result",
+    "JaxTrainer", "TorchTrainer", "SklearnTrainer", "GBDTTrainer",
+    "XGBoostTrainer", "LightGBMTrainer", "TensorflowTrainer", "Result",
     "TrainingFailedError", "Checkpoint",
     "Predictor", "JaxPredictor", "BatchPredictor",
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",

@@ -1,0 +1,40 @@
+"""TensorflowTrainer — distributed TF over the worker-group spine.
+
+Counterpart of the reference's `train/tensorflow/tensorflow_trainer.py`
++ `train/tensorflow/config.py` (TF_CONFIG rendezvous): the worker
+group, session API, checkpointing, and FailureConfig restarts are
+IDENTICAL to JaxTrainer — the only difference is the rendezvous, which
+renders TF_CONFIG (cluster spec + task index) into each worker's env
+before the training loop runs, so a
+`tf.distribute.MultiWorkerMirroredStrategy()` built inside the loop
+discovers its peers (tested for real: the MWMS gradient-sync regression
+in tests/test_train.py). Construction raises a clear ImportError when
+tensorflow is absent, same gating as the GBDT library adapters.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.trainer import JaxTrainer
+
+
+class TensorflowTrainer(JaxTrainer):
+    _rendezvous_method = "setup_tf_config"
+    _always_rendezvous = True     # TF_CONFIG is needed even at world=1
+
+    def __init__(self, *args, **kwargs):
+        import importlib
+        try:
+            importlib.import_module("tensorflow")
+        except ImportError as e:
+            raise ImportError(
+                "TensorflowTrainer requires the 'tensorflow' package, "
+                "which is not installed in this image; on TPU use "
+                "JaxTrainer (the native path) instead") from e
+        super().__init__(*args, **kwargs)
+
+
+def prepare_dataset_shard(dataset):
+    """Reference-parity passthrough (`train/tensorflow/train_loop_utils
+    .py` prepare_dataset_shard): with TF_CONFIG sharding, the dataset
+    shard needs no further transformation here."""
+    return dataset

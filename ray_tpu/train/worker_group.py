@@ -69,6 +69,38 @@ class TrainWorker:
             world_size=num_processes, rank=process_id)
         return dist.get_world_size()
 
+    def setup_tf_config(self, coordinator: str, num_processes: int,
+                        process_id: int):
+        """Render TF_CONFIG for MultiWorkerMirroredStrategy (the
+        reference's `train/tensorflow/config.py:21` _setup_tensorflow_
+        environment): the coordinator's host gets port+1+rank per rank
+        so every worker lists the same cluster spec. Must run BEFORE
+        any tensorflow import in the training loop.
+
+        v1 scope: SINGLE-HOST worker groups — the spec lists every rank
+        on the coordinator's host, so a rank on another machine could
+        never bind its own entry. Multi-host needs a per-worker address
+        gather (the reference collects each worker's own ip:port);
+        detect and refuse rather than fail inside TF's gRPC server."""
+        import json
+        import os
+        import socket
+        host, port = coordinator.rsplit(":", 1)
+        own = socket.gethostbyname(socket.gethostname())
+        if host not in ("127.0.0.1", "localhost", own):
+            raise NotImplementedError(
+                f"TensorflowTrainer v1 supports single-host worker "
+                f"groups only (rank {process_id} on {own} cannot bind "
+                f"an address on coordinator host {host}); use "
+                "JaxTrainer for multi-host TPU training")
+        workers = [f"{host}:{int(port) + 1 + i}"
+                   for i in range(num_processes)]
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": workers},
+            "task": {"type": "worker", "index": process_id},
+        })
+        return num_processes
+
     def device_info(self):
         import jax
         return {"backend": jax.default_backend(),

@@ -43,6 +43,7 @@ from ray_tpu.tune.trainable import (
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 from ray_tpu.tune.experiment import Trial
 from ray_tpu.tune.tpe import TPESearcher
+from ray_tpu.tune.bayesopt import BayesOptSearcher
 from ray_tpu.tune.loggers import (
     CSVLoggerCallback,
     JsonLoggerCallback,
@@ -57,6 +58,7 @@ __all__ = [
     # searchers
     "Searcher", "BasicVariantGenerator", "ConcurrencyLimiter",
     "TPESearcher",
+    "BayesOptSearcher",
     # loggers
     "CSVLoggerCallback", "JsonLoggerCallback", "TensorBoardLoggerCallback",
     # schedulers

@@ -108,3 +108,34 @@ def test_xgboost_lightgbm_trainers_gated():
             pass
         with pytest.raises(ImportError, match="native GBDTTrainer"):
             cls(label_column="y", datasets={})
+
+
+def test_tf_config_rendezvous_renderer():
+    """The TF_CONFIG renderer (reference: train/tensorflow/config.py:21)
+    builds a consistent single-host cluster spec and refuses multi-host
+    (which would list unbindable addresses). The full MWMS gradient-sync
+    path is covered end-to-end in test_train.py."""
+    import json
+    import os
+
+    import pytest
+
+    from ray_tpu.train.worker_group import TrainWorker
+    w = TrainWorker.__new__(TrainWorker)
+    old = os.environ.pop("TF_CONFIG", None)
+    try:
+        n = w.setup_tf_config("127.0.0.1:29500", 3, 1)
+        assert n == 3
+        tf_config = json.loads(os.environ["TF_CONFIG"])
+        assert tf_config["cluster"]["worker"] == [
+            "127.0.0.1:29501", "127.0.0.1:29502", "127.0.0.1:29503"]
+        assert tf_config["task"] == {"type": "worker", "index": 1}
+        # multi-host coordinator: refused up front (the v1 spec would
+        # list every rank on the coordinator's host)
+        with pytest.raises(NotImplementedError, match="single-host"):
+            w.setup_tf_config("10.9.9.9:29500", 2, 1)
+    finally:
+        if old is not None:
+            os.environ["TF_CONFIG"] = old
+        else:
+            os.environ.pop("TF_CONFIG", None)
