@@ -119,8 +119,11 @@ class CartPole(JaxEnv):
         failed = (jnp.abs(x) > 2.4) | (jnp.abs(theta) > 12 * jnp.pi / 180)
         done = failed | (t >= self.max_steps)
         reward = jnp.asarray(1.0)
-        # auto-reset: where done, swap in a fresh episode
-        reset_state, reset_obs = self.reset(key)
+        # auto-reset: where done, swap in a fresh episode. Explicitly the
+        # PARENT reset: observation-masking subclasses
+        # (StatelessCartPole) override reset() at the boundary, but the
+        # internal state swap needs the full 4-dim observation
+        reset_state, reset_obs = CartPole.reset(self, key)
         new_obs = jnp.where(done, reset_obs, obs)
         new_t = jnp.where(done, reset_state["t"], t)
         return ({"obs": new_obs, "t": new_t}, new_obs, reward, done, {})
@@ -281,7 +284,11 @@ class StatelessCartPole(CartPole):
     """CartPole with the velocity components masked out — position and
     angle only, so the policy must INFER velocities from memory. The
     classic recurrent-policy benchmark (reference:
-    rllib/examples/env/stateless_cartpole.py)."""
+    rllib/examples/env/stateless_cartpole.py).
+
+    Masking happens strictly at the OBSERVATION boundary: the internal
+    state (and the parent's auto-reset, which calls the PARENT reset
+    explicitly) stays 4-dimensional."""
 
     def __init__(self, env_config: dict | None = None):
         super().__init__(env_config)
@@ -292,11 +299,12 @@ class StatelessCartPole(CartPole):
         return jnp.stack([obs[0], obs[2]])   # x, theta (no derivatives)
 
     def reset(self, key):
-        state, obs = super().reset(key)
+        state, obs = CartPole.reset(self, key)
         return state, self._mask(obs)
 
     def step(self, state, action, key):
-        state, obs, r, done, info = super().step(state, action, key)
+        state, obs, r, done, info = CartPole.step(self, state, action,
+                                                  key)
         return state, self._mask(obs), r, done, info
 
 

@@ -22,14 +22,13 @@ class TensorflowTrainer(JaxTrainer):
     _always_rendezvous = True     # TF_CONFIG is needed even at world=1
 
     def __init__(self, *args, **kwargs):
-        import importlib
-        try:
-            importlib.import_module("tensorflow")
-        except ImportError as e:
+        import importlib.util
+        if importlib.util.find_spec("tensorflow") is None:
+            # find_spec, not import: gating must not load hundreds of
+            # MB of TF into the driver (only workers use it)
             raise ImportError(
-                "TensorflowTrainer requires the 'tensorflow' package, "
-                "which is not installed in this image; on TPU use "
-                "JaxTrainer (the native path) instead") from e
+                "TensorflowTrainer requires the 'tensorflow' package; "
+                "on TPU use JaxTrainer (the native path) instead")
         super().__init__(*args, **kwargs)
 
 

@@ -86,13 +86,31 @@ class TrainWorker:
         import os
         import socket
         host, port = coordinator.rsplit(":", 1)
-        own = socket.gethostbyname(socket.gethostname())
-        if host not in ("127.0.0.1", "localhost", own):
+        own = {socket.gethostbyname(socket.gethostname()),
+               os.environ.get("RAY_TPU_NODE_IP"),
+               "127.0.0.1", "localhost"}
+        if host not in own:
             raise NotImplementedError(
                 f"TensorflowTrainer v1 supports single-host worker "
-                f"groups only (rank {process_id} on {own} cannot bind "
-                f"an address on coordinator host {host}); use "
-                "JaxTrainer for multi-host TPU training")
+                f"groups only (rank {process_id} cannot bind an address "
+                f"on coordinator host {host}); use JaxTrainer for "
+                "multi-host TPU training")
+        my_port = int(port) + 1 + process_id
+        # fail with a CLEAR error if our assigned port is taken (the
+        # +1..+N ports are derived, not reserved) instead of dying
+        # inside TF's gRPC server with address-in-use
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            probe.bind((host if host != "localhost" else "127.0.0.1",
+                        my_port))
+        except OSError as e:
+            raise RuntimeError(
+                f"TF_CONFIG port {my_port} for rank {process_id} is "
+                f"already in use ({e}); another service or concurrent "
+                "TF trial holds it — rerun to get a fresh port range")
+        finally:
+            probe.close()
         workers = [f"{host}:{int(port) + 1 + i}"
                    for i in range(num_processes)]
         os.environ["TF_CONFIG"] = json.dumps({

@@ -62,7 +62,6 @@ class BayesOptSearcher(Searcher):
         self.noise = noise
         self.xi = xi
         self._rng = random.Random(seed)
-        self._np_rng = np.random.default_rng(seed)
         self._dims = {}
         for path, dom in _walk(param_space):
             if _is_grid(dom):
@@ -137,7 +136,7 @@ class BayesOptSearcher(Searcher):
 
     def suggest(self, trial_id: str) -> Optional[dict]:
         from ray_tpu.tune.search import _set_path
-        if len(self._y) < self.n_initial:
+        if len(self._y) < max(1, self.n_initial):
             flat = self._random_flat()
         else:
             cands = [self._random_flat()

@@ -136,3 +136,33 @@ def test_dqn_feedforward_fails_memory_task():
     assert best < 8.0, (
         f"feedforward DQN 'solved' the memory task ({best:.2f}) — the "
         "env no longer requires memory")
+
+
+def test_stateless_cartpole_masks_only_observations():
+    """StatelessCartPole exposes (x, theta) only, while the INTERNAL
+    dynamics (and auto-reset) stay 4-dimensional — the masked trajectory
+    must track the full env's exactly (regression: a masked reset once
+    leaked into the parent's auto-reset and broke shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.env.jax_env import make_env
+
+    full = make_env("CartPole-v1", {"max_steps": 50})
+    masked = make_env("StatelessCartPole", {"max_steps": 50})
+    assert masked.observation_space.shape == (2,)
+
+    key = jax.random.PRNGKey(0)
+    sf, of = full.reset(key)
+    sm, om = masked.reset(key)
+    np.testing.assert_allclose(np.asarray(om),
+                               np.asarray(of)[[0, 2]])
+    for t in range(60):      # crosses at least one auto-reset boundary
+        key, k = jax.random.split(key)
+        a = jnp.asarray(t % 2)
+        sf, of, rf, df, _ = full.step(sf, a, k)
+        sm, om, rm, dm, _ = masked.step(sm, a, k)
+        assert om.shape == (2,)
+        np.testing.assert_allclose(np.asarray(om),
+                                   np.asarray(of)[[0, 2]], rtol=1e-6)
+        assert bool(df) == bool(dm) and float(rf) == float(rm)
