@@ -92,6 +92,17 @@ class AttachClient:
         if timeout is None:
             from ray_tpu._private.constants import ATTACH_CONTROL_TIMEOUT_S
             timeout = ATTACH_CONTROL_TIMEOUT_S
+            # long-blocking server methods (pubsub_poll, wait, stack)
+            # carry their server-side blocking window in the payload; the
+            # transport deadline must sit strictly ABOVE that window or an
+            # idle long-poll races into a spurious ConnectionError
+            if isinstance(payload, dict) and "timeout" in payload:
+                try:
+                    srv = float(payload["timeout"])
+                except (TypeError, ValueError):
+                    srv = 0.0
+                if srv > 0:     # non-blocking calls keep the short
+                    timeout = max(timeout, srv + 10.0)  # user deadline
         with self._lock:
             self._req += 1
             rid = self._req

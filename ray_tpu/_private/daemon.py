@@ -300,6 +300,10 @@ class HostDaemon:
                     for wrapped in self._sent_ring:
                         conn.send(wrapped)
                 except (OSError, ValueError, BrokenPipeError):
+                    try:
+                        conn.close()   # don't leak the fd while the
+                    except OSError:    # head keeps flapping
+                        pass
                     continue     # new conn died mid-handshake: retry
                 self._head = conn
             logger.warning("re-registered with head "
@@ -837,6 +841,13 @@ class HostDaemon:
                 self._maybe_spill()
             except Exception:
                 logger.exception("daemon spill pass failed")
+            try:
+                # reclaim condemned pull buffers even if this node never
+                # pulls again (the sweep otherwise only runs on the next
+                # pull / abort_all)
+                self._pull_client.sweep()
+            except Exception:
+                logger.exception("tombstone sweep failed")
 
     def _maybe_spill(self):
         from ray_tpu._private.spill import run_spill_pass

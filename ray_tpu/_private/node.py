@@ -365,6 +365,10 @@ class NodeServer:
             threading.Thread(target=self._snapshot_loop,
                              name="ray_tpu-gcs-snapshot",
                              daemon=True).start()
+        # usage stats: local session snapshot always; network report only
+        # when explicitly opted in (usage_lib.py:92 analog, inverted)
+        from ray_tpu._private.usage_stats import UsageReporter
+        self._usage_reporter = UsageReporter(self).start()
         atexit.register(self.shutdown)
 
     # ------------------------------------------------------------------
@@ -3496,6 +3500,10 @@ class NodeServer:
             self._shutdown = True
             workers = list(self.workers.values())
             nodes = list(self.nodes.values())
+        try:
+            self._usage_reporter.stop()
+        except AttributeError:
+            pass
         self._sched_event.set()   # release the scheduler thread
         for node in nodes:
             node.alive = False

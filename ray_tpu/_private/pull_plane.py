@@ -146,9 +146,19 @@ class PullClient:
 
     def abort_all(self) -> None:
         """Wake every waiter (e.g. a source node died) so their
-        abort_check can run immediately."""
+        abort_check can run immediately. Also sweeps expired tombstones:
+        a node that stops pulling would otherwise never reclaim condemned
+        arena blocks (the sweep normally runs at the start of the next
+        pull)."""
         with self._cv:
+            self._sweep_tombstones_locked()
             self._cv.notify_all()
+
+    def sweep(self) -> None:
+        """Reclaim expired tombstoned allocations; safe to call from a
+        periodic maintenance loop (daemon spill pass)."""
+        with self._cv:
+            self._sweep_tombstones_locked()
 
     def pull(self, send, oid: str, abort_check=None,
              timeout: float | None = None, alloc=None, cleanup=None):

@@ -152,6 +152,16 @@ class _ForkServerClient:
         from multiprocessing import connection as mpc
         if self._conn is not None and self._proc.poll() is None:
             return True
+        if self._proc is not None:
+            # a previous factory whose connection dropped is still ours to
+            # reap — left alone it would keep the old socket path open and
+            # linger as an orphan beside the replacement
+            try:
+                self._proc.kill()
+                self._proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            self._proc = None
         sock = os.path.join(constants.SHM_ROOT,
                             f"ray_tpu_fs_{os.getpid()}.sock")
         env = propagate_pythonpath(dict(os.environ))

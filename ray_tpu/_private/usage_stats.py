@@ -1,0 +1,150 @@
+"""Usage-stats collection (reference: `_private/usage/usage_lib.py:92`).
+
+The reference gathers cluster metadata + "library usages" (which Ray
+libraries a session touched) and reports them to a telemetry endpoint
+unless the user opts out. Here the polarity is inverted and the sink is
+local-first: a `usage_stats.json` snapshot is always written into the
+session directory (free, useful for support bundles), and anything
+leaving the machine requires BOTH an explicit opt-in
+(`RAY_TPU_USAGE_STATS_ENABLED=1`) and a configured report URL
+(`RAY_TPU_USAGE_STATS_URL`) — the right default for TPU pods, which
+commonly run with zero egress.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import threading
+import time
+
+_lock = threading.Lock()
+_library_usages: set[str] = set()
+_extra_tags: dict[str, str] = {}
+_start_ts = time.time()
+
+
+def usage_stats_enabled() -> bool:
+    """Whether REPORTING (not local collection) is on. Opt-in, unlike
+    the reference's opt-out — this build targets zero-egress pods."""
+    return os.environ.get(
+        "RAY_TPU_USAGE_STATS_ENABLED", "0").strip().lower() in (
+            "1", "true", "yes", "on")
+
+
+def record_library_usage(library: str) -> None:
+    """Called from library entry points (train/tune/data/serve/rllib),
+    mirroring `usage_lib.record_library_usage`."""
+    with _lock:
+        _library_usages.add(library)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    with _lock:
+        _extra_tags[str(key)] = str(value)
+
+
+def library_usages() -> list[str]:
+    with _lock:
+        return sorted(_library_usages)
+
+
+def collect(node=None) -> dict:
+    """Build the usage payload (reference: `UsageStatsToReport`)."""
+    import ray_tpu
+    data = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "ray_tpu_version": ray_tpu.__version__,
+        "python_version": sys.version.split()[0],
+        "os": platform.system().lower(),
+        "arch": platform.machine(),
+        "session_uptime_s": round(time.time() - _start_ts, 1),
+        "libraries": library_usages(),
+        "collected_at": time.time(),
+    }
+    with _lock:
+        if _extra_tags:
+            data["extra_tags"] = dict(_extra_tags)
+    if node is not None:
+        try:
+            with node.lock:
+                peers = [n for n in node.nodes.values() if n.alive]
+                res = dict(node.total_resources)
+                for n in peers:
+                    for k, v in (n.total or {}).items():
+                        res[k] = res.get(k, 0) + v
+            data["total_num_nodes"] = 1 + len(peers)
+            data["total_num_cpus"] = res.get("CPU", 0)
+            data["total_num_tpus"] = res.get("TPU", 0)
+            data["session_id"] = os.path.basename(
+                getattr(node, "session_dir", "") or "")
+        except Exception:
+            pass
+    return data
+
+
+def write_local(node) -> str | None:
+    """Dump the payload beside the session's other artifacts."""
+    sd = getattr(node, "session_dir", None)
+    if not sd or not os.path.isdir(sd):
+        return None
+    path = os.path.join(sd, "usage_stats.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(collect(node), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def maybe_report(node) -> bool:
+    """POST the payload iff opted in AND a URL is configured. Returns
+    whether a report was sent (used by the test with a local server)."""
+    if not usage_stats_enabled():
+        return False
+    url = os.environ.get("RAY_TPU_USAGE_STATS_URL", "").strip()
+    if not url:
+        return False
+    import urllib.request
+    body = json.dumps(collect(node)).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return 200 <= r.status < 300
+    except OSError:
+        return False
+
+
+class UsageReporter:
+    """Periodic local dump + (opted-in) report; one per head node."""
+
+    def __init__(self, node, interval_s: float = 300.0):
+        self._node = node
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="usage-stats")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        # first dump quickly so short-lived sessions still leave one
+        delay = min(10.0, self._interval)
+        while not self._stop.wait(delay):
+            delay = self._interval
+            try:
+                write_local(self._node)
+                maybe_report(self._node)
+            except Exception:
+                pass

@@ -296,7 +296,8 @@ class TpuVmNodeProvider(NodeProvider):
                 self.cfg["head_address"],
                 self.cfg.get("authkey_hex", ""),
                 num_tpus=None if ntpus is None else int(ntpus),
-                custom_resources=custom or None)
+                custom_resources=custom or None,
+                authkey_secret=self.cfg.get("authkey_secret"))
         if script:
             meta = dict(body_base.get("metadata") or {})
             meta["startup-script"] = script
@@ -367,14 +368,25 @@ class TpuVmNodeProvider(NodeProvider):
 def default_startup_script(head_address: str, authkey_hex: str,
                            num_tpus: int | None = None,
                            custom_resources: dict | None = None,
-                           extra: str = "") -> str:
+                           extra: str = "",
+                           authkey_secret: str | None = None) -> str:
     """Startup script run on EVERY host of the slice: join the head as a
     HostDaemon. The TPU platform executes it per-worker, which is how one
     provider node fans out into N cluster nodes. When `num_tpus` is None
     the host auto-detects its local chips (`start` runs
     `_detect_tpu_chips()` when the flag is absent) — the right default on
     a real TPU-VM; custom resources the node type declared ride along so
-    the hosts advertise what the autoscaler planned for."""
+    the hosts advertise what the autoscaler planned for.
+
+    Authkey distribution: instance metadata is readable by anyone with
+    TPU-node read access on the project, so embedding the authkey there
+    exposes cluster control to project readers. When `authkey_secret` is
+    set (a Secret Manager resource, `projects/P/secrets/S` — latest
+    version is used, or a full `.../versions/N` path) the script instead
+    fetches the hex authkey at boot with the VM's own service-account
+    token and NOTHING secret lands in metadata; grant the node SA
+    `secretmanager.versions.access` on that secret. `authkey_hex` then
+    only serves as a fallback for air-gapped test rigs and may be ""."""
     join = (f"python3 -m ray_tpu.scripts.cli start "
             f"--address {head_address}")
     if num_tpus is not None:
@@ -382,10 +394,42 @@ def default_startup_script(head_address: str, authkey_hex: str,
     if custom_resources:
         import shlex
         join += f" --resources {shlex.quote(json.dumps(custom_resources))}"
+    if authkey_secret:
+        sec = authkey_secret
+        if "/versions/" not in sec:
+            sec = sec.rstrip("/") + "/versions/latest"
+        # the resource name lands inside a root-run boot script: refuse
+        # anything that isn't a plain Secret Manager path (the same
+        # strictness node-type tags get above)
+        import re
+        if not re.fullmatch(
+                r"projects/[A-Za-z0-9._-]+/secrets/[A-Za-z0-9._-]+"
+                r"/versions/[A-Za-z0-9._-]+", sec):
+            raise ValueError(
+                f"authkey_secret must look like projects/P/secrets/S"
+                f"[/versions/V]; got {authkey_secret!r}")
+        # NOTE: plain assignments (not `export VAR=$(...)`) so a failed
+        # fetch propagates through set -e instead of booting the host
+        # with an empty authkey
+        fetch = (
+            'TOK=$(curl -s -H "Metadata-Flavor: Google" '
+            '"http://metadata.google.internal/computeMetadata/v1/'
+            'instance/service-accounts/default/token" '
+            "| python3 -c 'import sys,json;"
+            'print(json.load(sys.stdin)["access_token"])\')\n'
+            f'RAY_TPU_AUTHKEY=$(curl -s -H "Authorization: '
+            f'Bearer $TOK" "https://secretmanager.googleapis.com/v1/'
+            f'{sec}:access" '
+            "| python3 -c 'import sys,json,base64;"
+            'print(base64.b64decode(json.load(sys.stdin)["payload"]'
+            '["data"]).decode().strip())\')\n'
+            'export RAY_TPU_AUTHKEY')
+    else:
+        fetch = f"export RAY_TPU_AUTHKEY={authkey_hex}"
     return "\n".join([
         "#!/bin/bash",
         "set -e",
         extra or "true",
-        f"export RAY_TPU_AUTHKEY={authkey_hex}",
+        fetch,
         join + " --block &",
     ])

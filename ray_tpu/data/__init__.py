@@ -49,3 +49,7 @@ __all__ = [
     "read_webdataset",
     "read_tfrecords",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu

@@ -43,3 +43,7 @@ __all__ = [
     "ES", "ESConfig", "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
     "ApexDQN", "ApexDQNConfig",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu

@@ -32,3 +32,7 @@ __all__ = [
     "multiplexed", "get_multiplexed_model_id", "apply_config",
     "build_app_from_config",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

@@ -171,6 +171,8 @@ class ServeController:
             "init_args": st.spec.get("init_args", ()),
             "init_kwargs": st.spec.get("init_kwargs", {}),
             "deployment_name": st.name,
+            "max_concurrent_queries":
+                st.spec.get("max_concurrent_queries", 8),
         })
 
     def _health_check(self, replicas: list) -> list:

@@ -59,6 +59,16 @@ class Replica:
         # pin the generator + its closure for the replica's lifetime)
         self._streams: dict[int, list] = {}
         self._stream_ids = itertools.count(1)
+        # Sync handlers get a dedicated pool sized to the concurrency the
+        # deployment declared: the default asyncio executor caps at
+        # ~min(32, cpus+4) threads, which would throttle sync-handler
+        # concurrency below max_concurrent_queries and can deadlock a
+        # deployment whose sync handlers call back into itself.
+        from concurrent.futures import ThreadPoolExecutor
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(1, int(
+                serialized_init.get("max_concurrent_queries", 8))),
+            thread_name_prefix=f"replica-{self.deployment_name}")
 
     def ready(self) -> bool:
         return True
@@ -131,7 +141,7 @@ class Replica:
             ctx = contextvars.copy_context()
             loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
-                None, lambda: ctx.run(target, *args, **kwargs))
+                self._executor, lambda: ctx.run(target, *args, **kwargs))
         if inspect.isawaitable(result):   # sync fn returning a coroutine
             result = await result
         return result

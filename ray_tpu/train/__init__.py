@@ -38,3 +38,7 @@ __all__ = [
     "session", "report", "get_checkpoint", "get_dataset_shard",
     "get_world_size", "get_world_rank", "get_mesh_spec",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu

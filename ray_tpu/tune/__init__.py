@@ -71,3 +71,7 @@ __all__ = [
     # runner
     "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

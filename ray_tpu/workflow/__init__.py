@@ -344,3 +344,7 @@ def delete(workflow_id: str) -> None:
 
 __all__ = ["init", "run", "resume", "get_output", "get_status",
            "list_all", "delete", "WorkflowStatus"]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu

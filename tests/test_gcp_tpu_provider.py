@@ -151,6 +151,34 @@ def test_startup_script_injected():
         api.close()
 
 
+def test_startup_script_authkey_secret_keeps_metadata_clean():
+    """With authkey_secret configured, the hex authkey never lands in
+    instance metadata — the script fetches it from Secret Manager with
+    the VM's own service-account token at boot (ADVICE r4: plaintext
+    authkey in startup-script metadata exposes cluster control to any
+    project reader)."""
+    api = FakeTpuApi()
+    url = api.serve()
+    try:
+        p = TpuVmNodeProvider(
+            {"project_id": "p", "zone": "z", "api_endpoint": url,
+             "token": "t", "operation_poll_interval_s": 0.05,
+             "head_address": "10.0.0.1:6379", "authkey_hex": "deadbeef",
+             "authkey_secret": "projects/p/secrets/ray-authkey"},
+            cluster_name="c")
+        p.create_node({"accelerator_type": "v5litepod-8"},
+                      {TAG_NODE_KIND: "worker"}, 1)
+        nid = p.non_terminated_nodes({})[0]
+        script = api.nodes[nid]["metadata"]["startup-script"]
+        assert "deadbeef" not in script
+        assert ("secretmanager.googleapis.com/v1/projects/p/secrets/"
+                "ray-authkey/versions/latest:access") in script
+        assert "Metadata-Flavor: Google" in script   # SA token fetch
+        assert "RAY_TPU_AUTHKEY" in script
+    finally:
+        api.close()
+
+
 def test_label_unsafe_node_type_rejected():
     api = FakeTpuApi()
     url = api.serve()

@@ -280,3 +280,54 @@ def test_pubsub_ring_cap(cluster, monkeypatch):
                         "timeout": 0.0})
     assert last == 25
     assert len(msgs) == 10 and msgs == list(range(15, 25))
+
+
+def test_usage_stats_local_and_optin_report(cluster, monkeypatch):
+    """Usage stats (reference: _private/usage/usage_lib.py:92): local
+    session snapshot always works; network reporting requires BOTH the
+    explicit opt-in env AND a configured URL (zero-egress default)."""
+    import os
+    from ray_tpu._private import usage_stats as us
+
+    us.record_library_usage("unit_test_lib")
+    node = ray_tpu._worker.get_client().node
+    path = us.write_local(node)
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["total_num_nodes"] >= 1
+    assert "unit_test_lib" in payload["libraries"]
+    assert payload["ray_tpu_version"] == ray_tpu.__version__
+
+    # off by default, even with a URL configured
+    monkeypatch.delenv("RAY_TPU_USAGE_STATS_ENABLED", raising=False)
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_URL",
+                       "http://127.0.0.1:1/nope")
+    assert us.maybe_report(node) is False
+
+    # opted in: POSTs the payload to the configured endpoint
+    import http.server
+    import threading
+    got = {}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got["body"] = json.loads(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.handle_request, daemon=True)
+    t.start()
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    monkeypatch.setenv(
+        "RAY_TPU_USAGE_STATS_URL",
+        f"http://127.0.0.1:{srv.server_address[1]}/usage")
+    assert us.maybe_report(node) is True
+    t.join(timeout=5)
+    srv.server_close()
+    assert "unit_test_lib" in got["body"]["libraries"]

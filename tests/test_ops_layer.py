@@ -7,6 +7,7 @@ and captured logs; an external process attaches to the session socket.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -142,3 +143,29 @@ def test_cli_attach_from_subprocess(cluster):
         capture_output=True, text=True, timeout=60, cwd="/root/repo")
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout)[0]["alive"] is True
+
+
+def test_attach_idle_longpoll_outlives_control_timeout(cluster):
+    """An attach client whose default control deadline is SHORTER than a
+    long-poll's server-side window must still get the empty batch back,
+    not a spurious ConnectionError (ADVICE r3 #3: the transport deadline
+    used to equal the server poll timeout exactly)."""
+    session_dir = ray_tpu._worker.get_client().node.session_dir
+    script = (
+        "from ray_tpu._private.attach import AttachClient\n"
+        f"c = AttachClient({session_dir!r})\n"
+        "last, msgs = c.control('pubsub_poll',"
+        " {'channel': 'idle_chan_never_published', 'after': 0,"
+        "  'timeout': 4.0})\n"
+        "assert msgs == [], msgs\n"
+        "c.close()\n"
+        "print('POLL_OK')\n")
+    env = dict(os.environ)
+    # client-side default deadline (2s) < server-side poll window (4s):
+    # before the fix this raised ConnectionError at 2s
+    env["RAY_TPU_ATTACH_CONTROL_TIMEOUT_S"] = "2.0"
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=60, cwd="/root/repo", env=env)
+    assert out.returncode == 0, out.stderr
+    assert "POLL_OK" in out.stdout
