@@ -3122,7 +3122,13 @@ class NodeServer:
     def _release_task_resources(self, t: _TaskState):
         if not t.node_released:
             pg = self.placement_groups.get(t.spec.placement_group_id or "")
-            if pg is not None:
+            if t.spec.placement_group_id and pg is None:
+                # The group was already removed (remove_pg credits the
+                # FULL bundles back wholesale); crediting the node again
+                # here would double-count — kill() is async, so actor/
+                # task death often lands after the PG teardown.
+                pass
+            elif pg is not None:
                 # return to the first bundle with headroom vs its spec
                 for b, orig in zip(pg.available, pg.bundles):
                     if all(b.get(k, 0) + v <= orig.get(k, 0) + _EPS
@@ -3153,6 +3159,10 @@ class NodeServer:
             a.creation_spec.placement_group_id or "")
         if pg is not None and pg.available:
             _add(pg.available[0], a.resources)
+        elif a.creation_spec.placement_group_id:
+            # PG already removed; its bundles were credited wholesale
+            # (see _release_task_resources) — don't double-credit.
+            pass
         elif pg is None:
             if a.node is not None:
                 node = self.nodes.get(a.node)

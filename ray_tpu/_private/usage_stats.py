@@ -132,17 +132,26 @@ class UsageReporter:
             target=self._loop, daemon=True, name="usage-stats")
 
     def start(self):
+        # synchronous first dump: even a session that exits immediately
+        # leaves a usage_stats.json snapshot behind
+        try:
+            write_local(self._node)
+        except Exception:
+            pass
         self._thread.start()
         return self
 
     def stop(self):
         self._stop.set()
+        # final dump so the snapshot reflects end-of-session state
+        try:
+            write_local(self._node)
+        except Exception:
+            pass
 
     def _loop(self):
-        # first dump quickly so short-lived sessions still leave one
-        delay = min(10.0, self._interval)
+        delay = self._interval
         while not self._stop.wait(delay):
-            delay = self._interval
             try:
                 write_local(self._node)
                 maybe_report(self._node)

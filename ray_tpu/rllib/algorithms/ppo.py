@@ -44,6 +44,13 @@ class PPOConfig(AlgorithmConfig):
         self.rollout_fragment_length = 128
         self.num_envs_per_worker = 16
         self.grad_clip = 0.5
+        # >1: shard the WHOLE fused iteration (rollout + GAE + SGD) over
+        # a data-axis device mesh via shard_map — env batch split across
+        # devices, gradients pmean'd over ICI. The TPU-native analogue of
+        # the reference's multi-GPU learner stack
+        # (rllib/execution/multi_gpu_learner_thread.py), except sampling
+        # shards too, not just the SGD pass.
+        self.num_learner_devices = 0
 
 
 def _ppo_loss(module, params, batch, clip_param, vf_clip_param,
@@ -98,12 +105,36 @@ class PPO(Algorithm):
         self.opt_state = self.optimizer.init(self.params)
         self.workers = None
         self._in_graph = is_jax_env(self.env)
+        self._axis_name = None
         if self._in_graph and cfg.num_rollout_workers == 0:
             self.sampler = InGraphSampler(
                 self.env, self.module, cfg.num_envs_per_worker,
                 cfg.rollout_fragment_length)
             self._carry = self.sampler.init_state(self.next_key())
-            self._train_fn = jax.jit(self._fused_iteration)
+            n = int(cfg.num_learner_devices or 0)
+            if n > 1:
+                from jax.sharding import Mesh, PartitionSpec as P
+                from jax.experimental.shard_map import shard_map
+                if cfg.num_envs_per_worker % n:
+                    raise ValueError(
+                        f"num_envs_per_worker={cfg.num_envs_per_worker} "
+                        f"must divide over num_learner_devices={n}")
+                devices = np.array(jax.devices()[:n])
+                if len(devices) < n:
+                    raise ValueError(
+                        f"num_learner_devices={n} but only "
+                        f"{len(devices)} devices visible")
+                self._mesh = Mesh(devices, ("data",))
+                self._axis_name = "data"
+                fn = shard_map(
+                    self._fused_iteration, mesh=self._mesh,
+                    in_specs=(P(), P(), P("data"), P()),
+                    out_specs=(P(), P(), P("data"), P(),
+                               P(None, "data")),
+                    check_rep=False)
+                self._train_fn = jax.jit(fn)
+            else:
+                self._train_fn = jax.jit(self._fused_iteration)
         else:
             env_spec, env_cfg = cfg.env, dict(cfg.env_config)
             model_cfg = dict(cfg.model)
@@ -128,6 +159,11 @@ class PPO(Algorithm):
 
     def _fused_iteration(self, params, opt_state, carry, key):
         cfg = self.algo_config
+        if self._axis_name:
+            # distinct sampling/shuffle streams per shard; params stay
+            # replicated because gradients are pmean'd before the update
+            key = jax.random.fold_in(
+                key, jax.lax.axis_index(self._axis_name))
         k_sample, k_sgd = jax.random.split(key)
         carry, traj, last_value = self.sampler._unroll_impl(
             params, carry, k_sample)
@@ -151,10 +187,18 @@ class PPO(Algorithm):
         n = flat[sb.ADVANTAGES].shape[0]
         mb = min(cfg.sgd_minibatch_size, n)
         num_mb = max(n // mb, 1)
-        # advantage standardization (reference: postprocessing.py)
+        # advantage standardization (reference: postprocessing.py) —
+        # with GLOBAL moments when sharded over the learner mesh
         adv = flat[sb.ADVANTAGES]
         flat = dict(flat)
-        flat[sb.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        if self._axis_name:
+            mean = jax.lax.pmean(adv.mean(), self._axis_name)
+            var = jax.lax.pmean(jnp.square(adv - mean).mean(),
+                                self._axis_name)
+            std = jnp.sqrt(var)
+        else:
+            mean, std = adv.mean(), adv.std()
+        flat[sb.ADVANTAGES] = (adv - mean) / (std + 1e-8)
 
         loss_fn = functools.partial(
             _ppo_loss, self.module,
@@ -166,9 +210,11 @@ class PPO(Algorithm):
             params, opt_state = state
             (_, stats), grads = jax.value_and_grad(
                 lambda p: loss_fn(p, batch), has_aux=True)(params)
-            # DP gradient sync seam: under shard_map/pjit this mean is a
-            # psum over the mesh's data axis; single-process jit makes it
-            # a no-op (SURVEY.md §2.3 TPU-native mapping).
+            if self._axis_name:
+                # DP gradient sync: one pmean over the mesh's data axis
+                # (ICI collective on real chips — SURVEY.md §2.3 mapping)
+                grads = jax.lax.pmean(grads, self._axis_name)
+                stats = jax.lax.pmean(stats, self._axis_name)
             updates, opt_state = self.optimizer.update(
                 grads, opt_state, params)
             params = optax.apply_updates(params, updates)

@@ -1,6 +1,9 @@
 from ray_tpu.rllib.env.jax_env import (
     CartPole, JaxEnv, Pendulum, make_env, register_env)
+from ray_tpu.rllib.env.pixel import (
+    PixelAsterix, PixelBreakout, PixelInvaders)
 from ray_tpu.rllib.env.spaces import Box, Discrete, Space
 
 __all__ = ["JaxEnv", "CartPole", "Pendulum", "make_env", "register_env",
-           "Box", "Discrete", "Space"]
+           "Box", "Discrete", "Space",
+           "PixelBreakout", "PixelAsterix", "PixelInvaders"]

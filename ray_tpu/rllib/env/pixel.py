@@ -1,0 +1,406 @@
+"""Pixel-observation environments — the repo's Atari-class oracle tier.
+
+The reference validates its RL stack on Atari via gym + ALE wrappers
+(`rllib/env/wrappers/atari_wrappers.py`) and time-to-reward tuned
+examples (`rllib/tuned_examples/ppo/pong-ppo.yaml:1`,
+`impala/pong-impala-fast.yaml:1-4`). ALE is a C emulator — it cannot be
+vmapped or scanned, so a TPU-first framework needs its own pixel tier:
+MinAtar-class games (10x10 grids, multi-channel binary images, moving
+objects, sparse-ish rewards) written as pure jnp functions. That keeps
+the defining difficulty of the Atari oracle — a conv encoder must learn
+spatio-temporal structure from pixels — while the whole rollout stays
+inside one XLA program (vmap → vector env, lax.scan → unroll), so the
+same env runs on the in-graph sampler, the actor path, and an 8-device
+mesh unchanged.
+
+Games follow the published MinAtar mechanics (Young & Tian 2019) but are
+re-derived and simplified where it does not change the difficulty class;
+no code is shared with any emulator.
+
+Observations are [10, 10, C] float32 in {0, 1}; channel semantics are
+listed per game. The conv catalog in `core/rl_module.py` picks the conv
+torso for these automatically (rank-3 obs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env.jax_env import JaxEnv, register_env
+from ray_tpu.rllib.env.spaces import Box, Discrete
+
+_SIZE = 10
+
+
+def _blank(channels: int):
+    return jnp.zeros((_SIZE, _SIZE, channels), jnp.float32)
+
+
+class PixelBreakout(JaxEnv):
+    """Breakout on a 10x10 grid.
+
+    Channels: 0 paddle, 1 ball, 2 ball-trail (previous ball cell — lets a
+    feedforward conv infer direction), 3 bricks.
+
+    A 3-row brick wall sits in rows 1-3; the paddle slides on row 9.
+    The ball moves one diagonal cell per step, bouncing off walls, bricks
+    (+1 reward each) and the paddle; missing the ball ends the episode.
+    A cleared wall respawns, so skilled play is unbounded up to the step
+    cap. Actions: 0 noop, 1 left, 2 right.
+    """
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 500))
+        self.observation_space = Box(0.0, 1.0, (_SIZE, _SIZE, 4))
+        self.action_space = Discrete(3)
+
+    def _render(self, s):
+        obs = _blank(4)
+        obs = obs.at[9, s["paddle"], 0].set(1.0)
+        obs = obs.at[s["ball_y"], s["ball_x"], 1].set(1.0)
+        obs = obs.at[s["last_y"], s["last_x"], 2].set(1.0)
+        obs = obs.at[1:4, :, 3].set(s["bricks"].astype(jnp.float32))
+        return obs
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        side = jax.random.randint(k1, (), 0, 2)          # spawn corner
+        ball_x = jnp.where(side == 0, 0, _SIZE - 1).astype(jnp.int32)
+        dx = jnp.where(side == 0, 1, -1).astype(jnp.int32)
+        s = {
+            "ball_y": jnp.asarray(3, jnp.int32),
+            "ball_x": ball_x,
+            "last_y": jnp.asarray(3, jnp.int32),
+            "last_x": ball_x,
+            "dy": jnp.asarray(1, jnp.int32),
+            "dx": dx,
+            "paddle": jax.random.randint(k2, (), 0, _SIZE),
+            "bricks": jnp.ones((3, _SIZE), jnp.int32),
+            "t": jnp.asarray(0, jnp.int32),
+        }
+        return s, self._render(s)
+
+    def step(self, state, action, key):
+        s = dict(state)
+        action = jnp.asarray(action)
+        paddle = jnp.clip(
+            s["paddle"] + (action == 2).astype(jnp.int32)
+            - (action == 1).astype(jnp.int32), 0, _SIZE - 1)
+
+        # -- ball advance with wall reflection
+        nx = s["ball_x"] + s["dx"]
+        dx = jnp.where((nx < 0) | (nx >= _SIZE), -s["dx"], s["dx"])
+        nx = jnp.clip(jnp.where(nx < 0, -nx, nx), 0, _SIZE - 1)
+        ny = s["ball_y"] + s["dy"]
+        hit_top = ny < 0
+        dy = jnp.where(hit_top, 1, s["dy"])
+        ny = jnp.where(hit_top, 1, ny)
+
+        # -- brick collision: bounce back, consume the brick
+        in_wall = (ny >= 1) & (ny <= 3)
+        brick_row = jnp.clip(ny - 1, 0, 2)
+        hit_brick = in_wall & (s["bricks"][brick_row, nx] == 1)
+        bricks = s["bricks"].at[brick_row, nx].set(
+            jnp.where(hit_brick, 0, s["bricks"][brick_row, nx]))
+        reward = hit_brick.astype(jnp.float32)
+        dy = jnp.where(hit_brick, -dy, dy)
+        ny = jnp.where(hit_brick, s["ball_y"], ny)
+
+        # -- paddle row: catch or miss
+        at_bottom = ny >= _SIZE - 1
+        caught = at_bottom & (nx == paddle)
+        dy = jnp.where(caught, -1, dy)
+        missed = at_bottom & ~caught
+
+        # -- cleared wall respawns
+        cleared = jnp.all(bricks == 0)
+        bricks = jnp.where(cleared, jnp.ones_like(bricks), bricks)
+
+        t = s["t"] + 1
+        done = missed | (t >= self.max_steps)
+        new = {
+            "ball_y": ny, "ball_x": nx,
+            "last_y": s["ball_y"], "last_x": s["ball_x"],
+            "dy": dy, "dx": dx, "paddle": paddle,
+            "bricks": bricks, "t": t,
+        }
+        reset_state, reset_obs = self.reset(key)
+        merged = jax.tree.map(
+            lambda r, n: jnp.where(done, r, n), reset_state, new)
+        obs = jnp.where(done, reset_obs, self._render(new))
+        return merged, obs, reward, done, {}
+
+
+class PixelAsterix(JaxEnv):
+    """Asterix on a 10x10 grid.
+
+    Channels: 0 player, 1 enemy, 2 gold, 3 motion-trail (the cell each
+    active entity occupied last move).
+
+    The player walks the middle rows (1-8); one entity per row slides
+    across from a random side every few steps — gold pays +1 when
+    touched, an enemy ends the episode. Actions: 0 noop, 1 left,
+    2 right, 3 up, 4 down.
+    """
+
+    _ROWS = 8                      # entity rows 1..8
+    _SPAWN_EVERY = 3
+    _MOVE_EVERY = 2
+    _GOLD_P = 0.4
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 300))
+        self.observation_space = Box(0.0, 1.0, (_SIZE, _SIZE, 4))
+        self.action_space = Discrete(5)
+
+    def _render(self, s):
+        obs = _blank(4)
+        obs = obs.at[s["py"], s["px"], 0].set(1.0)
+        rows = jnp.arange(self._ROWS) + 1
+        act = s["e_active"].astype(jnp.float32)
+        enemy = act * (1.0 - s["e_gold"].astype(jnp.float32))
+        gold = act * s["e_gold"].astype(jnp.float32)
+        obs = obs.at[rows, s["e_x"], 1].add(enemy)
+        obs = obs.at[rows, s["e_x"], 2].add(gold)
+        trail_x = jnp.clip(s["e_x"] - s["e_dir"], 0, _SIZE - 1)
+        obs = obs.at[rows, trail_x, 3].add(act)
+        return jnp.clip(obs, 0.0, 1.0)
+
+    def reset(self, key):
+        s = {
+            "py": jnp.asarray(5, jnp.int32),
+            "px": jnp.asarray(5, jnp.int32),
+            "e_x": jnp.zeros((self._ROWS,), jnp.int32),
+            "e_dir": jnp.ones((self._ROWS,), jnp.int32),
+            "e_active": jnp.zeros((self._ROWS,), jnp.bool_),
+            "e_gold": jnp.zeros((self._ROWS,), jnp.bool_),
+            "spawn_t": jnp.asarray(self._SPAWN_EVERY, jnp.int32),
+            "move_t": jnp.asarray(self._MOVE_EVERY, jnp.int32),
+            "t": jnp.asarray(0, jnp.int32),
+        }
+        return s, self._render(s)
+
+    def _collide(self, s, reward, dead):
+        """Touch resolution: gold collects, enemy kills."""
+        row = s["py"] - 1                      # entity slot for player row
+        valid = (s["py"] >= 1) & (s["py"] <= self._ROWS)
+        slot_x = s["e_x"][jnp.clip(row, 0, self._ROWS - 1)]
+        slot_active = s["e_active"][jnp.clip(row, 0, self._ROWS - 1)]
+        slot_gold = s["e_gold"][jnp.clip(row, 0, self._ROWS - 1)]
+        touch = valid & slot_active & (slot_x == s["px"])
+        reward = reward + (touch & slot_gold).astype(jnp.float32)
+        dead = dead | (touch & ~slot_gold)
+        s["e_active"] = s["e_active"].at[jnp.clip(row, 0, self._ROWS - 1)] \
+            .set(jnp.where(touch, False,
+                           s["e_active"][jnp.clip(row, 0,
+                                                  self._ROWS - 1)]))
+        return s, reward, dead
+
+    def step(self, state, action, key):
+        s = dict(state)
+        action = jnp.asarray(action)
+        k_spawn_row, k_spawn_side, k_spawn_gold, k_reset = \
+            jax.random.split(key, 4)
+
+        # -- player move (rows 1..8 only)
+        px = jnp.clip(s["px"] + (action == 2).astype(jnp.int32)
+                      - (action == 1).astype(jnp.int32), 0, _SIZE - 1)
+        py = jnp.clip(s["py"] + (action == 4).astype(jnp.int32)
+                      - (action == 3).astype(jnp.int32), 1, self._ROWS)
+        s["px"], s["py"] = px, py
+
+        reward = jnp.asarray(0.0)
+        dead = jnp.asarray(False)
+        s, reward, dead = self._collide(s, reward, dead)
+
+        # -- entity slide every _MOVE_EVERY steps
+        move_t = s["move_t"] - 1
+        do_move = move_t <= 0
+        move_t = jnp.where(do_move, self._MOVE_EVERY, move_t)
+        nx = s["e_x"] + jnp.where(do_move, s["e_dir"], 0)
+        off = (nx < 0) | (nx >= _SIZE)
+        s["e_active"] = s["e_active"] & ~off
+        s["e_x"] = jnp.clip(nx, 0, _SIZE - 1)
+        s["move_t"] = move_t
+        s, reward, dead = self._collide(s, reward, dead)
+
+        # -- spawn into a random row every _SPAWN_EVERY steps
+        spawn_t = s["spawn_t"] - 1
+        do_spawn = spawn_t <= 0
+        spawn_t = jnp.where(do_spawn, self._SPAWN_EVERY, spawn_t)
+        row = jax.random.randint(k_spawn_row, (), 0, self._ROWS)
+        free = ~s["e_active"][row]
+        place = do_spawn & free
+        side = jax.random.randint(k_spawn_side, (), 0, 2)
+        sx = jnp.where(side == 0, 0, _SIZE - 1).astype(jnp.int32)
+        sdir = jnp.where(side == 0, 1, -1).astype(jnp.int32)
+        sgold = jax.random.uniform(k_spawn_gold) < self._GOLD_P
+        s["e_x"] = s["e_x"].at[row].set(jnp.where(place, sx,
+                                                  s["e_x"][row]))
+        s["e_dir"] = s["e_dir"].at[row].set(jnp.where(place, sdir,
+                                                      s["e_dir"][row]))
+        s["e_gold"] = s["e_gold"].at[row].set(
+            jnp.where(place, sgold, s["e_gold"][row]))
+        s["e_active"] = s["e_active"].at[row].set(
+            jnp.where(place, True, s["e_active"][row]))
+        s["spawn_t"] = spawn_t
+
+        t = state["t"] + 1
+        s["t"] = t
+        done = dead | (t >= self.max_steps)
+        reset_state, reset_obs = self.reset(k_reset)
+        merged = jax.tree.map(
+            lambda r, n: jnp.where(done, r, n), reset_state, s)
+        obs = jnp.where(done, reset_obs, self._render(s))
+        return merged, obs, reward, done, {}
+
+
+class PixelInvaders(JaxEnv):
+    """Space Invaders on a 10x10 grid.
+
+    Channels: 0 player cannon, 1 aliens, 2 friendly bullet,
+    3 enemy bullet.
+
+    A 4x6 alien block marches sideways, dropping a row at each edge; the
+    cannon on row 9 moves and fires (one bullet in flight, short
+    cooldown). Shooting an alien pays +1; an enemy bullet or an alien
+    reaching the cannon row ends the episode. A cleared wave respawns.
+    Actions: 0 noop, 1 left, 2 right, 3 fire.
+    """
+
+    _MOVE_EVERY = 4
+    _SHOOT_EVERY = 6               # enemy fire cadence
+    _COOLDOWN = 3
+
+    def __init__(self, env_config: dict | None = None):
+        cfg = env_config or {}
+        self.max_steps = int(cfg.get("max_steps", 400))
+        self.observation_space = Box(0.0, 1.0, (_SIZE, _SIZE, 4))
+        self.action_space = Discrete(4)
+
+    @staticmethod
+    def _fresh_aliens():
+        block = jnp.zeros((_SIZE, _SIZE), jnp.int32)
+        return block.at[0:4, 2:8].set(1)
+
+    def _render(self, s):
+        obs = _blank(4)
+        obs = obs.at[9, s["px"], 0].set(1.0)
+        obs = obs.at[:, :, 1].set(s["aliens"].astype(jnp.float32))
+        obs = jnp.where(
+            s["fb_active"],
+            obs.at[s["fb_y"], s["fb_x"], 2].set(1.0), obs)
+        obs = jnp.where(
+            s["eb_active"],
+            obs.at[s["eb_y"], s["eb_x"], 3].set(1.0), obs)
+        return obs
+
+    def reset(self, key):
+        s = {
+            "px": jnp.asarray(5, jnp.int32),
+            "aliens": self._fresh_aliens(),
+            "adir": jnp.asarray(1, jnp.int32),
+            "move_t": jnp.asarray(self._MOVE_EVERY, jnp.int32),
+            "shoot_t": jnp.asarray(self._SHOOT_EVERY, jnp.int32),
+            "cool": jnp.asarray(0, jnp.int32),
+            "fb_y": jnp.asarray(0, jnp.int32),
+            "fb_x": jnp.asarray(0, jnp.int32),
+            "fb_active": jnp.asarray(False),
+            "eb_y": jnp.asarray(0, jnp.int32),
+            "eb_x": jnp.asarray(0, jnp.int32),
+            "eb_active": jnp.asarray(False),
+            "t": jnp.asarray(0, jnp.int32),
+        }
+        return s, self._render(s)
+
+    def step(self, state, action, key):
+        s = dict(state)
+        action = jnp.asarray(action)
+        k_col, k_reset = jax.random.split(key)
+
+        # -- cannon move + fire
+        px = jnp.clip(s["px"] + (action == 2).astype(jnp.int32)
+                      - (action == 1).astype(jnp.int32), 0, _SIZE - 1)
+        cool = jnp.maximum(s["cool"] - 1, 0)
+        fire = (action == 3) & ~s["fb_active"] & (cool == 0)
+        fb_y = jnp.where(fire, 8, s["fb_y"])
+        fb_x = jnp.where(fire, px, s["fb_x"])
+        fb_active = s["fb_active"] | fire
+        cool = jnp.where(fire, self._COOLDOWN, cool)
+
+        # -- friendly bullet flight + alien kill
+        fb_y = jnp.where(fb_active, fb_y - 1, fb_y)
+        fb_off = fb_y < 0
+        fb_active = fb_active & ~fb_off
+        fb_y = jnp.clip(fb_y, 0, _SIZE - 1)
+        hit = fb_active & (s["aliens"][fb_y, fb_x] == 1)
+        aliens = s["aliens"].at[fb_y, fb_x].set(
+            jnp.where(hit, 0, s["aliens"][fb_y, fb_x]))
+        reward = hit.astype(jnp.float32)
+        fb_active = fb_active & ~hit
+
+        # -- alien march (sideways; drop + reverse at the walls)
+        move_t = s["move_t"] - 1
+        do_move = move_t <= 0
+        move_t = jnp.where(do_move, self._MOVE_EVERY, move_t)
+        cols = jnp.any(aliens == 1, axis=0)
+        idx = jnp.arange(_SIZE)
+        any_alien = jnp.any(cols)
+        left = jnp.min(jnp.where(cols, idx, _SIZE))
+        right = jnp.max(jnp.where(cols, idx, -1))
+        at_edge = jnp.where(s["adir"] > 0, right >= _SIZE - 1, left <= 0)
+        adir = jnp.where(do_move & at_edge & any_alien, -s["adir"],
+                         s["adir"])
+        drop = do_move & at_edge & any_alien
+        shift = do_move & ~at_edge & any_alien
+        aliens = jnp.where(shift, jnp.roll(aliens, adir, axis=1), aliens)
+        aliens = jnp.where(drop, jnp.roll(aliens, 1, axis=0), aliens)
+
+        # -- enemy fire: random alien column shoots from its lowest row
+        shoot_t = s["shoot_t"] - 1
+        do_shoot = (shoot_t <= 0) & ~s["eb_active"] & any_alien
+        shoot_t = jnp.where(shoot_t <= 0, self._SHOOT_EVERY, shoot_t)
+        cols_now = jnp.any(aliens == 1, axis=0)
+        ncols = jnp.maximum(jnp.sum(cols_now), 1)
+        pick = jax.random.randint(k_col, (), 0, ncols)
+        col = jnp.argsort(~cols_now)[pick]       # pick-th active column
+        rows = jnp.arange(_SIZE)
+        low_row = jnp.max(jnp.where(aliens[:, col] == 1, rows, -1))
+        eb_y = jnp.where(do_shoot, jnp.clip(low_row + 1, 0, _SIZE - 1),
+                         s["eb_y"])
+        eb_x = jnp.where(do_shoot, col, s["eb_x"])
+        eb_active = s["eb_active"] | do_shoot
+
+        # -- enemy bullet flight
+        eb_y = jnp.where(eb_active & ~do_shoot, eb_y + 1, eb_y)
+        eb_off = eb_y >= _SIZE
+        eb_y = jnp.clip(eb_y, 0, _SIZE - 1)
+        shot_down = eb_active & ~eb_off & (eb_y == 9) & (eb_x == px)
+        eb_active = eb_active & ~eb_off & ~shot_down
+
+        # -- wave cleared → new, slightly advanced wave
+        cleared = ~jnp.any(aliens == 1)
+        aliens = jnp.where(cleared, self._fresh_aliens(), aliens)
+
+        invaded = jnp.any(aliens[9, :] == 1)
+        t = s["t"] + 1
+        done = shot_down | invaded | (t >= self.max_steps)
+        new = {
+            "px": px, "aliens": aliens, "adir": adir, "move_t": move_t,
+            "shoot_t": shoot_t, "cool": cool,
+            "fb_y": fb_y, "fb_x": fb_x, "fb_active": fb_active,
+            "eb_y": eb_y, "eb_x": eb_x, "eb_active": eb_active, "t": t,
+        }
+        reset_state, reset_obs = self.reset(k_reset)
+        merged = jax.tree.map(
+            lambda r, n: jnp.where(done, r, n), reset_state, new)
+        obs = jnp.where(done, reset_obs, self._render(new))
+        return merged, obs, reward, done, {}
+
+
+register_env("PixelBreakout", lambda cfg: PixelBreakout(cfg))
+register_env("PixelAsterix", lambda cfg: PixelAsterix(cfg))
+register_env("PixelInvaders", lambda cfg: PixelInvaders(cfg))

@@ -48,7 +48,9 @@ class InGraphSampler:
             obs = carry["obs"]
             actions, logp, value = self.module.compute_actions(
                 params, obs, k_act)
-            env_keys = jax.random.split(k_env, self.num_envs)
+            # obs.shape[0], not self.num_envs: under a shard_map'd learner
+            # each shard steps its own num_envs/n slice of the env batch
+            env_keys = jax.random.split(k_env, obs.shape[0])
             state, next_obs, reward, done, _ = jax.vmap(self.env.step)(
                 carry["env_state"], actions, env_keys)
             ep_ret = carry["ep_ret"] + reward

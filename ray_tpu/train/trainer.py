@@ -75,6 +75,10 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = dict(datasets or {})
         self.resume_checkpoint = resume_from_checkpoint
+        # When running as a Tune trial, the controller's gang reservation
+        # is handed down here (bundle 0 = trial executor, 1..N = our
+        # workers) — we fill it instead of creating a second group.
+        self._external_pg = None
 
     # ------------------------------------------------------------------
 
@@ -92,6 +96,12 @@ class JaxTrainer:
     def _create_workers(self, trial_name: str):
         sc = self.scaling
         res = sc.worker_resources()
+        if self._external_pg is not None:
+            workers = make_worker_group(
+                sc.num_workers, res, trial_name,
+                placement_group=self._external_pg, bundle_offset=1,
+                env_vars={})
+            return workers, None        # not ours to remove
         pg = placement_group([dict(res) for _ in range(sc.num_workers)],
                              strategy=sc.placement_strategy)
         workers = make_worker_group(sc.num_workers, res, trial_name,
@@ -131,10 +141,11 @@ class JaxTrainer:
                 ray_tpu.kill(w)
             except Exception:
                 pass
-        try:
-            remove_placement_group(pg)
-        except Exception:
-            pass
+        if pg is not None:
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
 
     def _persist_checkpoint(self, ckpt, storage: str, iteration: int,
                             kept: list):

@@ -192,8 +192,12 @@ class TrainWorker:
 
 
 def make_worker_group(num_workers: int, resources: dict, trial_name: str,
-                      placement_group=None, env_vars: dict | None = None):
-    """Spawn the actor group (one placement-group bundle per worker)."""
+                      placement_group=None, env_vars: dict | None = None,
+                      bundle_offset: int = 0):
+    """Spawn the actor group (one placement-group bundle per worker).
+    `bundle_offset` skips leading bundles when the group is placed inside
+    a larger reservation (a Tune trial's PG, whose bundle 0 is the trial
+    executor)."""
     from ray_tpu.util.scheduling_strategies import (
         PlacementGroupSchedulingStrategy,
     )
@@ -210,7 +214,7 @@ def make_worker_group(num_workers: int, resources: dict, trial_name: str,
         if placement_group is not None:
             o["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                 placement_group=placement_group,
-                placement_group_bundle_index=rank)
+                placement_group_bundle_index=rank + bundle_offset)
         workers.append(cls.options(**o).remote(
             rank, num_workers, trial_name))
     return workers

@@ -27,10 +27,18 @@ EXPERIMENT_STATE_FILE = "experiment_state.json"
 
 class Trial:
     def __init__(self, trial_id: str, config: dict, experiment_dir: str,
-                 resources: Optional[dict] = None):
+                 resources: Optional[dict] = None,
+                 pg_factory: Optional[dict] = None):
         self.trial_id = trial_id
         self.config = dict(config)
         self.resources = dict(resources or {"CPU": 1.0})
+        # Gang-reservation spec: every trial runs inside a placement
+        # group built from these bundles (reference:
+        # tune/execution/placement_groups.py:9 — each trial IS a
+        # PlacementGroupFactory). Bundle 0 hosts the trial executor;
+        # trainer trials append one bundle per training worker.
+        self.pg_factory = dict(pg_factory) if pg_factory else {
+            "bundles": [dict(self.resources)], "strategy": "PACK"}
         self.status = PENDING
         self.last_result: dict = {}
         self.metrics_history: list = []
@@ -43,6 +51,7 @@ class Trial:
         self.checkpoint_path: Optional[str] = None
         # runtime-only fields (not persisted)
         self.actor = None
+        self.pg = None              # live PlacementGroup reservation
         self._pbt_exploit = None
         # remote mirror of this trial's dir (reference: tune/syncer.py);
         # set by the Tuner when storage_path is a URI
@@ -84,6 +93,7 @@ class Trial:
             "trial_id": self.trial_id,
             "config": _jsonable(self.config),
             "resources": self.resources,
+            "pg_factory": self.pg_factory,
             "status": self.status,
             "last_result": _jsonable(self.last_result),
             "error": self.error,
@@ -94,7 +104,7 @@ class Trial:
     @classmethod
     def from_state(cls, state: dict, experiment_dir: str) -> "Trial":
         t = cls(state["trial_id"], state["config"], experiment_dir,
-                state.get("resources"))
+                state.get("resources"), state.get("pg_factory"))
         t.status = state["status"]
         t.last_result = state.get("last_result", {})
         t.error = state.get("error")

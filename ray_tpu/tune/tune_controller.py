@@ -88,8 +88,11 @@ class TuneController:
         self.experiment_dir = experiment_dir
         if max_concurrent is None:
             cpus = ray_tpu.cluster_resources().get("CPU", 1)
-            per_trial = max(t.resources.get("CPU", 1.0)
-                            for t in self.trials) if self.trials else 1.0
+            per_trial = max(
+                (sum(b.get("CPU", 0.0)
+                     for b in (t.pg_factory or {}).get("bundles", []))
+                 or t.resources.get("CPU", 1.0))
+                for t in self.trials) if self.trials else 1.0
             max_concurrent = max(1, int(cpus // max(per_trial, 0.001)))
         self.max_concurrent = max_concurrent
         self._futures: Dict[object, Trial] = {}   # train() future -> trial
@@ -132,12 +135,85 @@ class TuneController:
         return sum(1 for t in self.trials if t.status == RUNNING)
 
     def _launch_pending(self) -> None:
+        blocked: set = set()
         while self._running_count() < self.max_concurrent:
-            pending = [t for t in self.trials if t.status == PENDING]
+            pending = [t for t in self.trials
+                       if t.status == PENDING and id(t) not in blocked]
             trial = self.scheduler.choose_trial_to_run(pending)
             if trial is None:
                 break
+            if not self._reserve_trial(trial):
+                if (not self._futures and self._running_count() == 0
+                        and not self._gang_fits_cluster(trial)):
+                    # The gang exceeds the cluster's TOTAL capacity (not
+                    # merely what's currently free — other workloads may
+                    # release theirs): it can never fit. Fail the trial
+                    # instead of spinning.
+                    trial.status = ERROR
+                    trial.error = ("placement group infeasible: "
+                                   f"{trial.pg_factory}")
+                    for cb in self.callbacks:
+                        _safe(cb, "on_trial_error", trial=trial)
+                    continue
+                # Cluster full: the whole-gang reservation didn't fit.
+                # Leave the trial PENDING and retry after a running trial
+                # frees its group (reference: a trial's PG stays pending
+                # in the scheduler, tune/execution/placement_groups.py).
+                blocked.add(id(trial))
+                continue
             self._start_trial(trial)
+
+    def _gang_fits_cluster(self, trial: Trial) -> bool:
+        """Whether the trial's bundles fit the cluster's total capacity
+        (per resource type, summed over bundles)."""
+        totals = ray_tpu.cluster_resources()
+        need: Dict[str, float] = {}
+        for b in (trial.pg_factory or {}).get("bundles") \
+                or [dict(trial.resources)]:
+            for k, v in b.items():
+                need[k] = need.get(k, 0.0) + float(v)
+        return all(totals.get(k, 0.0) >= v for k, v in need.items())
+
+    def _reserve_trial(self, trial: Trial) -> bool:
+        """Atomically reserve the trial's FULL resource footprint (trial
+        executor + any training workers) as one placement group, so two
+        multi-worker trials can never each grab half their actors and
+        livelock."""
+        if trial.pg is not None:
+            return True
+        from ray_tpu.util.placement_group import placement_group
+        spec = trial.pg_factory or {}
+        bundles = [dict(b) for b in spec.get("bundles")
+                   or [dict(trial.resources)]]
+        try:
+            trial.pg = placement_group(
+                bundles, strategy=spec.get("strategy", "PACK"))
+        except _exc.PlacementGroupError:
+            return False
+        return True
+
+    def _release_trial_pg(self, trial: Trial) -> None:
+        if trial.pg is None:
+            return
+        from ray_tpu.util.placement_group import remove_placement_group
+        try:
+            remove_placement_group(trial.pg)
+        except _exc.RayTpuError:
+            pass
+        trial.pg = None
+
+    def _executor_config(self, trial: Trial, config: dict) -> dict:
+        """Config as the trial executor sees it. Trainer trials place
+        their worker group inside the trial's own reservation (bundles
+        1..N) instead of creating a second group — the gang the
+        controller reserved IS the gang the trainer uses."""
+        config = dict(config)
+        if getattr(self.trainable_cls, "_consumes_trial_pg", False) \
+                and trial.pg is not None:
+            config["_tune_trial_pg"] = {
+                "id": trial.pg.id, "bundles": trial.pg.bundles,
+                "strategy": trial.pg.strategy}
+        return config
 
     def _start_trial(self, trial: Trial) -> None:
         from ray_tpu.tune.search import ConcurrencyLimiter
@@ -153,6 +229,7 @@ class TuneController:
             # exhausted and would TERMINATE every trial).
             cfg = self.searcher.suggest(trial.trial_id)
             if cfg is None:
+                self._release_trial_pg(trial)
                 if isinstance(self.searcher, ConcurrencyLimiter):
                     # at capacity, not exhausted: leave PENDING and retry
                     # on a later scheduling pass
@@ -160,11 +237,11 @@ class TuneController:
                 trial.status = TERMINATED
                 return
             trial.config = dict(cfg)
-        actor_cls = ray_tpu.remote(**_actor_opts(trial.resources))(
-            _TrialExecutor)
+        actor_cls = ray_tpu.remote(
+            **_actor_opts(trial.resources, trial.pg))(_TrialExecutor)
         trial.actor = actor_cls.remote(
-            self.trainable_cls, trial.config, trial.trial_id,
-            trial.local_dir)
+            self.trainable_cls, self._executor_config(trial, trial.config),
+            trial.trial_id, trial.local_dir)
         ckpt = trial.latest_checkpoint()
         if ckpt is not None:
             try:
@@ -265,10 +342,12 @@ class TuneController:
                 ray_tpu.get(trial.actor.stop.remote(), timeout=30)
                 ray_tpu.kill(trial.actor)
                 actor_cls = ray_tpu.remote(
-                    **_actor_opts(trial.resources))(_TrialExecutor)
+                    **_actor_opts(trial.resources, trial.pg))(
+                        _TrialExecutor)
                 trial.actor = actor_cls.remote(
-                    self.trainable_cls, new_config, trial.trial_id,
-                    trial.local_dir)
+                    self.trainable_cls,
+                    self._executor_config(trial, new_config),
+                    trial.trial_id, trial.local_dir)
             ray_tpu.get(trial.actor.restore.remote(ckpt), timeout=120)
             trial.config = dict(new_config)
             logger.info("PBT: trial %s exploited %s", trial.trial_id,
@@ -283,6 +362,9 @@ class TuneController:
         logger.warning("trial %s failed (%d): %s", trial.trial_id,
                        trial.num_failures, err)
         self._kill_actor(trial)
+        # Release the gang so other pending trials can use the capacity
+        # while this one waits to relaunch; _reserve_trial re-reserves.
+        self._release_trial_pg(trial)
         unlimited = self.max_failures < 0
         if unlimited or trial.num_failures <= self.max_failures:
             trial.status = PENDING      # relaunched; restores from ckpt
@@ -301,6 +383,7 @@ class TuneController:
             self.searcher.on_trial_complete(trial.trial_id, result)
         self.scheduler.on_trial_complete(trial, result)
         self._kill_actor(trial)
+        self._release_trial_pg(trial)
         trial.status = status
         for cb in self.callbacks:
             _safe(cb, "on_trial_complete", trial=trial, result=result)
@@ -326,6 +409,7 @@ class TuneController:
         for trial in self.trials:
             if trial.actor is not None:
                 self._kill_actor(trial)
+            self._release_trial_pg(trial)
             if trial.status == RUNNING:
                 # Interrupted (Ctrl-C/driver exit), NOT finished: persist
                 # as PENDING so Tuner.restore resumes it from its latest
@@ -334,7 +418,7 @@ class TuneController:
                 trial.status = PENDING
 
 
-def _actor_opts(resources: dict) -> dict:
+def _actor_opts(resources: dict, pg=None) -> dict:
     opts = {}
     res = dict(resources)
     if "CPU" in res:
@@ -343,6 +427,11 @@ def _actor_opts(resources: dict) -> dict:
         opts["num_tpus"] = res.pop("TPU")
     if res:
         opts["resources"] = res
+    if pg is not None:
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy)
+        opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)
     return opts
 
 

@@ -112,22 +112,45 @@ class Tuner:
     # ------------------------------------------------------------------
 
     def _resolve_trainable(self):
-        """(trainable_cls, default_resources)."""
+        """(trainable_cls, default_resources, pg_factory).
+
+        Every trial is a gang reservation (reference:
+        tune/execution/placement_groups.py:9 — trials schedule through
+        PlacementGroupFactory): bundle 0 is the trial executor, and a
+        trainer trial adds one bundle per training worker so the whole
+        worker group reserves atomically.
+        """
         t = self.trainable
-        resources = dict(getattr(t, "_tune_resources", {"CPU": 1.0}))
+        req = dict(getattr(t, "_tune_resources", {"CPU": 1.0}))
+        if "bundles" in req:
+            # with_resources(..., {"bundles": [...], "strategy": ...})
+            bundles = [dict(b) for b in req["bundles"]]
+            pg_factory = {"bundles": bundles,
+                          "strategy": req.get("strategy", "PACK")}
+            resources = dict(bundles[0])
+        else:
+            resources = req
+            pg_factory = {"bundles": [dict(req)], "strategy": "PACK"}
         # JaxTrainer instance → function trainable that runs trainer.fit()
         # inside the trial with the sampled config merged in.
         from ray_tpu.train.trainer import JaxTrainer
         if isinstance(t, JaxTrainer):
-            return _trainer_as_trainable(t), resources
+            sc = t.scaling
+            pg_factory = {
+                "bundles": [dict(resources)] + [
+                    dict(sc.worker_resources())
+                    for _ in range(sc.num_workers)],
+                "strategy": sc.placement_strategy,
+            }
+            return _trainer_as_trainable(t), resources, pg_factory
         if inspect.isclass(t) and issubclass(t, Trainable):
-            return t, resources
+            return t, resources, pg_factory
         if callable(t):
-            return wrap_function(t), resources
+            return wrap_function(t), resources, pg_factory
         raise TypeError(f"cannot tune {t!r}")
 
-    def _make_trials(self, experiment_dir: str,
-                     resources: dict) -> List[Trial]:
+    def _make_trials(self, experiment_dir: str, resources: dict,
+                     pg_factory: Optional[dict] = None) -> List[Trial]:
         tc = self.tune_config
         if tc.search_alg is not None:
             # Trials are generated upfront; a ConcurrencyLimiter caps
@@ -144,7 +167,7 @@ class Tuner:
                 # (tune_controller._start_trial), so later suggestions see
                 # earlier results instead of being one upfront batch
                 return [Trial(new_trial_id(), {}, experiment_dir,
-                              resources)
+                              resources, pg_factory)
                         for _ in range(tc.num_samples)]
             trials = []
             tid = new_trial_id()
@@ -153,11 +176,13 @@ class Tuner:
                 cfg = searcher.suggest(tid)
                 if cfg is None:
                     break
-                trials.append(Trial(tid, cfg, experiment_dir, resources))
+                trials.append(Trial(tid, cfg, experiment_dir, resources,
+                                    pg_factory))
                 tid = new_trial_id()
             return trials
         return [
-            Trial(new_trial_id(), cfg, experiment_dir, resources)
+            Trial(new_trial_id(), cfg, experiment_dir, resources,
+                  pg_factory)
             for cfg in generate_variants(self.param_space, tc.num_samples,
                                          tc.seed)
         ]
@@ -165,7 +190,7 @@ class Tuner:
     def fit(self) -> ResultGrid:
         from ray_tpu.util import storage as storage_mod
         tc = self.tune_config
-        trainable_cls, resources = self._resolve_trainable()
+        trainable_cls, resources, pg_factory = self._resolve_trainable()
         sync_uri = None
         if self._restore_path:
             if storage_mod.is_uri(self._restore_path):
@@ -185,7 +210,8 @@ class Tuner:
             else:
                 experiment_dir = resolved
             os.makedirs(experiment_dir, exist_ok=True)
-            trials = self._make_trials(experiment_dir, resources)
+            trials = self._make_trials(experiment_dir, resources,
+                                       pg_factory)
         if not trials:
             raise ValueError("search space produced no trials")
         if sync_uri:
@@ -222,18 +248,28 @@ class Tuner:
 def _trainer_as_trainable(trainer) -> type:
     """Each trial runs a full JaxTrainer.fit with the trial config merged
     into train_loop_config; worker actors are created from inside the
-    trial actor (nested actors, like the reference's trial→WorkerGroup)."""
+    trial actor (nested actors, like the reference's trial→WorkerGroup)
+    but placed into the TRIAL's placement group (bundles 1..N), so the
+    gang the controller reserved is the gang the trainer fills."""
     import copy
 
     def run_trainer(config: dict):
         from ray_tpu.tune.trainable import report
+        config = dict(config)
+        pg_spec = config.pop("_tune_trial_pg", None)
         t = copy.copy(trainer)
         t.config = {**trainer.config, **config}
+        if pg_spec is not None:
+            from ray_tpu.util.placement_group import PlacementGroup
+            t._external_pg = PlacementGroup(
+                pg_spec["id"], pg_spec["bundles"], pg_spec["strategy"])
         result = t.fit()
         final = dict(result.metrics)
         report(final, checkpoint=result.checkpoint)
 
-    return wrap_function(run_trainer)
+    cls = wrap_function(run_trainer)
+    cls._consumes_trial_pg = True
+    return cls
 
 
 def run(trainable, *, config: Optional[dict] = None, num_samples: int = 1,

@@ -235,6 +235,85 @@ def test_tuner_over_jax_trainer(ray_session, tmp_path):
     assert best.metrics["loss"] == pytest.approx(0.1)
 
 
+def test_trial_gang_reservation_serializes(ray_session, tmp_path):
+    """Two multi-worker trainer trials on a just-big-enough cluster must
+    SERIALIZE through whole-gang placement-group reservations instead of
+    each grabbing part of its worker group (reference:
+    tune/execution/placement_groups.py:9 — every trial reserves through a
+    PlacementGroupFactory). On a 4-CPU cluster, each trial needs
+    1 (executor) + 2 (workers) = 3 CPUs, so the second trial's gang only
+    fits after the first finishes; without atomic reservation the second
+    trainer's inner placement_group() call would fail and error the
+    trial."""
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    events = []
+
+    class _Recorder:
+        def on_trial_start(self, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_complete(self, trial, result):
+            events.append(("complete", trial.trial_id))
+
+    cpus_before = ray_tpu.available_resources()["CPU"]
+    trainer = JaxTrainer(
+        _tiny_train_loop,
+        train_loop_config={"lr": 0.0},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="inner", storage_path=str(tmp_path)))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="gang", storage_path=str(tmp_path),
+                             callbacks=[_Recorder()])).fit()
+    assert len(grid) == 2
+    assert not grid.errors, grid.errors
+    # serialized: first trial completed before the second started
+    assert [e[0] for e in events] == [
+        "start", "complete", "start", "complete"], events
+    # no leaked reservations
+    assert ray_tpu.available_resources()["CPU"] == cpus_before
+    from ray_tpu.util.state import list_placement_groups
+    assert list_placement_groups() == []
+
+
+def test_trial_pg_reserved_and_released(ray_session, tmp_path):
+    """Every trial (even a plain function trainable) runs inside its own
+    placement-group reservation, released at trial end."""
+    import ray_tpu
+
+    def probe(config):
+        from ray_tpu.tune.trainable import report
+        report({"score": 1.0})
+
+    cpus_before = ray_tpu.available_resources()["CPU"]
+    grid = tune.run(probe, config={}, num_samples=2, metric="score",
+                    mode="max", storage_path=str(tmp_path), name="pgres")
+    assert len(grid) == 2 and not grid.errors
+    assert ray_tpu.available_resources()["CPU"] == cpus_before
+    from ray_tpu.util.state import list_placement_groups
+    assert list_placement_groups() == []
+
+
+def test_infeasible_gang_errors_instead_of_hanging(ray_session, tmp_path):
+    """A trial whose gang can never fit the cluster fails fast with a
+    placement error instead of spinning the controller forever."""
+    from ray_tpu.tune.trainable import with_resources
+
+    def probe(config):
+        from ray_tpu.tune.trainable import report
+        report({"score": 1.0})
+
+    big = with_resources(probe, {"bundles": [{"CPU": 1000}],
+                                 "strategy": "PACK"})
+    grid = tune.run(big, config={}, num_samples=1, metric="score",
+                    mode="max", storage_path=str(tmp_path), name="infeas")
+    assert grid.errors and "placement group infeasible" in grid.errors[0]
+
+
 def test_concurrency_limiter_runs_all_samples(ray_session, tmp_path):
     """A ConcurrencyLimiter caps parallelism, not the trial count."""
     from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
