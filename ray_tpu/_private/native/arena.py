@@ -153,6 +153,36 @@ class Arena:
             return None
         return self._view[off:off + size.value].toreadonly()
 
+    def acquire_mapped(self, object_id: str):
+        """Pin + zero-copy view over a DEDICATED per-object mmap.
+
+        Buffer exports from deserialized consumers (numpy arrays etc.)
+        land on the underlying exporter object. With the shared arena
+        map, that exporter is one mmap for every object, so nothing can
+        tell whose bytes are still borrowed; with a per-object mmap,
+        `mmap.close()` raising BufferError is a precise
+        "still-borrowed" probe, which the store's free path uses to keep
+        the pin (condemning the block) instead of letting the allocator
+        reuse bytes underneath live zero-copy arrays.
+
+        Returns (mmap, view) or (None, None).
+        """
+        size = ctypes.c_uint64()
+        off = self._lib.rts_acquire(self._h, object_id.encode(),
+                                    ctypes.byref(size))
+        if off == 0:
+            return None, None
+        page = mmap.ALLOCATIONGRANULARITY
+        base = (off // page) * page
+        delta = off - base
+        fd = os.open(self._path, os.O_RDONLY)
+        try:
+            m = mmap.mmap(fd, delta + size.value, offset=base,
+                          access=mmap.ACCESS_READ)
+        finally:
+            os.close(fd)
+        return m, memoryview(m)[delta:delta + size.value]
+
     def poisoned(self) -> bool:
         return self._lib.rts_poisoned(self._h) == 1
 

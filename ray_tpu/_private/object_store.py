@@ -94,7 +94,18 @@ class ObjectStore:
         from ray_tpu._private.native.arena import Arena
         self._arena = Arena.open(session_dir)
         # object_id -> pinned arena view held until delete() or close()
+        # (shared-map views: raw_bytes/forwarding, which copy immediately)
         self._views: dict[str, memoryview] = {}
+        # object_id -> (per-object mmap, view) handed to zero-copy
+        # deserialization; the mmap is the buffer exporter, so close()
+        # raising BufferError detects live borrowers at free time
+        self._mviews: dict[str, tuple] = {}
+        # object_id -> mmaps still borrowed when the object was freed;
+        # one arena pin is held per entry (block condemned) until a
+        # later sweep finds the borrowers gone. A list because an
+        # object id can be reused and condemned again before the first
+        # incarnation's borrowers die.
+        self._condemned: dict[str, list] = {}
         # ids this process put (and therefore owner-pinned)
         self._owned: set[str] = set()
 
@@ -225,7 +236,8 @@ class ObjectStore:
         if desc.inline is not None:
             return serialization.loads(desc.inline)
         if desc.arena:
-            view = self._arena_view(desc)
+            view = self._arena_read_view(desc)
+            self._sweep_condemned()
             return serialization.loads(view)
         if desc.path is not None and "://" in desc.path:
             from ray_tpu.util import storage as _storage
@@ -250,10 +262,9 @@ class ObjectStore:
         return serialization.loads(m)
 
     def _arena_view(self, desc: Descriptor) -> memoryview:
-        """Pinned read view. The pin (acquire) is taken once per process per
-        object and held for the process lifetime, so deserialized zero-copy
-        arrays can never be freed/reused underneath a live reference —
-        the analog of a plasma client holding the buffer until Release."""
+        """Pinned read view over the SHARED arena map — for callers that
+        copy immediately (raw_bytes/forwarding). Zero-copy
+        deserialization goes through _arena_read_view instead."""
         if self._arena is None:
             raise ObjectLostError(
                 f"object {desc.object_id} is arena-backed but this process "
@@ -268,6 +279,49 @@ class ObjectStore:
                         "(evicted or deleted)")
                 self._views[desc.object_id] = view
         return view[:desc.size]
+
+    def _arena_read_view(self, desc: Descriptor) -> memoryview:
+        """Pinned read view over a PER-OBJECT mmap, handed to zero-copy
+        deserialization. Buffer exports from the deserialized arrays
+        land on this object's own mmap, so the free path can probe
+        "still borrowed?" precisely (mmap.close() raises BufferError) —
+        the analog of plasma clients holding the buffer until Release,
+        but with reclamation the moment the last borrower dies."""
+        if self._arena is None:
+            raise ObjectLostError(
+                f"object {desc.object_id} is arena-backed but this process "
+                "has no native arena (RAY_TPU_DISABLE_NATIVE mismatch?)")
+        with self._lock:
+            cached = self._mviews.get(desc.object_id)
+            if cached is None:
+                m, view = self._arena.acquire_mapped(desc.object_id)
+                if view is None:
+                    raise ObjectLostError(
+                        f"object {desc.object_id} missing from arena "
+                        "(evicted or deleted)")
+                cached = (m, view)
+                self._mviews[desc.object_id] = cached
+        return cached[1][:desc.size]
+
+    def _sweep_condemned(self) -> None:
+        """Free condemned blocks whose borrowers have since died."""
+        if not self._condemned:
+            return
+        with self._lock:
+            items = [(oid, m) for oid, ms in self._condemned.items()
+                     for m in list(ms)]
+        for oid, m in items:
+            try:
+                m.close()
+            except BufferError:
+                continue        # still borrowed
+            with self._lock:
+                ms = self._condemned.get(oid)
+                if ms and m in ms:
+                    ms.remove(m)
+                    if not ms:
+                        del self._condemned[oid]
+                    self._arena.pin(oid, -1)
 
     def raw_bytes(self, desc: Descriptor) -> bytes:
         """The serialized envelope (for forwarding across nodes)."""
@@ -290,7 +344,11 @@ class ObjectStore:
         if desc.inline is not None:
             return desc.inline
         if desc.arena:
-            return self._arena_view(desc)
+            # per-object mmap view: slices handed to the pull plane
+            # export from that mmap, so delete()'s borrow probe covers
+            # an in-flight chunked send (the shared view can't — slice
+            # exports are invisible to memoryview.release())
+            return self._arena_read_view(desc)
         if "://" in desc.path:
             from ray_tpu.util import storage as _storage
             return _storage.read_bytes(desc.path)
@@ -343,22 +401,37 @@ class ObjectStore:
                 oid = desc.object_id
                 with self._lock:
                     view = self._views.pop(oid, None)
+                    mview = self._mviews.pop(oid, None)
                     owned = oid in self._owned
                     self._owned.discard(oid)
                 # drop THIS process's pins only (owner pin from put, reader
-                # pin from get) — never another process's reader pin — then
-                # delete: frees now if unpinned, else condemns until the
-                # last remaining reader releases
+                # pins from get) — never another process's reader pin —
+                # then delete: frees now if unpinned, else condemns until
+                # the last remaining reader releases
                 if view is not None:
+                    # shared-map view: consumers copied, safe to release
+                    view.release()
+                    self._arena.pin(oid, -1)
+                if mview is not None:
+                    m, v = mview
                     try:
-                        view.release()
+                        v.release()
                     except BufferError:
-                        pass  # a live numpy view borrows it; pin stays held
+                        pass
+                    try:
+                        # the per-object mmap is the exporter for every
+                        # zero-copy array deserialized from this object:
+                        # close() raises while any borrower is alive
+                        m.close()
+                    except BufferError:
+                        with self._lock:
+                            self._condemned.setdefault(oid, []).append(m)
                     else:
                         self._arena.pin(oid, -1)
                 if owned:
                     self._arena.pin(oid, -1)
                 self._arena.delete(oid)
+                self._sweep_condemned()
             return
         with self._lock:
             m = self._maps.pop(desc.object_id, None)
@@ -388,10 +461,26 @@ class ObjectStore:
         if self._arena is not None:
             with self._lock:
                 views, self._views = self._views, {}
+                mviews, self._mviews = self._mviews, {}
+                condemned, self._condemned = self._condemned, {}
             for v in views.values():
                 try:
                     v.release()
                 except BufferError:
                     pass
+            for m, v in mviews.values():
+                for h in (v, m):
+                    try:
+                        h.release() if isinstance(h, memoryview) \
+                            else h.close()
+                    except BufferError:
+                        pass  # borrower outlives the session; mmap dies
+                              # with the process
+            for ms in condemned.values():
+                for m in ms:
+                    try:
+                        m.close()
+                    except BufferError:
+                        pass
             self._arena.close()
             self._arena = None

@@ -62,6 +62,13 @@ class _EpsilonPolicy:
         # max-Q plays the value role (only TD training consumes it here)
         return actions, q_sel, q.max(axis=-1)
 
+    def forward(self, params, obs):
+        """Bootstrap seam for the vectorized runner (InGraphSampler
+        calls module.forward for the fragment-end value): max-Q plays
+        the state value."""
+        q = self._module.q_values(params, obs)
+        return None, q.max(axis=-1)
+
 
 class ApexDQN(DQN):
     _config_class = ApexDQNConfig
@@ -138,7 +145,8 @@ class ApexDQN(DQN):
             max(1, cfg.num_rollout_workers), env_creator,
             module_creator, cfg.rollout_fragment_length, seed=cfg.seed,
             num_cpus_per_worker=cfg.num_cpus_per_worker,
-            connectors=cfg.connector_dict())
+            connectors=cfg.connector_dict(),
+            num_envs_per_worker=cfg.num_envs_per_worker)
 
     def cleanup(self) -> None:
         import ray_tpu as _rt
@@ -163,10 +171,17 @@ class ApexDQN(DQN):
         if self._pending_adds:
             _rt.get(self._pending_adds, timeout=300)
         self._pending_adds = []
+        t_dim = np.asarray(batches[0][sb.REWARDS]).ndim if batches else 1
         for batch in batches:
-            flat = {k: np.asarray(batch[k])
-                    for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
-                              sb.NEXT_OBS)}
+            # vectorized workers return time-major [T, B, ...]; replay
+            # ingests flat 1-step transitions
+            flat = {}
+            for k in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                      sb.NEXT_OBS):
+                v = np.asarray(batch[k])
+                if t_dim == 2:
+                    v = v.reshape((-1,) + v.shape[2:])
+                flat[k] = v
             shard = self.replay_shards[self._add_rr % n_shards]
             self._add_rr += 1
             self._pending_adds.append(shard.add_batch.remote(flat))

@@ -31,40 +31,35 @@ class APPOConfig(IMPALAConfig):
 class APPO(IMPALA):
     _config_class = APPOConfig
 
-    def _vtrace_update(self, params, opt_state, batch, last_value):
+    def _vtrace_loss(self, params, batch, last_value):
+        """PPO's clipped surrogate on V-trace advantages; the behaviour
+        policy's logp is the "old" policy (appo_torch_policy.py). Plugs
+        into IMPALA's shared whole-batch/minibatched update loop."""
         cfg = self.algo_config
-
-        def loss_fn(p):
-            dist, values = self.module.forward(p, batch[sb.OBS])
-            target_logp = dist.logp(batch[sb.ACTIONS])
-            vs, pg_adv = vtrace(
-                batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
-                values, batch[sb.DONES], last_value, cfg.gamma,
-                cfg.lambda_, cfg.vtrace_clip_rho_threshold,
-                cfg.vtrace_clip_pg_rho_threshold)
-            # PPO surrogate on V-trace advantages; the behaviour policy's
-            # logp is the "old" policy (appo_torch_policy.py)
-            ratio = jnp.exp(target_logp - batch[sb.ACTION_LOGP])
-            surr = jnp.minimum(
-                ratio * pg_adv,
-                jnp.clip(ratio, 1 - cfg.clip_param,
-                         1 + cfg.clip_param) * pg_adv)
-            pg_loss = -jnp.mean(surr)
-            vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
-            entropy = jnp.mean(dist.entropy())
-            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            if cfg.use_kl_loss:
-                approx_kl = jnp.mean(batch[sb.ACTION_LOGP] - target_logp)
-                total = total + cfg.kl_coeff * approx_kl
-            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
-
-        (_, stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        updates, opt_state = self.optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, stats
+        dist, values = self.module.forward(params, batch[sb.OBS])
+        target_logp = dist.logp(batch[sb.ACTIONS])
+        vs, pg_adv = vtrace(
+            batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
+            values, batch[sb.DONES], last_value, cfg.gamma,
+            cfg.lambda_, cfg.vtrace_clip_rho_threshold,
+            cfg.vtrace_clip_pg_rho_threshold)
+        if cfg.standardize_advantages:
+            pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        ratio = jnp.exp(target_logp - batch[sb.ACTION_LOGP])
+        surr = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1 - cfg.clip_param,
+                     1 + cfg.clip_param) * pg_adv)
+        pg_loss = -jnp.mean(surr)
+        vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
+        entropy = jnp.mean(dist.entropy())
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        if cfg.use_kl_loss:
+            approx_kl = jnp.mean(batch[sb.ACTION_LOGP] - target_logp)
+            total = total + cfg.kl_coeff * approx_kl
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
 
 
 register_algorithm("APPO", APPO)

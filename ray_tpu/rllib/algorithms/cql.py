@@ -21,7 +21,7 @@ from ray_tpu.rllib.algorithms.algorithm import register_algorithm
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, _sample_squashed
 from ray_tpu.rllib.env.jax_env import make_env
 from ray_tpu.rllib.env.spaces import Box
-from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.offline import resolve_input
 from ray_tpu.rllib.replay_buffers import ReplayBuffer
 
 
@@ -56,7 +56,7 @@ class CQL(SAC):
         self.build_learner()
         # fill the buffer once from the offline shards; actions in the
         # dataset are env-scaled — map back to the actor's tanh range
-        data = JsonReader(cfg.input_).read_all()
+        data = resolve_input(cfg.input_).read_all()
         n = len(data[sb.REWARDS])
         if n > cfg.buffer_size:
             # never silently truncate the dataset to the ring size

@@ -206,7 +206,10 @@ class DQN(Algorithm):
     def _ingest_external(self) -> None:
         """Pull one batch from the external input seam (policy server /
         offline reader / callable) into the replay buffer."""
-        src = self.algo_config.input_
+        if not hasattr(self, "_input_src"):
+            from ray_tpu.rllib.offline import resolve_input
+            self._input_src = resolve_input(self.algo_config.input_)
+        src = self._input_src
         batch = src() if callable(src) else src.next()
         flat = {k: np.asarray(v) for k, v in batch.items()}
         self.buffer.add_batch(flat)

@@ -148,7 +148,8 @@ class DT(Algorithm):
     # -- offline ingestion -------------------------------------------------
 
     def _drain_input(self):
-        src = self.algo_config.input_
+        from ray_tpu.rllib.offline import resolve_input
+        src = resolve_input(self.algo_config.input_)
         if callable(src):
             batches = []
             out = src()

@@ -41,12 +41,29 @@ class IMPALAConfig(AlgorithmConfig):
         self.batches_per_step = 4       # learner batches per train() call
         self.broadcast_interval = 1     # resubmit with fresh weights every
         self.grad_clip = 40.0
+        # SGD passes over each learner batch (reference: impala.py
+        # num_sgd_iter + minibatch_buffer_size — the learner thread
+        # replays a batch several times; V-trace's rho/c clipping absorbs
+        # the growing policy lag)
+        self.num_sgd_iter = 1
+        # shuffled minibatches per pass (None = whole batch; reference:
+        # impala.py minibatch_size)
+        self.sgd_minibatch_size = None
+        # optimizer family (reference: impala.py opt_type "adam"/"rmsprop")
+        self.opt_type = "rmsprop"
+        # standardize V-trace pg advantages per batch before the policy
+        # loss — an extension borrowed from PPO's postprocessing
+        # (reference: ppo.py standardize_fields); OFF by default to match
+        # reference IMPALA, but the make-or-break stabilizer for sparse-
+        # reward pixel tasks at small batch sizes
+        self.standardize_advantages = False
 
 
 def vtrace(behaviour_logp, target_logp, rewards, values, dones,
            last_value, gamma, lambda_, clip_rho, clip_pg_rho):
-    """V-trace targets over a [T] fragment (Espeholt et al. 2018, eqns
-    1-2). All inputs time-major; returns (vs, pg_advantages)."""
+    """V-trace targets over a [T] or [T, B] fragment batch (Espeholt et
+    al. 2018, eqns 1-2). All inputs time-major; `last_value` matches the
+    trailing batch shape. Returns (vs, pg_advantages)."""
     rhos = jnp.exp(target_logp - behaviour_logp)
     clipped_rhos = jnp.minimum(clip_rho, rhos)
     cs = lambda_ * jnp.minimum(1.0, rhos)
@@ -60,7 +77,7 @@ def vtrace(behaviour_logp, target_logp, rewards, values, dones,
         acc = delta + gamma * nt * c * acc
         return acc, acc
 
-    _, vs_minus_v = jax.lax.scan(back, jnp.zeros(()),
+    _, vs_minus_v = jax.lax.scan(back, jnp.zeros_like(last_value),
                                  (deltas, cs, nonterm), reverse=True)
     vs = vs_minus_v + values
     next_vs = jnp.concatenate([vs[1:], last_value[None]])
@@ -77,7 +94,10 @@ class IMPALA(Algorithm):
         chain = []
         if cfg.grad_clip:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))
-        chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        if getattr(cfg, "opt_type", "rmsprop") == "adam":
+            chain.append(optax.adam(cfg.lr))
+        else:
+            chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
         self.optimizer = optax.chain(*chain)
         self.opt_state = self.optimizer.init(self.params)
 
@@ -96,36 +116,83 @@ class IMPALA(Algorithm):
             max(1, cfg.num_rollout_workers), env_creator, module_creator,
             cfg.rollout_fragment_length, seed=cfg.seed,
             num_cpus_per_worker=cfg.num_cpus_per_worker,
-            connectors=cfg.connector_dict())
+            connectors=cfg.connector_dict(),
+            num_envs_per_worker=cfg.num_envs_per_worker)
         self._update_fn = jax.jit(self._vtrace_update)
         # async pipeline: one in-flight sample per worker
         self._inflight: dict = {}
         self._steps_trained = 0
 
-    def _vtrace_update(self, params, opt_state, batch, last_value):
+    def _vtrace_loss(self, params, batch, last_value):
+        """(loss, stats) for one fragment (mini)batch. Shared by the
+        whole-batch and minibatched passes; APPO overrides this with the
+        clipped surrogate."""
+        cfg = self.algo_config
+        dist, values = self.module.forward(params, batch[sb.OBS])
+        target_logp = dist.logp(batch[sb.ACTIONS])
+        vs, pg_adv = vtrace(
+            batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
+            values, batch[sb.DONES], last_value, cfg.gamma,
+            cfg.lambda_, cfg.vtrace_clip_rho_threshold,
+            cfg.vtrace_clip_pg_rho_threshold)
+        if cfg.standardize_advantages:
+            pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
+        entropy = jnp.mean(dist.entropy())
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    def _vtrace_update(self, params, opt_state, batch, last_value, key):
+        """num_sgd_iter epochs over the batch; when sgd_minibatch_size is
+        set and fragments are [T, B], each epoch is a shuffled scan over
+        env-column minibatches (fragments stay whole so V-trace sees full
+        sequences — reference: impala.py num_sgd_iter/minibatch_size)."""
         cfg = self.algo_config
 
-        def loss_fn(p):
-            dist, values = self.module.forward(p, batch[sb.OBS])
-            target_logp = dist.logp(batch[sb.ACTIONS])
-            vs, pg_adv = vtrace(
-                batch[sb.ACTION_LOGP], target_logp, batch[sb.REWARDS],
-                values, batch[sb.DONES], last_value, cfg.gamma,
-                cfg.lambda_, cfg.vtrace_clip_rho_threshold,
-                cfg.vtrace_clip_pg_rho_threshold)
-            pg_loss = -jnp.mean(target_logp * pg_adv)
-            vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
-            entropy = jnp.mean(dist.entropy())
-            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
+        def sgd_step(state, mb):
+            params, opt_state = state
+            b, lv = mb
+            (_, stats), grads = jax.value_and_grad(
+                self._vtrace_loss, has_aux=True)(params, b, lv)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), stats
 
-        (_, stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        updates, opt_state = self.optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, stats
+        t_b = batch[sb.REWARDS].ndim
+        mb_size = cfg.sgd_minibatch_size
+        if t_b == 2 and mb_size:
+            T, B = batch[sb.REWARDS].shape
+            cols = min(B, max(1, int(mb_size) // T))
+            num_mb = max(1, B // cols)
+
+            def one_epoch(state, ekey):
+                perm = jax.random.permutation(ekey, B)[:num_mb * cols]
+
+                def shuf(v):
+                    v = v[:, perm]
+                    v = v.reshape(v.shape[0], num_mb, cols, *v.shape[2:])
+                    return jnp.moveaxis(v, 1, 0)   # [num_mb, T, cols, ..]
+
+                mbs = jax.tree.map(shuf, dict(batch))
+                lvs = last_value[perm].reshape(num_mb, cols)
+                state, stats = jax.lax.scan(sgd_step, state, (mbs, lvs))
+                return state, jax.tree.map(jnp.mean, stats)
+
+            epoch_keys = jax.random.split(key, max(1, cfg.num_sgd_iter))
+            (params, opt_state), stats = jax.lax.scan(
+                one_epoch, (params, opt_state), epoch_keys)
+        else:
+            def one_pass(state, _):
+                return sgd_step(state, (batch, last_value))
+
+            (params, opt_state), stats = jax.lax.scan(
+                one_pass, (params, opt_state), None,
+                length=max(1, cfg.num_sgd_iter))
+        return params, opt_state, jax.tree.map(jnp.mean, stats)
 
     def _submit(self, idx: int) -> None:
         from ray_tpu.rllib.worker_set import _to_host
@@ -158,11 +225,12 @@ class IMPALA(Algorithm):
             device = {k: jnp.asarray(v) for k, v in batch.items()}
             self.params, self.opt_state, stats = self._update_fn(
                 self.params, self.opt_state, device,
-                jnp.asarray(last_v))
+                jnp.asarray(last_v), self.next_key())
             learn_stats.append(stats)
             stats_list.append(ep_stats)
             consumed += 1
-            self._steps_trained += len(batch)
+            # rewards count env steps for both [T] and [T, B] fragments
+            self._steps_trained += int(np.asarray(batch[sb.REWARDS]).size)
 
         metrics = merge_episode_stats(stats_list) if stats_list else {
             "episode_reward_mean": float("nan"), "episodes_this_iter": 0}

@@ -21,7 +21,7 @@ from ray_tpu.rllib.algorithms.algorithm import (
     Algorithm, AlgorithmConfig, register_algorithm)
 from ray_tpu.rllib.core.rl_module import RLModule
 from ray_tpu.rllib.env.jax_env import make_env
-from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.offline import resolve_input
 
 
 class MARWILConfig(AlgorithmConfig):
@@ -56,7 +56,7 @@ class MARWIL(Algorithm):
                                self.env.action_space, cfg.model)
         self._rng = jax.random.PRNGKey(cfg.seed)
         self.params = self.module.init(self.next_key())
-        self.reader = JsonReader(cfg.input_)
+        self.reader = resolve_input(cfg.input_)
         self._data = self._postprocess(self.reader.read_all())
         self.build_learner()
 

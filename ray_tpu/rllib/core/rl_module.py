@@ -41,9 +41,33 @@ class _MLPTorso(nn.Module):
 DEFAULT_CONV_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
 
 
+def _patches(x, k: int, s: int):
+    """SAME-padded kxk/stride-s patch extraction:
+    [..., H, W, C] -> [..., Ho, Wo, k*k*C]. Written as pad + strided
+    slices so the conv below becomes an explicit patch-matmul."""
+    H, W = x.shape[-3], x.shape[-2]
+    ho, wo = -(-H // s), -(-W // s)
+    ph = max((ho - 1) * s + k - H, 0)
+    pw = max((wo - 1) * s + k - W, 0)
+    pad = [(0, 0)] * (x.ndim - 3) + [
+        (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0)]
+    x = jnp.pad(x, pad)
+    cols = []
+    for di in range(k):
+        for dj in range(k):
+            cols.append(x[..., di:di + (ho - 1) * s + 1:s,
+                          dj:dj + (wo - 1) * s + 1:s, :])
+    return jnp.concatenate(cols, axis=-1)
+
+
 class _ConvTorso(nn.Module):
-    """NHWC conv encoder -> flat features. Channel counts are multiples
-    of 8/16 so the MXU tiles convs cleanly (conv = implicit matmul)."""
+    """NHWC conv encoder -> flat features, with each conv written as
+    patch-extraction + matmul (a Dense over k*k*C patch columns). That
+    is exactly how the MXU executes convs (implicit GEMM), so the
+    compiled TPU program is identical-or-better than lax.conv — and the
+    backward pass is matmul gradients, which avoids XLA:CPU's slow
+    conv-transpose fallback on the CI/dev path. Channel counts are
+    multiples of 8/16 so the MXU tiles the GEMMs cleanly."""
     filters: Tuple = DEFAULT_CONV_FILTERS
     hiddens: Tuple[int, ...] = (256,)
     activation: str = "relu"
@@ -51,9 +75,9 @@ class _ConvTorso(nn.Module):
     @nn.compact
     def __call__(self, x):
         act = _ACTIVATIONS[self.activation]
-        for out, kernel, stride in self.filters:
-            x = act(nn.Conv(out, (kernel, kernel),
-                            strides=(stride, stride), padding="SAME")(x))
+        for i, (out, kernel, stride) in enumerate(self.filters):
+            x = act(nn.Dense(out, name=f"Conv_{i}")(
+                _patches(x, int(kernel), int(stride))))
         x = x.reshape(*x.shape[:-3], -1)
         for h in self.hiddens:
             x = act(nn.Dense(h)(x))

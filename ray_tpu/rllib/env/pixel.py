@@ -146,13 +146,15 @@ class PixelAsterix(JaxEnv):
     """
 
     _ROWS = 8                      # entity rows 1..8
-    _SPAWN_EVERY = 3
-    _MOVE_EVERY = 2
-    _GOLD_P = 0.4
 
     def __init__(self, env_config: dict | None = None):
         cfg = env_config or {}
         self.max_steps = int(cfg.get("max_steps", 300))
+        # difficulty knobs (tuned-example yamls pick easier settings for
+        # wall-clock-bounded oracles, like the reference's env_config)
+        self._SPAWN_EVERY = int(cfg.get("spawn_every", 3))
+        self._MOVE_EVERY = int(cfg.get("move_every", 2))
+        self._GOLD_P = float(cfg.get("gold_p", 0.4))
         self.observation_space = Box(0.0, 1.0, (_SIZE, _SIZE, 4))
         self.action_space = Discrete(5)
 
@@ -271,13 +273,12 @@ class PixelInvaders(JaxEnv):
     Actions: 0 noop, 1 left, 2 right, 3 fire.
     """
 
-    _MOVE_EVERY = 4
-    _SHOOT_EVERY = 6               # enemy fire cadence
-    _COOLDOWN = 3
-
     def __init__(self, env_config: dict | None = None):
         cfg = env_config or {}
         self.max_steps = int(cfg.get("max_steps", 400))
+        self._MOVE_EVERY = int(cfg.get("move_every", 4))
+        self._SHOOT_EVERY = int(cfg.get("shoot_every", 6))
+        self._COOLDOWN = int(cfg.get("cooldown", 3))
         self.observation_space = Box(0.0, 1.0, (_SIZE, _SIZE, 4))
         self.action_space = Discrete(4)
 

@@ -2,10 +2,14 @@
 
 Counterpart of the reference's `rllib/offline/`: `json_writer.py` /
 `json_reader.py` (SampleBatches as JSONL shards), `InputReader` iteration,
-and the off-policy estimators `offline/estimators/` (ImportanceSampling,
-WeightedImportanceSampling — IS/WIS per Precup 2000). Batches are stored
-row-compressed as JSON with base64 numpy columns, one batch per line, so
-shards stream without loading everything.
+`dataset_reader.py` (offline data through the Data library), and the
+off-policy estimators `offline/estimators/` — ImportanceSampling,
+WeightedImportanceSampling (IS/WIS per Precup 2000), DirectMethod and
+DoublyRobust (Jiang & Li 2016) backed by Fitted Q Evaluation (Le et al.
+2019; reference: `offline/estimators/fqe_torch_model.py`, here a jitted
+flax/optax loop). Batches are stored row-compressed as JSON with base64
+numpy columns, one batch per line, so shards stream without loading
+everything.
 """
 
 from __future__ import annotations
@@ -108,6 +112,51 @@ class JsonReader:
         return concat_samples(out)
 
 
+class DatasetReader:
+    """Offline input through a `ray_tpu.data.Dataset` (reference:
+    `rllib/offline/dataset_reader.py` — the reference reads offline data
+    with Ray Data readers, so JSON/parquet/csv sources, repartitioning
+    and streaming all come for free). Rows must carry SampleBatch
+    columns (`obs`, `actions`, `rewards`, `dones`, `action_logp`, ...).
+
+    `next()` cycles minibatches forever (InputReader contract);
+    `read_all()` materializes the full dataset as one SampleBatch.
+    """
+
+    def __init__(self, dataset, batch_size: int = 256):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._iter = None
+
+    def _batches(self):
+        while True:
+            for cols in self.dataset.iter_batches(
+                    batch_size=self.batch_size, batch_format="numpy"):
+                yield SampleBatch(
+                    {k: np.asarray(v) for k, v in cols.items()})
+
+    def next(self) -> SampleBatch:
+        if self._iter is None:
+            self._iter = self._batches()
+        return next(self._iter)
+
+    def read_all(self) -> SampleBatch:
+        cols = self.dataset.to_numpy()
+        return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
+
+def resolve_input(input_):
+    """Normalize an algorithm's offline `input_` config to a reader
+    (reference: `rllib/offline/io_context.py` input resolution): a
+    path/glob → JsonReader, a `ray_tpu.data.Dataset` → DatasetReader;
+    readers (anything with .next()) and callables pass through."""
+    if isinstance(input_, str):
+        return JsonReader(input_)
+    if hasattr(input_, "iter_batches") and hasattr(input_, "to_numpy"):
+        return DatasetReader(input_)
+    return input_
+
+
 # ---------------------------------------------------------------------------
 # off-policy estimators (reference: rllib/offline/estimators/)
 # ---------------------------------------------------------------------------
@@ -138,6 +187,159 @@ def importance_sampling(batch: SampleBatch, target_logp: np.ndarray,
         disc = gamma ** np.arange(t)
         vals.append(float(np.sum(w * disc * ep[sb.REWARDS])))
         raw.append(float(np.sum(disc * ep[sb.REWARDS])))
+    return {"v_target": float(np.mean(vals)),
+            "v_behavior": float(np.mean(raw)),
+            "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}
+
+
+class FittedQEvaluation:
+    """FQE (Le et al. 2019): fit Q^π of the TARGET policy on behaviour
+    data by iterating the Bellman backup with a frozen target network.
+    Counterpart of the reference's
+    `offline/estimators/fqe_torch_model.py`, as a jitted flax/optax loop.
+
+    Discrete actions. `fit(batch, target_probs)` needs `new_obs` rows;
+    `target_probs` is π(a|s) of the evaluated policy, [N, A].
+    """
+
+    def __init__(self, obs_shape, num_actions: int,
+                 hiddens=(64, 64), lr: float = 1e-2, gamma: float = 0.99,
+                 n_iters: int = 40, sgd_steps_per_iter: int = 10,
+                 seed: int = 0):
+        import flax.linen as nn
+        import jax
+        import optax
+
+        class _Q(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = x.reshape(x.shape[0], -1)
+                for h in hiddens:
+                    x = nn.relu(nn.Dense(h)(x))
+                return nn.Dense(num_actions)(x)
+
+        self.gamma = gamma
+        self.n_iters = n_iters
+        self.sgd_steps = sgd_steps_per_iter
+        self._net = _Q()
+        dummy = np.zeros((1, int(np.prod(obs_shape))), np.float32)
+        self.params = self._net.init(
+            jax.random.PRNGKey(seed), dummy)["params"]
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self.params)
+
+        import jax.numpy as jnp
+
+        def q_fn(params, obs):
+            return self._net.apply({"params": params}, obs)
+
+        def update(params, opt_state, obs, act, targets):
+            def loss_fn(p):
+                q_sa = jnp.take_along_axis(
+                    q_fn(p, obs), act[:, None], axis=-1)[:, 0]
+                return jnp.mean(jnp.square(q_sa - targets))
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._q_fn = jax.jit(q_fn)
+        self._update = jax.jit(update)
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, np.float32).reshape(len(obs), -1)
+        return np.asarray(self._q_fn(self.params, obs))
+
+    def v_values(self, obs: np.ndarray,
+                 target_probs: np.ndarray) -> np.ndarray:
+        """V^π(s) = Σ_a π(a|s) Q(s, a)."""
+        return (self.q_values(obs) * np.asarray(target_probs)).sum(-1)
+
+    def fit(self, batch: SampleBatch, target_probs: np.ndarray,
+            target_probs_next: np.ndarray | None = None) -> dict:
+        """`target_probs` is π(a|s) on the batch's `obs` rows;
+        `target_probs_next` is π(a|s') on its `new_obs` rows — REQUIRED
+        for a state-dependent policy (the Bellman backup bootstraps
+        V(s') = Σ_a π(a|s') Q(s', a)). When omitted, `target_probs` is
+        reused, which is only exact for state-independent policies."""
+        import jax.numpy as jnp
+
+        obs = np.asarray(batch[sb.OBS], np.float32)
+        obs = obs.reshape(len(obs), -1)
+        nxt = np.asarray(batch[sb.NEXT_OBS], np.float32)
+        nxt = nxt.reshape(len(nxt), -1)
+        act = np.asarray(batch[sb.ACTIONS], np.int32)
+        rew = np.asarray(batch[sb.REWARDS], np.float32)
+        done = np.asarray(batch[sb.DONES], np.float32)
+        probs_next = np.asarray(
+            target_probs if target_probs_next is None
+            else target_probs_next, np.float32)
+        losses = []
+        loss = float("nan")
+        for _ in range(self.n_iters):
+            # Bellman targets from the FROZEN iterate
+            q_next = np.asarray(self._q_fn(self.params, jnp.asarray(nxt)))
+            v_next = (q_next * probs_next).sum(-1)
+            targets = jnp.asarray(rew + self.gamma * (1.0 - done) * v_next)
+            for _ in range(self.sgd_steps):
+                self.params, self._opt_state, loss = self._update(
+                    self.params, self._opt_state, jnp.asarray(obs),
+                    jnp.asarray(act), targets)
+            losses.append(float(loss))
+        return {"loss": losses[-1] if losses else float(loss),
+                "losses": losses}
+
+
+def direct_method(batch: SampleBatch, target_probs: np.ndarray,
+                  q_model: FittedQEvaluation,
+                  gamma: float = 1.0) -> dict:
+    """DM (reference: `offline/estimators/direct_method.py`): the target
+    policy's value is the fitted model's V^π at episode starts — no
+    importance weights, so low variance but biased by model error."""
+    vals, raw = [], []
+    offset = 0
+    v_all = q_model.v_values(np.asarray(batch[sb.OBS]), target_probs)
+    for ep in _per_episode(batch):
+        t = len(ep[sb.REWARDS])
+        vals.append(float(v_all[offset]))
+        raw.append(float(np.sum(gamma ** np.arange(t) * ep[sb.REWARDS])))
+        offset += t
+    return {"v_target": float(np.mean(vals)),
+            "v_behavior": float(np.mean(raw)),
+            "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}
+
+
+def doubly_robust(batch: SampleBatch, target_logp: np.ndarray,
+                  target_probs: np.ndarray,
+                  q_model: FittedQEvaluation,
+                  gamma: float = 1.0) -> dict:
+    """DR (Jiang & Li 2016; reference:
+    `offline/estimators/doubly_robust.py`): backward recursion
+
+        V_DR(t) = V̂(s_t) + ρ_t [r_t + γ V_DR(t+1) − Q̂(s_t, a_t)]
+
+    with per-step weight ρ_t = π(a_t|s_t)/β(a_t|s_t) — unbiased if
+    EITHER the model or the weights are right."""
+    behaviour_logp = np.asarray(batch[sb.ACTION_LOGP])
+    obs = np.asarray(batch[sb.OBS])
+    act = np.asarray(batch[sb.ACTIONS], np.int64)
+    q_all = q_model.q_values(obs)
+    v_all = (q_all * np.asarray(target_probs)).sum(-1)
+    q_sa = np.take_along_axis(q_all, act[:, None], axis=-1)[:, 0]
+    vals, raw = [], []
+    offset = 0
+    for ep in _per_episode(batch):
+        t = len(ep[sb.REWARDS])
+        sl = slice(offset, offset + t)
+        rho = np.exp(target_logp[sl] - behaviour_logp[sl])
+        r = np.asarray(ep[sb.REWARDS])
+        v_hat, q_hat = v_all[sl], q_sa[sl]
+        v_dr = 0.0
+        for i in range(t - 1, -1, -1):
+            v_dr = v_hat[i] + rho[i] * (r[i] + gamma * v_dr - q_hat[i])
+        vals.append(float(v_dr))
+        raw.append(float(np.sum(gamma ** np.arange(t) * r)))
+        offset += t
     return {"v_target": float(np.mean(vals)),
             "v_behavior": float(np.mean(raw)),
             "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}

@@ -105,8 +105,15 @@ class PrioritizedReplayBuffer(ReplayBuffer):
 
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         total = self._tree.total()
-        prefix = self._rng.uniform(0, total, batch_size)
-        idx = np.minimum(self._tree.sample_idx(prefix), self._size - 1)
+        if not np.isfinite(total) or total <= 0.0:
+            # degenerate tree (diverged TD errors fed inf priorities, or
+            # all-zero): fall back to uniform rather than crash the
+            # learner mid-run
+            idx = self._rng.integers(0, self._size, batch_size)
+        else:
+            prefix = self._rng.uniform(0, total, batch_size)
+            idx = np.minimum(self._tree.sample_idx(prefix),
+                             self._size - 1)
         out = {k: v[idx] for k, v in self._store.items()}
         probs = self._tree.tree[idx + self._tree.size] / max(total, 1e-9)
         weights = (self._size * probs + 1e-9) ** (-self.beta)
@@ -114,9 +121,14 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         out["batch_indexes"] = idx
         return out
 
+    _PRIORITY_CEIL = 1e6    # bounds the tree against diverged TD errors
+
     def update_priorities(self, idx: np.ndarray,
                           priorities: np.ndarray) -> None:
-        priorities = np.abs(priorities) + 1e-6
+        priorities = np.abs(np.asarray(priorities, np.float64)) + 1e-6
+        priorities = np.clip(np.nan_to_num(
+            priorities, nan=1.0, posinf=self._PRIORITY_CEIL),
+            1e-6, self._PRIORITY_CEIL)
         self._max_priority = max(self._max_priority,
                                  float(priorities.max()))
         self._tree.set(np.asarray(idx),

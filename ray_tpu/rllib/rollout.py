@@ -97,6 +97,53 @@ def episode_stats(traj) -> dict:
     }
 
 
+class VectorEnvRunner:
+    """InGraphSampler inside a rollout actor: the whole [T, B] fragment
+    is ONE compiled vmap+scan unroll, so actor-based algorithms (IMPALA,
+    Ape-X) get the same per-step cost as the in-graph path instead of a
+    per-step eager dispatch. Counterpart of the reference's
+    `VectorEnv`/`num_envs_per_worker` sampling
+    (`rllib/env/vector_env.py`), minus the Python env loop.
+
+    Batches come back TIME-MAJOR [T, B, ...] with `last_value` [B];
+    consumers either keep fragments (V-trace) or flatten to [T*B]
+    transitions (replay ingest). `new_obs` rows following a done carry
+    the auto-reset observation (masked by (1-done) in TD targets, same
+    contract as PythonEnvRunner).
+    """
+
+    def __init__(self, env, module, rollout_length: int, num_envs: int,
+                 seed: int = 0):
+        self.sampler = InGraphSampler(env, module, num_envs,
+                                      rollout_length)
+        self._key = jax.random.PRNGKey(seed)
+        self._carry = None
+        self._stats: dict | None = None
+
+    def sample(self, params) -> Tuple[SampleBatch, np.ndarray]:
+        self._key, k_init, k_roll = jax.random.split(self._key, 3)
+        if self._carry is None:
+            self._carry = self.sampler.init_state(k_init)
+        self._carry, traj, last_value = self.sampler.sample(
+            params, self._carry, k_roll)
+        self._stats = episode_stats(traj)
+        obs = np.asarray(traj[sb.OBS])
+        next_obs = np.concatenate(
+            [obs[1:], np.asarray(self._carry["obs"])[None]], axis=0)
+        batch = SampleBatch({
+            **{k: np.asarray(v) for k, v in traj.items()
+               if k not in ("episode_return", "episode_len")},
+            sb.NEXT_OBS: next_obs,
+        })
+        return batch, np.asarray(last_value)
+
+    def pop_episode_stats(self) -> dict:
+        stats, self._stats = self._stats, None
+        return stats or {"episode_reward_mean": float("nan"),
+                         "episode_len_mean": float("nan"),
+                         "episodes_this_iter": 0}
+
+
 class PythonEnvRunner:
     """Eager sampler for gym-API Python envs (reset/step methods).
 

@@ -27,10 +27,21 @@ class RolloutWorker:
 
     def __init__(self, env_creator: Callable, module_creator: Callable,
                  rollout_length: int, worker_index: int, seed: int,
-                 connectors: dict | None = None):
+                 connectors: dict | None = None, num_envs: int = 1):
         env = env_creator(worker_index)
         from ray_tpu.rllib.env.jax_env import EagerJaxEnv, is_jax_env
-        if is_jax_env(env):
+        from ray_tpu.rllib.rollout import VectorEnvRunner
+        # connector pipelines are host-side transforms and can't run
+        # inside the compiled unroll: keep the eager batch-1 runner for
+        # them rather than silently dropping the pipeline
+        has_connectors = any((connectors or {}).values())
+        vectorize = is_jax_env(env) and num_envs > 1 and not has_connectors
+        if is_jax_env(env) and num_envs > 1 and has_connectors:
+            logger.info(
+                "worker %d: connectors configured — using the eager "
+                "batch-1 runner instead of the vectorized in-graph "
+                "sampler", worker_index)
+        if is_jax_env(env) and not vectorize:
             env = EagerJaxEnv(env, seed=seed + worker_index)
         import inspect
         try:
@@ -41,10 +52,17 @@ class RolloutWorker:
         self.module = (module_creator(env, worker_index=worker_index)
                        if takes_index else module_creator(env))
         connectors = connectors or {}
-        self.runner = PythonEnvRunner(
-            env, self.module, rollout_length, seed=seed + worker_index,
-            obs_connectors=connectors.get("obs"),
-            action_connectors=connectors.get("action"))
+        if vectorize:
+            # compiled [T, B] unroll; connectors don't apply in-graph
+            self.runner = VectorEnvRunner(
+                env, self.module, rollout_length, num_envs,
+                seed=seed + worker_index)
+        else:
+            self.runner = PythonEnvRunner(
+                env, self.module, rollout_length,
+                seed=seed + worker_index,
+                obs_connectors=connectors.get("obs"),
+                action_connectors=connectors.get("action"))
         self.params = None
 
     def set_connector_state(self, state: dict) -> None:
@@ -84,12 +102,13 @@ class WorkerSet:
     def __init__(self, num_workers: int, env_creator: Callable,
                  module_creator: Callable, rollout_length: int,
                  seed: int = 0, num_cpus_per_worker: float = 1.0,
-                 max_restarts: int = 2, connectors: dict | None = None):
+                 max_restarts: int = 2, connectors: dict | None = None,
+                 num_envs_per_worker: int = 1):
         self.num_workers = num_workers
         self._make = lambda i: ray_tpu.remote(
             num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
                 env_creator, module_creator, rollout_length, i, seed,
-                connectors)
+                connectors, num_envs_per_worker)
         self._workers: List = [self._make(i) for i in range(num_workers)]
         self._restarts = [0] * num_workers
         self.max_restarts = max_restarts

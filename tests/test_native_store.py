@@ -228,6 +228,38 @@ def test_object_store_arena_roundtrip(tmp_path):
     store.close()
 
 
+def test_zero_copy_view_survives_delete_and_reuse(tmp_path):
+    """Freeing an object while a deserialized zero-copy array still
+    borrows its bytes must NOT let the allocator reuse them: the free
+    path probes the per-object mmap for live exports and condemns the
+    block instead (the bug this guards against surfaced as replay
+    batches whose int columns held float bit patterns)."""
+    store = ObjectStore(str(tmp_path))
+    if store._arena is None:
+        pytest.skip("native toolchain unavailable")
+    arr = np.arange(100_000, dtype=np.int32)
+    desc = store.put("victim", arr)
+    assert desc.arena
+    out = store.get(desc)            # zero-copy borrower
+    store.delete(desc)               # freed while borrowed
+    # hammer the allocator: without the borrow probe these allocations
+    # reuse the victim's block and corrupt `out`
+    descs = []
+    for i in range(20):
+        d = store.put(f"churn{i}", np.full(100_000, i, np.float32))
+        descs.append(d)
+    np.testing.assert_array_equal(out, arr)
+    # once the borrower dies, a later store operation reclaims the block
+    del out
+    import gc
+    gc.collect()
+    for d in descs:
+        store.delete(d)
+    store._sweep_condemned()
+    assert not store._condemned
+    store.close()
+
+
 def test_object_store_file_fallback_when_arena_full(tmp_path):
     os.environ["RAY_TPU_OBJECT_STORE_BYTES"] = "1048576"
     try:
