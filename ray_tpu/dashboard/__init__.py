@@ -57,6 +57,21 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):   # no stderr spam
         pass
 
+    _STATIC_TYPES = {".html": "text/html", ".js": "text/javascript",
+                     ".css": "text/css", ".svg": "image/svg+xml"}
+
+    def _static(self, name: str):
+        import os as _os
+        root = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                             "static")
+        path = _os.path.normpath(_os.path.join(root, name))
+        if not path.startswith(root + _os.sep) or not _os.path.isfile(path):
+            return self._send(404, {"error": f"no asset {name!r}"})
+        ext = _os.path.splitext(path)[1]
+        with open(path, "r") as f:
+            return self._send(200, f.read(),
+                              self._STATIC_TYPES.get(ext, "text/plain"))
+
     def _send(self, code: int, body, content_type="application/json"):
         data = (json.dumps(_jsonable(body)).encode()
                 if content_type == "application/json"
@@ -71,13 +86,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             path = self.path.split("?")[0].rstrip("/")
             if path == "":
-                # minimal operator UI over the JSON APIs (the reference
-                # ships a React SPA; this is one static page)
-                import os as _os
-                page = _os.path.join(_os.path.dirname(
-                    _os.path.abspath(__file__)), "index.html")
-                with open(page, "r") as f:
-                    return self._send(200, f.read(), "text/html")
+                # SPA shell (reference: dashboard/client/src — re-done
+                # as a no-build vanilla-JS app in static/)
+                return self._static("index.html")
+            if path.startswith("/static/"):
+                return self._static(path[len("/static/"):])
             if path == "/healthz":
                 return self._send(200, {"status": "ok"})
             if path == "/metrics":

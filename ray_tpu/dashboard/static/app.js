@@ -1,0 +1,386 @@
+/* Dashboard SPA — hash-routed pages over the JSON API.
+ *
+ * Page families mirror the reference's React client
+ * (dashboard/client/src/pages/): overview, cluster (nodes/resources),
+ * jobs (+submit/logs), actors, tasks (+state filters), serve, logs,
+ * metrics (client-side timeseries polled from /api/metrics_snapshot).
+ * No build step: one file, fetch + DOM.
+ */
+"use strict";
+
+const $main = document.getElementById("main");
+const REFRESH_MS = 3000;
+let timer = null;
+
+const fmt = {
+  num(x) {
+    if (x === null || x === undefined) return "–";
+    if (typeof x !== "number") return String(x);
+    if (Number.isInteger(x)) return x.toLocaleString();
+    return x.toFixed(2);
+  },
+  bytes(x) {
+    if (x === null || x === undefined) return "–";
+    const u = ["B", "KB", "MB", "GB", "TB"];
+    let i = 0;
+    while (x >= 1024 && i < u.length - 1) { x /= 1024; i++; }
+    return x.toFixed(i ? 1 : 0) + " " + u[i];
+  },
+  ts(t) {
+    if (!t) return "–";
+    return new Date(t * 1000).toLocaleTimeString();
+  },
+  ago(t) {
+    if (!t) return "–";
+    const s = Math.max(0, Date.now() / 1000 - t);
+    if (s < 60) return s.toFixed(0) + "s ago";
+    if (s < 3600) return (s / 60).toFixed(0) + "m ago";
+    return (s / 3600).toFixed(1) + "h ago";
+  },
+  esc(s) {
+    return String(s ?? "").replace(/[&<>"]/g,
+      c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+  },
+};
+
+async function api(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path + " -> " + r.status);
+  const ct = r.headers.get("Content-Type") || "";
+  return ct.includes("json") ? r.json() : r.text();
+}
+
+function stateBadge(s) {
+  return `<span class="state ${fmt.esc(s)}">${fmt.esc(s)}</span>`;
+}
+
+function table(cols, rows, renderRow) {
+  if (!rows || !rows.length)
+    return `<p class="dim">nothing here yet</p>`;
+  return `<table><thead><tr>${
+    cols.map(c => `<th>${c}</th>`).join("")
+  }</tr></thead><tbody>${rows.map(renderRow).join("")}</tbody></table>`;
+}
+
+function bar(frac) {
+  const pct = Math.min(100, Math.max(0, frac * 100));
+  return `<span class="bar"><i style="width:${pct}%"></i></span>`;
+}
+
+/* --------------------------------------------------------------- pages */
+
+const pages = {};
+
+pages.overview = async () => {
+  const [nodes, summary, jobs, actors] = await Promise.all([
+    api("/api/nodes"), api("/api/summary"), api("/api/jobs"),
+    api("/api/actors"),
+  ]);
+  const alive = nodes.filter(n => (n.state || n.status) !== "DEAD").length;
+  const states = {};
+  for (const row of Object.values(summary || {})) {
+    for (const [st, n] of Object.entries(row.states || row)) {
+      if (typeof n === "number") states[st] = (states[st] || 0) + n;
+    }
+  }
+  const running = states.RUNNING || 0, pending =
+    (states.PENDING || 0) + (states.QUEUED || 0);
+  return `
+  <h2>Overview</h2>
+  <div class="cards">
+    <div class="card"><div class="big">${alive}</div>
+      <div class="label">alive nodes</div></div>
+    <div class="card"><div class="big">${actors.length}</div>
+      <div class="label">actors</div></div>
+    <div class="card"><div class="big">${running}</div>
+      <div class="label">running tasks</div></div>
+    <div class="card"><div class="big">${pending}</div>
+      <div class="label">queued tasks</div></div>
+    <div class="card"><div class="big">${jobs.length}</div>
+      <div class="label">jobs</div></div>
+  </div>
+  <h3>Recent jobs</h3>
+  ${table(["job", "status", "entrypoint", "submitted"],
+          jobs.slice(-8).reverse(), j => `<tr>
+    <td><span class="linklike" onclick="location.hash='#/jobs/${
+      fmt.esc(j.job_id || j.submission_id)}'">${
+      fmt.esc(j.job_id || j.submission_id)}</span></td>
+    <td>${stateBadge(j.status)}</td>
+    <td>${fmt.esc(j.entrypoint)}</td>
+    <td>${fmt.ago(j.submitted_at || j.start_time)}</td></tr>`)}`;
+};
+
+pages.cluster = async () => {
+  const nodes = await api("/api/nodes");
+  return `
+  <h2>Cluster</h2>
+  ${table(["node", "state", "address", "CPU", "TPU", "memory",
+           "object store"],
+          nodes, n => {
+    const res = n.resources || n.resources_total || {};
+    const avail = n.available || n.resources_available || {};
+    const cpu = res.CPU || 0, cpuA = avail.CPU ?? cpu;
+    const tpu = res.TPU || 0, tpuA = avail.TPU ?? tpu;
+    return `<tr>
+      <td>${fmt.esc(n.node_id)}</td>
+      <td>${stateBadge(n.state || n.status || "ALIVE")}</td>
+      <td>${fmt.esc(n.address || n.node_ip || "local")}</td>
+      <td>${fmt.num(cpu - cpuA)}/${fmt.num(cpu)} ${
+        bar(cpu ? (cpu - cpuA) / cpu : 0)}</td>
+      <td>${fmt.num(tpu - tpuA)}/${fmt.num(tpu)}</td>
+      <td>${fmt.bytes(n.memory_used)} / ${fmt.bytes(n.memory_total)}</td>
+      <td>${fmt.bytes(n.object_store_used)} / ${
+        fmt.bytes(n.object_store_total)}</td></tr>`;
+  })}`;
+};
+
+pages.jobs = async (sub) => {
+  if (sub) return jobDetail(sub);
+  const jobs = await api("/api/jobs");
+  return `
+  <h2>Jobs</h2>
+  <form class="inline" onsubmit="return submitJob(this)">
+    <input type="text" name="entrypoint"
+           placeholder="entrypoint, e.g. python my_script.py">
+    <button>Submit</button>
+  </form>
+  <h3>All jobs</h3>
+  ${table(["job", "status", "entrypoint", "submitted", ""],
+          jobs.slice().reverse(), j => {
+    const id = fmt.esc(j.job_id || j.submission_id);
+    return `<tr>
+    <td><span class="linklike" onclick="location.hash='#/jobs/${id}'">${
+      id}</span></td>
+    <td>${stateBadge(j.status)}</td>
+    <td>${fmt.esc(j.entrypoint)}</td>
+    <td>${fmt.ago(j.submitted_at || j.start_time)}</td>
+    <td>${j.status === "RUNNING"
+      ? `<span class="linklike" onclick="stopJob('${id}')">stop</span>`
+      : ""}</td></tr>`;
+  })}`;
+};
+
+async function jobDetail(jobId) {
+  const [info, logs] = await Promise.all([
+    api("/api/jobs/" + jobId),
+    api("/api/jobs/" + jobId + "/logs").catch(() => "(no logs)"),
+  ]);
+  return `
+  <h2>Job ${fmt.esc(jobId)} ${stateBadge(info.status)}</h2>
+  <p class="dim">${fmt.esc(info.entrypoint || "")}</p>
+  <h3>Logs</h3>
+  <pre class="logbox">${fmt.esc(logs)}</pre>
+  <p><a class="btn" href="#/jobs">back</a></p>`;
+}
+
+window.submitJob = (form) => {
+  const entrypoint = form.entrypoint.value.trim();
+  if (entrypoint) {
+    fetch("/api/jobs", {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({entrypoint}),
+    }).then(render);
+  }
+  return false;
+};
+window.stopJob = (id) => {
+  fetch(`/api/jobs/${id}/stop`, {method: "POST"}).then(render);
+};
+
+pages.actors = async () => {
+  const actors = await api("/api/actors");
+  return `
+  <h2>Actors</h2>
+  ${table(["actor", "class", "state", "node", "pid", "restarts", "name"],
+          actors, a => `<tr>
+    <td>${fmt.esc(a.actor_id)}</td>
+    <td>${fmt.esc(a.class_name)}</td>
+    <td>${stateBadge(a.state)}</td>
+    <td>${fmt.esc(a.node_id || "head")}</td>
+    <td>${fmt.esc(a.pid ?? "–")}</td>
+    <td>${fmt.num(a.num_restarts || 0)}</td>
+    <td>${fmt.esc(a.name || "")}</td></tr>`)}`;
+};
+
+let taskFilter = "ALL";
+window.setTaskFilter = (s) => { taskFilter = s; render(); };
+
+pages.tasks = async () => {
+  const [tasks, summary] = await Promise.all([
+    api("/api/tasks"), api("/api/summary")]);
+  const states = [...new Set(tasks.map(t => t.state))].sort();
+  const shown = tasks.filter(
+    t => taskFilter === "ALL" || t.state === taskFilter).slice(-500);
+  const sumRows = Object.entries(summary || {});
+  return `
+  <h2>Tasks</h2>
+  <h3>Summary (by function)</h3>
+  ${table(["function", "states"], sumRows, ([name, row]) => {
+    const st = row.states || row;
+    return `<tr><td>${fmt.esc(name)}</td><td>${
+      Object.entries(st).map(([k, v]) =>
+        `${stateBadge(k)} ${v}`).join(" &nbsp; ")}</td></tr>`;
+  })}
+  <h3>Tasks</h3>
+  <div class="filters">
+    ${["ALL", ...states].map(s =>
+      `<button class="${taskFilter === s ? "on" : ""}"
+        onclick="setTaskFilter('${s}')">${s}</button>`).join("")}
+  </div>
+  ${table(["task", "function", "state", "node", "attempts"],
+          shown.reverse(), t => `<tr>
+    <td>${fmt.esc(t.task_id)}</td>
+    <td>${fmt.esc(t.func_or_class_name || t.name)}</td>
+    <td>${stateBadge(t.state)}</td>
+    <td>${fmt.esc(t.node_id || "–")}</td>
+    <td>${fmt.num(t.attempt_number || 0)}</td></tr>`)}`;
+};
+
+pages.serve = async () => {
+  const apps = await api("/api/serve/applications");
+  const entries = Object.entries(apps.applications || apps || {});
+  if (!entries.length)
+    return `<h2>Serve</h2><p class="dim">no applications deployed</p>`;
+  let html = `<h2>Serve</h2>`;
+  for (const [name, app] of entries) {
+    const deps = Object.entries(app.deployments || {});
+    html += `<h3>${fmt.esc(name)} ${stateBadge(app.status || "?")}</h3>
+    ${table(["deployment", "status", "replicas", "route"],
+            deps, ([dn, d]) => `<tr>
+      <td>${fmt.esc(dn)}</td>
+      <td>${stateBadge(d.status || "?")}</td>
+      <td>${fmt.num(d.num_replicas ?? (d.replicas || []).length)}</td>
+      <td>${fmt.esc(d.route_prefix || app.route_prefix || "")}</td>
+      </tr>`)}`;
+  }
+  return html;
+};
+
+let logSource = null;
+window.setLogSource = (s) => { logSource = s; render(); };
+
+pages.logs = async () => {
+  const sources = await api("/api/logs");
+  const list = Array.isArray(sources) ? sources
+    : (sources.sources || Object.keys(sources));
+  let tail = "";
+  if (logSource) {
+    tail = await api("/api/logs/" + logSource + "?lines=300")
+      .catch(e => "error: " + e);
+  }
+  return `
+  <h2>Logs</h2>
+  <div class="filters">
+    ${list.map(s => `<button class="${logSource === s ? "on" : ""}"
+       onclick="setLogSource('${fmt.esc(s)}')">${fmt.esc(s)}</button>`)
+      .join("")}
+  </div>
+  ${logSource
+    ? `<h3>${fmt.esc(logSource)}</h3>
+       <pre class="logbox">${fmt.esc(tail)}</pre>`
+    : `<p class="dim">pick a source</p>`}`;
+};
+
+/* metrics: poll gauge snapshots client-side into ring buffers and draw
+ * sparkline charts (the reference embeds Grafana; this is self-serve) */
+const series = {};   // name -> [{t, v}]
+const SERIES_CAP = 120;
+
+function pushSample(name, v) {
+  const s = series[name] || (series[name] = []);
+  s.push({t: Date.now(), v});
+  if (s.length > SERIES_CAP) s.shift();
+}
+
+async function pollMetrics() {
+  try {
+    const snap = await api("/api/metrics_snapshot");
+    for (const [k, v] of Object.entries(snap || {})) {
+      if (typeof v === "number") pushSample(k, v);
+    }
+    document.getElementById("health").classList.add("ok");
+  } catch (e) {
+    document.getElementById("health").classList.remove("ok");
+  }
+}
+
+function drawChart(canvas, pts) {
+  const ctx = canvas.getContext("2d");
+  const W = canvas.width, H = canvas.height;
+  ctx.clearRect(0, 0, W, H);
+  if (pts.length < 2) return;
+  const vs = pts.map(p => p.v);
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  const span = hi - lo || 1;
+  ctx.strokeStyle = "#4da3ff";
+  ctx.lineWidth = 1.5;
+  ctx.beginPath();
+  pts.forEach((p, i) => {
+    const x = (i / (pts.length - 1)) * (W - 8) + 4;
+    const y = H - 6 - ((p.v - lo) / span) * (H - 14);
+    i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+  });
+  ctx.stroke();
+  ctx.fillStyle = "#8494a6";
+  ctx.font = "10px monospace";
+  ctx.fillText(hi.toFixed(1), 4, 10);
+  ctx.fillText(lo.toFixed(1), 4, H - 2);
+}
+
+pages.metrics = async () => {
+  await pollMetrics();
+  const names = Object.keys(series).sort();
+  setTimeout(() => {
+    for (const n of names) {
+      const c = document.getElementById("c_" + n);
+      if (c) drawChart(c, series[n]);
+    }
+  }, 0);
+  return `
+  <h2>Metrics</h2>
+  <p class="dim">sampled every ${REFRESH_MS / 1000}s from
+     /api/metrics_snapshot · raw: <a class="linklike"
+     href="/metrics" target="_blank">/metrics</a> · trace:
+     <a class="linklike" href="/api/timeline" target="_blank">
+     /api/timeline</a></p>
+  <div class="row">
+    ${names.map(n => `<div class="chart-card">
+      <div class="t">${fmt.esc(n)} = ${
+        fmt.num(series[n][series[n].length - 1].v)}</div>
+      <canvas id="c_${fmt.esc(n)}" width="280" height="80"></canvas>
+    </div>`).join("") || `<p class="dim">no gauges yet</p>`}
+  </div>`;
+};
+
+/* --------------------------------------------------------------- router */
+
+function route() {
+  const hash = location.hash.replace(/^#\//, "") || "overview";
+  const [page, sub] = hash.split("/");
+  return {page: pages[page] ? page : "overview", sub};
+}
+
+async function render() {
+  const {page, sub} = route();
+  document.querySelectorAll("#nav a").forEach(a =>
+    a.classList.toggle("active", a.dataset.page === page));
+  try {
+    $main.innerHTML = await pages[page](sub);
+    document.getElementById("health").classList.add("ok");
+  } catch (e) {
+    $main.innerHTML = `<p class="err">error: ${fmt.esc(e.message)}</p>`;
+    document.getElementById("health").classList.remove("ok");
+  }
+}
+
+function loop() {
+  clearInterval(timer);
+  timer = setInterval(() => {
+    pollMetrics();
+    render();
+  }, REFRESH_MS);
+}
+
+window.addEventListener("hashchange", render);
+render();
+loop();

@@ -296,14 +296,17 @@ def direct_method(batch: SampleBatch, target_probs: np.ndarray,
     """DM (reference: `offline/estimators/direct_method.py`): the target
     policy's value is the fitted model's V^π at episode starts — no
     importance weights, so low variance but biased by model error."""
+    episodes = _per_episode(batch)
+    # only episode-START values are consumed: evaluate the model there
+    starts = np.cumsum([0] + [len(ep[sb.REWARDS])
+                              for ep in episodes[:-1]])
+    obs0 = np.asarray(batch[sb.OBS])[starts]
+    v0 = q_model.v_values(obs0, np.asarray(target_probs)[starts])
     vals, raw = [], []
-    offset = 0
-    v_all = q_model.v_values(np.asarray(batch[sb.OBS]), target_probs)
-    for ep in _per_episode(batch):
+    for i, ep in enumerate(episodes):
         t = len(ep[sb.REWARDS])
-        vals.append(float(v_all[offset]))
+        vals.append(float(v0[i]))
         raw.append(float(np.sum(gamma ** np.arange(t) * ep[sb.REWARDS])))
-        offset += t
     return {"v_target": float(np.mean(vals)),
             "v_behavior": float(np.mean(raw)),
             "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}

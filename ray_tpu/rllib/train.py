@@ -38,20 +38,29 @@ def run_experiment(algo_name: str, env: str, config: dict | None = None,
     stop = dict(stop or {})
     reward_target = stop.get("episode_reward_mean")
     max_iters = int(stop.get("training_iteration", 100))
+    # wall-clock budget (reference: the tuned-example oracles are
+    # time-to-result floors, e.g. pong-impala-fast.yaml) — the run FAILS
+    # if the reward target isn't reached inside it
+    time_budget = stop.get("time_total_s")
     best = float("-inf")
     t0 = time.time()
     i = 0
-    for i in range(1, max_iters + 1):
-        result = algo.train()
-        rew = result.get("episode_reward_mean", float("nan"))
-        if rew == rew:
-            best = max(best, rew)
-        if verbose and (i % 5 == 0 or i == 1):
-            print(f"iter {i:4d} reward_mean="
-                  f"{rew if rew == rew else float('nan'):9.2f} "
-                  f"best={best:9.2f}")
-        if reward_target is not None and best >= reward_target:
-            break
+    try:
+        for i in range(1, max_iters + 1):
+            result = algo.train()
+            rew = result.get("episode_reward_mean", float("nan"))
+            if rew == rew:
+                best = max(best, rew)
+            if verbose and (i % 5 == 0 or i == 1):
+                print(f"iter {i:4d} reward_mean="
+                      f"{rew if rew == rew else float('nan'):9.2f} "
+                      f"best={best:9.2f}")
+            if reward_target is not None and best >= reward_target:
+                break
+            if time_budget is not None and time.time() - t0 >= time_budget:
+                break
+    finally:
+        algo.cleanup()
     return {
         "passed": reward_target is None or best >= reward_target,
         "best_reward": best,

@@ -7,10 +7,16 @@ killed run resumes from the last completed step, `api.py` exposes
 run/resume/list/get_output. Here the executor walks the `ray_tpu.dag`
 expression tree; each FunctionNode becomes a durable *step* whose result
 is checkpointed to storage (filesystem dir, one file per step) before the
-next step may consume it. Step identity is positional (deterministic
-topological index + function name), so resuming re-binds results to the
-same steps as long as the DAG shape is unchanged — the same contract as
-the reference's name-indexed steps.
+next step may consume it. Step identity is CONTENT-BASED (function code
+hash + upstream step ids + static args), so editing a DAG invalidates
+exactly the edited step and its downstream on resume instead of silently
+re-binding old results to new code — stricter than the reference's
+name-indexed steps (`workflow_storage.py:229`).
+
+Dynamic workflows (reference: `workflow_executor.py:32` continuations):
+a step may RETURN a DAG; the executor runs the returned sub-DAG durably
+in a namespaced step scope and the sub-DAG's result becomes the step's
+result — recursive workflows checkpoint at every level.
 
 Limitations vs reference (documented, not hidden): no virtual actors
 (deprecated upstream), no cross-workflow events; ClassNode/actor steps
@@ -91,6 +97,27 @@ class _Storage:
     def step_path(self, step_id: str) -> str:
         return os.path.join(self.steps_dir, step_id + ".pkl")
 
+    # a step that returned a DAG checkpoints the RETURNED DAG before the
+    # continuation runs, so a crash mid-continuation resumes without
+    # re-executing the parent step (reference: dynamic workflow progress,
+    # workflow_storage.py save_workflow_execution_state)
+    def cont_path(self, step_id: str) -> str:
+        return os.path.join(self.steps_dir, step_id + ".cont.pkl")
+
+    def has_continuation(self, step_id: str) -> bool:
+        return os.path.exists(self.cont_path(step_id))
+
+    def save_continuation(self, step_id: str, subdag) -> None:
+        import cloudpickle
+        tmp = self.cont_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(subdag, f)
+        os.replace(tmp, self.cont_path(step_id))
+
+    def load_continuation(self, step_id: str):
+        with open(self.cont_path(step_id), "rb") as f:
+            return pickle.load(f)
+
     def has_step(self, step_id: str) -> bool:
         return os.path.exists(self.step_path(step_id))
 
@@ -125,33 +152,128 @@ def _topo_order(dag: DAGNode) -> list[DAGNode]:
     return order
 
 
+def _code_hash(fn) -> str:
+    import hashlib
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        payload = code.co_code + repr(code.co_consts).encode()
+    else:
+        payload = repr(fn).encode()
+    return hashlib.sha1(payload).hexdigest()[:10]
+
+
+def _static_repr(value) -> str:
+    """Stable digest for non-node step arguments."""
+    import hashlib
+
+    import cloudpickle
+    try:
+        return hashlib.sha1(cloudpickle.dumps(value)).hexdigest()[:10]
+    except Exception:
+        return "opaque"
+
+
 def _step_ids(nodes: list[DAGNode]) -> Dict[int, str]:
-    """Deterministic step id per FunctionNode: topological visit order +
-    function name. Stable across resumes for an unchanged DAG shape."""
-    order: Dict[int, str] = {}
-    counter = 0
-    for node in nodes:
-        if isinstance(node, FunctionNode):
-            name = getattr(node._fn._function, "__name__", "step")
-            order[id(node)] = f"{counter:05d}_{name}"
-            counter += 1
-    return order
+    """CONTENT-BASED step id per FunctionNode: function name + a hash of
+    (function bytecode, upstream step ids, static args). Editing a step's
+    code or its inputs changes its id (and its downstream's), so resume
+    re-executes exactly the affected subgraph instead of silently
+    re-binding a stale checkpoint — the failure mode of positional ids.
+    Identical-content siblings are disambiguated by a deterministic
+    occurrence index."""
+    import hashlib
+    ids: Dict[int, str] = {}        # id(node) -> step id
+    content: Dict[int, str] = {}    # id(node) -> content token (any node)
+    seen_count: Dict[str, int] = {}
+    for node in nodes:              # children-first topological order
+        child_tokens = [content[id(c)] for c in node._children()]
+        if not isinstance(node, FunctionNode):
+            # discriminating payload of non-function nodes must ride the
+            # token too: input.x vs input.y, or different method names,
+            # are different content even with identical children
+            extra = [repr(getattr(node, attr)) for attr in
+                     ("_key", "_kind", "_method") if hasattr(node, attr)]
+            content[id(node)] = (
+                type(node).__name__ + ":" +
+                hashlib.sha1("|".join(child_tokens + extra).encode())
+                .hexdigest()[:8])
+            continue
+        name = getattr(node._fn._function, "__name__", "step")
+
+        def scrub(v):
+            if isinstance(v, DAGNode):
+                return "<node>"        # upstream identity rides
+            if isinstance(v, (list, tuple)):    # child_tokens instead
+                return [scrub(x) for x in v]
+            if isinstance(v, dict):
+                return {k: scrub(x) for k, x in sorted(
+                    v.items(), key=lambda kv: repr(kv[0]))}
+            return v
+
+        statics = _static_repr((scrub(list(node._bound_args)),
+                                scrub(node._bound_kwargs)))
+        digest = hashlib.sha1("|".join(
+            [_code_hash(node._fn._function), *child_tokens, statics]
+        ).encode()).hexdigest()[:10]
+        base = f"{name}_{digest}"
+        n = seen_count.get(base, 0)
+        seen_count[base] = n + 1
+        sid = base if n == 0 else f"{base}_{n}"
+        ids[id(node)] = sid
+        content[id(node)] = sid
+    return ids
 
 
-def _execute_durable(dag: DAGNode, storage: _Storage, dag_input) -> Any:
+class WorkflowCancelledError(RuntimeError):
+    pass
+
+
+_MAX_CONTINUATION_DEPTH = 200
+
+
+def _execute_durable(dag: DAGNode, storage: _Storage, dag_input,
+                     prefix: str = "", depth: int = 0) -> Any:
     """Ready-wave scheduler: completed steps replay from storage; all steps
     whose dependencies are resolved are submitted *together*, then results
     are consumed as they complete (ray_tpu.wait) and checkpointed — so
-    independent branches run in parallel, like the non-durable execute()."""
+    independent branches run in parallel, like the non-durable execute().
+
+    Continuations (reference: workflow_executor.py:32): a step whose
+    result is itself a DAGNode recurses into this executor with the
+    step's id as the namespace prefix; the sub-DAG's result becomes the
+    step's checkpointed result, so resumes replay at every level.
+    """
     from ray_tpu.dag import (ClassMethodNode, ClassNode,
                              InputAttributeNode, MultiOutputNode)
+    if depth > _MAX_CONTINUATION_DEPTH:
+        raise RecursionError(
+            f"workflow continuation depth exceeded "
+            f"{_MAX_CONTINUATION_DEPTH} (non-terminating recursion?)")
     nodes = _topo_order(dag)
-    step_ids = _step_ids(nodes)
+    step_ids = {k: prefix + sid for k, sid in _step_ids(nodes).items()}
     resolved: Dict[int, Any] = {}
     inflight: Dict[str, tuple] = {}   # ref id -> (node key, step id, ref)
 
     def deps_ready(node: DAGNode) -> bool:
         return all(id(c) in resolved for c in node._children())
+
+    def settle(key: int, sid: str, result: Any,
+               replayed: bool = False) -> None:
+        """Checkpoint a completed step, recursing into a returned DAG."""
+        if isinstance(result, DAGNode):
+            if not replayed:
+                storage.save_continuation(sid, result)
+            result = _execute_durable(
+                result, storage, dag_input, prefix=sid + "__",
+                depth=depth + 1)
+        storage.save_step(sid, result)
+        resolved[key] = result
+
+    def check_cancelled() -> None:
+        meta = storage.load_meta()
+        if meta is not None and meta.get("status") == "CANCELED":
+            raise WorkflowCancelledError(
+                f"workflow was cancelled ({storage.dir})")
 
     while id(dag) not in resolved:
         progressed = False
@@ -165,6 +287,12 @@ def _execute_durable(dag: DAGNode, storage: _Storage, dag_input) -> Any:
                 sid = step_ids[key]
                 if storage.has_step(sid):
                     resolved[key] = storage.load_step(sid)
+                    progressed = True
+                elif storage.has_continuation(sid):
+                    # the step ran before the crash and returned a DAG:
+                    # continue the saved sub-DAG, don't re-run the step
+                    settle(key, sid, storage.load_continuation(sid),
+                           replayed=True)
                     progressed = True
                 elif not any(k == key for k, _, _ in inflight.values()):
                     args, kwargs = node._resolve_args(resolved, dag_input)
@@ -188,14 +316,13 @@ def _execute_durable(dag: DAGNode, storage: _Storage, dag_input) -> Any:
         if id(dag) in resolved:
             break
         if inflight:
+            check_cancelled()
             # consume ONE completed step, checkpoint it, then loop: newly
             # unblocked steps get submitted before we wait again
             refs = [ref for _, _, ref in inflight.values()]
             ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=None)
             key, sid, ref = inflight.pop(ready[0]._id)
-            result = ray_tpu.get(ref)
-            storage.save_step(sid, result)
-            resolved[key] = result
+            settle(key, sid, ray_tpu.get(ref))
         elif not progressed:
             raise RuntimeError("workflow DAG made no progress (cycle?)")
     return resolved[id(dag)]
@@ -266,6 +393,8 @@ def run(dag: DAGNode, *, workflow_id: str | None = None,
                            "pid": os.getpid()})
     try:
         result = _execute_durable(dag, storage, dag_input)
+    except WorkflowCancelledError:
+        raise                      # meta already says CANCELED
     except BaseException:
         m = storage.load_meta() or {}
         m["status"] = "FAILED"
@@ -298,6 +427,8 @@ def resume(workflow_id: str) -> Any:
     storage.save_meta(meta)
     try:
         result = _execute_durable(dag, storage, dag_input)
+    except WorkflowCancelledError:
+        raise                      # meta already says CANCELED
     except BaseException:
         meta["status"] = "FAILED"
         storage.save_meta(meta)
@@ -342,8 +473,37 @@ def delete(workflow_id: str) -> None:
     shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
 
 
+def cancel(workflow_id: str) -> None:
+    """Request cancellation (reference: api.cancel). The running
+    executor observes the CANCELED status at its next step boundary and
+    raises WorkflowCancelledError; checkpoints are kept, so resume() can
+    pick the run back up later."""
+    storage = _Storage(workflow_id)
+    meta = storage.load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if meta["status"] in ("SUCCESSFUL",):
+        return
+    meta["status"] = "CANCELED"
+    storage.save_meta(meta)
+
+
+def resume_all() -> Dict[str, Any]:
+    """Resume every resumable/failed/cancelled workflow (reference:
+    api.resume_all). Returns {workflow_id: result | exception}."""
+    out: Dict[str, Any] = {}
+    for st in list_all():
+        if st.status in ("RESUMABLE", "FAILED", "CANCELED"):
+            try:
+                out[st.workflow_id] = resume(st.workflow_id)
+            except Exception as e:      # surface, don't abort the batch
+                out[st.workflow_id] = e
+    return out
+
+
 __all__ = ["init", "run", "resume", "get_output", "get_status",
-           "list_all", "delete", "WorkflowStatus"]
+           "list_all", "delete", "cancel", "resume_all",
+           "WorkflowCancelledError", "WorkflowStatus"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu("workflow")

@@ -221,3 +221,139 @@ def test_workflow_list_and_delete(cluster, wf_store):
     assert "wlist" in ids
     workflow.delete("wlist")
     assert "wlist" not in [w.workflow_id for w in workflow.list_all()]
+
+
+# ---------------------------------------------------------------------------
+# dynamic workflows (continuations), content-based identity, management
+# (reference: workflow_executor.py:32 continuations; api.cancel/resume_all)
+# ---------------------------------------------------------------------------
+
+
+def test_workflow_recursive_continuation(cluster, wf_store):
+    """A step that returns a DAG recurses durably: factorial via
+    continuation, checkpointed at every level."""
+    @ray_tpu.remote
+    def fact(n, acc=1):
+        if n <= 1:
+            return acc
+        return fact.bind(n - 1, acc * n)
+
+    assert workflow.run(fact.bind(5), workflow_id="wrec") == 120
+    assert workflow.get_status("wrec") == "SUCCESSFUL"
+    # every recursion level left a namespaced checkpoint
+    import os
+    steps = os.listdir(os.path.join(wf_store, "wrec", "steps"))
+    assert sum(1 for s in steps if "fact" in s) >= 5, steps
+
+
+def test_workflow_continuation_resume(cluster, wf_store, tmp_path):
+    """Crash mid-continuation: completed sub-steps replay from their
+    namespaced checkpoints on resume."""
+    marker = tmp_path / "boom"
+    count = tmp_path / "count"
+
+    @ray_tpu.remote
+    def chain(n):
+        with open(count, "a") as f:
+            f.write("x")
+        if n == 2 and marker.exists():
+            raise RuntimeError("boom")
+        if n <= 0:
+            return "done"
+        return chain.bind(n - 1)
+
+    marker.write_text("1")
+    with pytest.raises(Exception):
+        workflow.run(chain.bind(4), workflow_id="wcr")
+    assert workflow.get_status("wcr") == "FAILED"
+    ran_before = len(count.read_text())
+    marker.unlink()
+    assert workflow.resume("wcr") == "done"
+    # levels 4 and 3 replayed from checkpoints; only the failed level
+    # (2) and deeper re-ran
+    ran_after = len(count.read_text()) - ran_before
+    assert ran_after == 3, (ran_before, ran_after)
+
+
+def test_workflow_edit_invalidates_step(cluster, wf_store, tmp_path):
+    """Content-based identity: editing a step's CODE re-executes it on
+    the next run instead of silently replaying the stale checkpoint
+    (the positional-id failure mode)."""
+    a_runs = tmp_path / "a_runs"
+    b_runs = tmp_path / "b_runs"
+
+    @ray_tpu.remote
+    def upstream():
+        with open(a_runs, "a") as f:
+            f.write("x")
+        return 10
+
+    @ray_tpu.remote
+    def downstream(x):
+        with open(b_runs, "a") as f:
+            f.write("x")
+        return x + 1
+
+    assert workflow.run(downstream.bind(upstream.bind()),
+                        workflow_id="wedit") == 11
+    assert (len(a_runs.read_text()), len(b_runs.read_text())) == (1, 1)
+
+    # unchanged DAG: pure replay, nothing re-executes
+    assert workflow.run(downstream.bind(upstream.bind()),
+                        workflow_id="wedit") == 11
+    assert (len(a_runs.read_text()), len(b_runs.read_text())) == (1, 1)
+
+    # EDIT downstream's code: it (and only it) must re-execute
+    @ray_tpu.remote
+    def downstream(x):  # noqa: F811
+        with open(b_runs, "a") as f:
+            f.write("x")
+        return x + 2
+
+    assert workflow.run(downstream.bind(upstream.bind()),
+                        workflow_id="wedit") == 12
+    assert (len(a_runs.read_text()), len(b_runs.read_text())) == (1, 2)
+
+    # EDIT upstream's code: upstream re-runs AND downstream's identity
+    # changes with its input lineage, so both re-execute
+    @ray_tpu.remote
+    def upstream():  # noqa: F811
+        with open(a_runs, "a") as f:
+            f.write("x")
+        return 20
+    assert workflow.run(downstream.bind(upstream.bind()),
+                        workflow_id="wedit") == 22
+    assert (len(a_runs.read_text()), len(b_runs.read_text())) == (2, 3)
+
+
+def test_workflow_cancel_and_resume_all(cluster, wf_store, tmp_path):
+    """cancel() stops the run at a step boundary keeping checkpoints;
+    resume_all() picks up every non-successful workflow."""
+    import threading
+    import time as _time
+
+    @ray_tpu.remote
+    def slow(i):
+        import time as _t
+        _t.sleep(0.5)
+        return i
+
+    @ray_tpu.remote
+    def combine(a, b):
+        return a + b
+
+    # cancel from the driver while steps are in flight; the executor
+    # observes it at its next step boundary
+    canceller = threading.Timer(0.2, workflow.cancel, args=("wcancel",))
+    dag = combine.bind(slow.bind(1), slow.bind(2))
+    canceller.start()
+    try:
+        with pytest.raises(workflow.WorkflowCancelledError):
+            workflow.run(dag, workflow_id="wcancel")
+    finally:
+        canceller.join()
+    assert workflow.get_status("wcancel") == "CANCELED"
+
+    out = workflow.resume_all()
+    assert out.get("wcancel") == 3
+    assert workflow.get_status("wcancel") == "SUCCESSFUL"

@@ -60,9 +60,26 @@ def test_dashboard_healthz_and_state(cluster, dashboard_port):
     snap = _get(dashboard_port, "/api/metrics_snapshot")
     assert snap["nodes_alive"] >= 1 and snap["workers_alive"] >= 1
     assert snap["ts"] > 0 and "store_used_bytes" in snap
-    # and the page itself carries the chart machinery
+    # the SPA shell + assets serve, and the app covers the reference
+    # client's page families (dashboard/client/src/pages/)
     page = _get(dashboard_port, "/")
-    assert "metrics_snapshot" in page and "sparkline" in page
+    assert 'src="/static/app.js"' in page
+    app = _get(dashboard_port, "/static/app.js")
+    for family in ("overview", "cluster", "jobs", "actors", "tasks",
+                   "serve", "logs", "metrics"):
+        assert f"pages.{family}" in app, family
+    assert "metrics_snapshot" in app
+    css = _get(dashboard_port, "/static/style.css")
+    assert "--accent" in css
+    # path traversal is rejected
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dashboard_port, "/static/../__init__.py")
+    # every API the SPA polls responds
+    for route in ("/api/nodes", "/api/actors", "/api/tasks",
+                  "/api/summary", "/api/jobs", "/api/logs",
+                  "/api/serve/applications", "/api/metrics_snapshot"):
+        _get(dashboard_port, route)
 
 
 def test_job_submit_success_and_logs(cluster):
