@@ -229,6 +229,15 @@ OBJECT_REPLACEMENT_WAIT_S = define(
     "After an object's source died mid-pull, how long to wait for a "
     "promoted copy or lineage reconstruction to re-register it.")
 
+SUBMIT_INLINE_BACKLOG = define(
+    "SUBMIT_INLINE_BACKLOG", int, 32,
+    "Pending-queue depth beyond which task submission skips its inline "
+    "dispatch attempt and becomes a pure enqueue: with a deep backlog "
+    "the attempt is futile (older tasks wait on the same capacity) and "
+    "completions pull from the backlog directly. Keeps saturated "
+    "submission O(1) while idle-cluster submit->execute latency stays "
+    "on the fast path.")
+
 SCHEDULER_DISPATCH_WINDOW = define(
     "SCHEDULER_DISPATCH_WINDOW", int, 64,
     "Max non-dispatchable tasks one schedule pass examines before "

@@ -71,6 +71,9 @@ def _spawn_child(req: dict) -> int:
             os.dup2(fd, 2)
         os.environ.clear()
         os.environ.update(req["env"])
+        from ray_tpu._private import ids as _ids
+        _ids.reseed()       # forked children must not replay the
+        # factory's id stream (duplicate object ids across siblings)
         from ray_tpu._private import worker_main
         worker_main.run(req["address"], req["worker_id"])
         os._exit(0)

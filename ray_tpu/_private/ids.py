@@ -6,11 +6,30 @@ they pickle cheaply and hash fast in Python dicts.
 """
 
 import os
-import binascii
+import random
+
+# Uniqueness, not secrecy: a per-process PRNG seeded from the OS avoids
+# one urandom syscall per id on the task-submission hot path (~1M ids
+# per large driver run). getrandbits is a single C call under the GIL,
+# so concurrent submitters can share it safely.
+_rng = random.Random(os.urandom(16))
 
 
 def _rand_hex(nbytes: int = 16) -> str:
-    return binascii.hexlify(os.urandom(nbytes)).decode()
+    return f"{_rng.getrandbits(nbytes * 8):0{nbytes * 2}x}"
+
+
+def reseed() -> None:
+    """Re-seed after fork (child processes must not replay the parent's
+    id stream)."""
+    global _rng
+    _rng = random.Random(os.urandom(16))
+
+
+# Any fork site (user-level multiprocessing included, not just our
+# forkserver) gets a fresh stream — id collisions between forked
+# children would silently alias distinct objects in the store.
+os.register_at_fork(after_in_child=reseed)
 
 
 def new_object_id() -> str:

@@ -114,6 +114,9 @@ class _WorkerConn:
     # set exactly once when RegisterWorker lands: spawn waiters block on
     # THIS, not the global cv (a notify_all herd under creation bursts)
     reg_event: threading.Event = field(default_factory=threading.Event)
+    # True while a pool worker is converted into an actor host; lets a
+    # failed constructor hand the (still healthy) worker back to the pool
+    pooled_actor: bool = False
 
     def send(self, msg) -> bool:
         # conn is None between spawn and registration
@@ -2276,9 +2279,11 @@ class NodeServer:
                        retries_left=spec.max_retries,
                        retry_exceptions=spec.retry_exceptions)
         with self.lock:
-            for kind, v in list(spec.args) + list(spec.kwargs.values()):
-                if kind == "ref" and v not in self.directory \
-                        and v in self.freed_refs:
+            ref_args = [v for kind, v in spec.args if kind == "ref"]
+            ref_args += [v for kind, v in spec.kwargs.values()
+                         if kind == "ref"]
+            for v in ref_args:
+                if v not in self.directory and v in self.freed_refs:
                     from ray_tpu.exceptions import ObjectFreedError
                     self._store_error(
                         spec.return_ids,
@@ -2287,8 +2292,8 @@ class NodeServer:
                             "reference counting"),
                         spec=spec)
                     return
-            for kind, v in list(spec.args) + list(spec.kwargs.values()):
-                if kind == "ref" and v not in self.directory:
+            for v in ref_args:
+                if v not in self.directory:
                     t.deps.add(v)
                     self.obj_waiting_tasks.setdefault(v, []).append(t)
             self.task_events.submitted(spec, bool(t.deps))
@@ -2380,6 +2385,21 @@ class NodeServer:
             return
         with self.lock:
             if self._shutdown or t.cancelled:
+                return
+            if not spec.actor_creation and \
+                    len(self.pending) > constants.SUBMIT_INLINE_BACKLOG:
+                # Deep backlog: the inline dispatch attempt is almost
+                # always futile (older tasks are already waiting on the
+                # same capacity), and every completion pulls from the
+                # backlog directly (_dispatch_freed_fastpath). Skipping
+                # the scan makes saturated submission a pure enqueue —
+                # the reference's submit path is queue-and-schedule for
+                # the same reason (cluster_task_manager.cc:44).
+                self.pending.append(t)
+                # pending may be deep with dep-BLOCKED tasks while
+                # capacity sits idle: the scheduler thread must still
+                # look at this task now, not at its 1 s safety tick
+                self._sched_event.set()
                 return
             to_send = []
             if spec.actor_creation:
@@ -2831,8 +2851,26 @@ class NodeServer:
         if self._needs_localize_locked(t):
             return False
         a.tpu_chips = self._debit_target("head", idx, req, n_tpu, pg)
+        if not a.tpu_chips and not t.spec.runtime_env:
+            # Serve the creation from an idle pooled worker when one
+            # exists (reference: the raylet's PopWorker hands actor
+            # creations pooled workers the same way) — skips the whole
+            # fork+init+register round (~15ms/actor on a 1-core box).
+            # TPU/runtime-env actors still get dedicated spawns.
+            w = next((w for w in self.workers.values()
+                      if w.alive and w.idle and not w.remote
+                      and w.kind == "generic"), None)
+            if w is not None:
+                w.kind = "actor"
+                w.pooled_actor = True
+                w.idle = False
+                w.current = t
+                a.worker = w
+                a.inflight.append(t)
+                to_send.append((w, self._push_msg(w, t)))
+                return True
         threading.Thread(target=self._spawn_actor_worker, args=(a, t),
-                         daemon=True).start()
+                        daemon=True).start()
         return True
 
     def _pump_actor(self, a: _ActorState, to_send):
@@ -3018,6 +3056,15 @@ class NodeServer:
                                 ActorDiedError(
                                     f"actor {a.actor_id} constructor raised"),
                                 spec=qt.spec)
+                        if w.pooled_actor:
+                            # the worker came from the pool and is still
+                            # healthy (only the user constructor raised):
+                            # hand it back instead of stranding it
+                            w.pooled_actor = False
+                            w.kind = "generic"
+                            w.idle = True
+                            a.worker = None
+                            self._sched_event.set()
                     else:
                         a.ready = True
                 if a.worker is w:

@@ -21,6 +21,9 @@ from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -33,4 +36,6 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "ApexDQN", "ApexDQNConfig", "R2D2", "R2D2Config",
            "QMIX", "QMIXConfig", "DT", "DTConfig",
            "AlphaZero", "AlphaZeroConfig",
-           "DreamerV3", "DreamerV3Config"]
+           "DreamerV3", "DreamerV3Config",
+           "MADDPG", "MADDPGConfig", "ARS", "ARSConfig",
+           "CRR", "CRRConfig"]

@@ -1,0 +1,174 @@
+"""ARS — augmented random search (Mania et al. 2018).
+
+Counterpart of the reference's `rllib/algorithms/ars/ars.py` (a
+CPU-worker fleet evaluating random perturbations). What distinguishes
+ARS from ES (the "augmentations", §3 of the paper):
+
+- top-b DIRECTION SELECTION: only the b best directions (by
+  max(r+, r-)) contribute to the update;
+- the step is scaled by the STD of the selected returns (sigma_R), not
+  a rank transform;
+- observations are WHITENED by running mean/std collected during
+  rollouts (V2), so the linear-ish policies the paper uses see
+  normalized state.
+
+TPU-native shape, like our ES: the whole population of antithetic
+perturbations and all their rollouts run as ONE vmapped, scanned,
+jitted program — the paper's parallel CPU fleet becomes a single
+compiled evaluation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.env.jax_env import is_jax_env
+
+
+class ARSConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ARS)
+        self.lr = 0.02                    # paper: step size alpha
+        self.num_directions = 32          # antithetic pairs per iter
+        self.top_directions = 16          # b <= num_directions
+        self.noise_stdev = 0.05
+        self.episode_horizon = 200
+        self.observation_filter = True    # V2 obs whitening
+        self.model = {"fcnet_hiddens": (32,)}
+
+
+class ARS(Algorithm):
+    _config_class = ARSConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        if not is_jax_env(self.env):
+            raise ValueError("ARS requires a JaxEnv (in-graph rollouts)")
+        cfg = self.algo_config
+        if cfg.top_directions > cfg.num_directions:
+            raise ValueError("top_directions must be <= num_directions")
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self._flat, self._unravel = jax.flatten_util.ravel_pytree(
+            self.params)
+        obs_dim = tuple(self.env.observation_space.shape)
+        # running whitening stats (count, mean, M2) — Welford form so
+        # merging a rollout's batch stats is exact
+        self._obs_stats = (jnp.asarray(1e-4), jnp.zeros(obs_dim),
+                           1e-4 * jnp.ones(obs_dim))   # sigma starts ~1
+        self._step_fn = jax.jit(self._ars_step)
+        self._iter = 0
+
+    # -- one perturbed policy's return + obs-moment accumulation ----------
+
+    def _episode_return(self, flat_params, key, mu, sigma):
+        cfg = self.algo_config
+        params = self._unravel(flat_params)
+        k_reset, k_run = jax.random.split(key)
+        state, obs = self.env.reset(k_reset)
+
+        def step(carry, k):
+            state, obs, ret, alive, cnt, s1, s2 = carry
+            w = (obs - mu) / sigma if cfg.observation_filter else obs
+            actions, _, _ = self.module.compute_actions(
+                params, w[None], k, explore=False)
+            state, obs2, r, done, _ = self.env.step(
+                state, jnp.squeeze(actions, 0), k)
+            # fitness + whitening stats stop at the FIRST termination
+            # (the env auto-resets; see es.py for why the mask matters)
+            ret = ret + r * alive
+            cnt = cnt + alive
+            s1 = s1 + obs * alive
+            s2 = s2 + obs * obs * alive
+            alive = alive * (1.0 - done.astype(jnp.float32))
+            return (state, obs2, ret, alive, cnt, s1, s2), None
+
+        zeros = jnp.zeros_like(obs)
+        keys = jax.random.split(k_run, cfg.episode_horizon)
+        (_, _, ret, _, cnt, s1, s2), _ = jax.lax.scan(
+            step, (state, obs, 0.0, 1.0, 0.0, zeros, zeros), keys)
+        return ret, (cnt, s1, s2)
+
+    def _ars_step(self, flat, obs_stats, key):
+        cfg = self.algo_config
+        n, b = cfg.num_directions, cfg.top_directions
+        k_noise, k_eval = jax.random.split(key)
+        delta = jax.random.normal(k_noise, (n, flat.shape[0]),
+                                  dtype=flat.dtype)
+        eval_keys = jax.random.split(k_eval, n)
+        cnt0, mu, m2 = obs_stats
+        sigma = jnp.sqrt(jnp.maximum(m2 / jnp.maximum(cnt0, 1.0), 1e-6))
+
+        run = jax.vmap(self._episode_return, in_axes=(0, 0, None, None))
+        r_plus, st_p = run(flat[None, :] + cfg.noise_stdev * delta,
+                           eval_keys, mu, sigma)
+        r_minus, st_m = run(flat[None, :] - cfg.noise_stdev * delta,
+                            eval_keys, mu, sigma)
+
+        # top-b directions by best-of-pair performance (paper alg. 2,
+        # line 6)
+        scores = jnp.maximum(r_plus, r_minus)
+        _, top = jax.lax.top_k(scores, b)
+        rp, rm = r_plus[top], r_minus[top]
+        sigma_r = jnp.std(jnp.concatenate([rp, rm])) + 1e-8
+        update = (cfg.lr / (b * sigma_r)) * ((rp - rm) @ delta[top])
+        flat = flat + update
+
+        # merge whitening moments from every rollout (plain sums)
+        cnt = cnt0 + jnp.sum(st_p[0]) + jnp.sum(st_m[0])
+        s1 = (mu * cnt0 + jnp.sum(st_p[1], 0) + jnp.sum(st_m[1], 0))
+        s2 = (m2 + mu * mu * cnt0
+              + jnp.sum(st_p[2], 0) + jnp.sum(st_m[2], 0))
+        new_mu = s1 / cnt
+        new_m2 = s2 - new_mu * new_mu * cnt
+        stats = {
+            "episode_reward_mean": jnp.mean(
+                jnp.concatenate([r_plus, r_minus])),
+            "episode_reward_max": jnp.maximum(jnp.max(r_plus),
+                                              jnp.max(r_minus)),
+            "sigma_r": sigma_r,
+        }
+        return flat, (cnt, new_mu, new_m2), stats
+
+    def training_step(self) -> dict:
+        self._flat, self._obs_stats, stats = self._step_fn(
+            self._flat, self._obs_stats, self.next_key())
+        self._iter += 1
+        self.params = self._unravel(self._flat)
+        return {
+            "episode_reward_mean": float(stats["episode_reward_mean"]),
+            "episode_reward_max": float(stats["episode_reward_max"]),
+            "sigma_r": float(stats["sigma_r"]),
+            "episodes_this_iter": 2 * self.algo_config.num_directions,
+            "training_iteration": self._iter,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        cnt, mu, m2 = self._obs_stats
+        sigma = jnp.sqrt(jnp.maximum(m2 / jnp.maximum(cnt, 1.0), 1e-6))
+        w = (jnp.asarray(obs) - mu) / sigma \
+            if self.algo_config.observation_filter else jnp.asarray(obs)
+        actions, _, _ = self.module.compute_actions(
+            self.params, w[None], self.next_key(), explore=explore)
+        a = np.asarray(actions)[0]
+        return a.item() if a.ndim == 0 else a
+
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "flat": np.asarray(self._flat),
+                "obs_stats": jax.tree.map(np.asarray, self._obs_stats)}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self._flat = jnp.asarray(state["flat"])
+        self._obs_stats = tuple(
+            jnp.asarray(x) for x in state["obs_stats"])
+
+
+register_algorithm("ARS", ARS)

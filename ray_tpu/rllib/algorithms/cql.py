@@ -61,11 +61,10 @@ class CQL(SAC):
         if n > cfg.buffer_size:
             # never silently truncate the dataset to the ring size
             self.buffer = ReplayBuffer(n, seed=cfg.seed)
-        low = np.asarray(self._act_low)
-        high = np.asarray(self._act_high)
-        acts = np.asarray(data[sb.ACTIONS], dtype=np.float32)
-        unit = np.clip(2.0 * (acts - low) / (high - low) - 1.0,
-                       -0.999, 0.999)
+        from ray_tpu.rllib.offline import actions_to_unit
+        unit = actions_to_unit(data[sb.ACTIONS],
+                               np.asarray(self._act_low),
+                               np.asarray(self._act_high))
         self.buffer.add_batch({
             sb.OBS: np.asarray(data[sb.OBS], np.float32),
             sb.ACTIONS: unit,

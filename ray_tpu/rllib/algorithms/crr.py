@@ -1,0 +1,252 @@
+"""CRR — critic-regularized regression (Wang et al. 2020).
+
+Counterpart of the reference's `rllib/algorithms/crr/crr.py`: OFFLINE
+continuous control by advantage-weighted behaviour cloning. The actor
+never maximizes Q directly (the failure mode of offline DDPG — exploiting
+critic errors on out-of-distribution actions); instead it regresses
+toward DATASET actions weighted by the critic's advantage:
+
+    L_actor = -E[ log pi(a|s) * f(A(s,a)) ]
+    f = 1[A > 0]            ("binary" mode)
+      | exp(A / beta) clipped ("exp" mode)
+    A(s,a) = Q(s,a) - (1/m) sum_j Q(s, a_j),  a_j ~ pi(.|s)
+
+The critic is a twin-Q TD learner on dataset transitions with target
+networks (no CQL penalty needed — the actor is already constrained to
+the data). One jitted update does critic + actor + polyak targets.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class _GaussianActor(nn.Module):
+    act_dim: int
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.tanh(nn.Dense(self.act_dim)(x))
+        log_std = self.param("log_std", nn.initializers.constant(-0.5),
+                             (self.act_dim,))
+        return mean, jnp.broadcast_to(log_std, mean.shape)
+
+
+class _QNet(nn.Module):
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CRR)
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.weight_mode = "exp"         # "exp" | "binary"
+        self.beta = 1.0                  # exp temperature
+        self.weight_clip = 20.0
+        self.n_action_samples = 4        # m in the advantage baseline
+        self.train_batch_size = 256
+        self.n_updates_per_iter = 64
+        self.input_ = None               # offline data (required)
+        self.buffer_size = 1_000_000
+        self.actor_hiddens = (64, 64)
+        self.critic_hiddens = (64, 64)
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class CRR(Algorithm):
+    _config_class = CRRConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if not cfg.input_:
+            raise ValueError("CRR is an OFFLINE algorithm: pass data via "
+                             "config.offline_data(input_=...)")
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not isinstance(self.env.action_space, Box):
+            raise ValueError("CRR requires a continuous (Box) action "
+                             "space")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.act_dim = int(np.prod(self.env.action_space.shape))
+        self._act_low = np.asarray(self.env.action_space.low,
+                                   np.float32).reshape(self.act_dim)
+        self._act_high = np.asarray(self.env.action_space.high,
+                                    np.float32).reshape(self.act_dim)
+        self.actor = _GaussianActor(self.act_dim,
+                                    tuple(cfg.actor_hiddens))
+        self.q1 = _QNet(tuple(cfg.critic_hiddens))
+        self.q2 = _QNet(tuple(cfg.critic_hiddens))
+        dummy_o = jnp.zeros((1, self.obs_dim))
+        dummy_a = jnp.zeros((1, self.act_dim))
+        self.params = {
+            "actor": self.actor.init(self.next_key(), dummy_o)["params"],
+            "q1": self.q1.init(self.next_key(), dummy_o,
+                               dummy_a)["params"],
+            "q2": self.q2.init(self.next_key(), dummy_o,
+                               dummy_a)["params"],
+        }
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        from ray_tpu.rllib.offline import resolve_input
+        data = resolve_input(cfg.input_).read_all()
+        n = len(data[sb.REWARDS])
+        self.buffer = ReplayBuffer(max(n, cfg.buffer_size),
+                                   seed=cfg.seed)
+        from ray_tpu.rllib.offline import actions_to_unit
+        unit = actions_to_unit(
+            np.asarray(data[sb.ACTIONS]).reshape(n, self.act_dim),
+            self._act_low, self._act_high)
+        self.buffer.add_batch({
+            sb.OBS: np.asarray(data[sb.OBS], np.float32).reshape(
+                n, self.obs_dim),
+            sb.ACTIONS: unit,
+            sb.REWARDS: np.asarray(data[sb.REWARDS], np.float32),
+            sb.DONES: np.asarray(data[sb.DONES]),
+            sb.NEXT_OBS: np.asarray(data[sb.NEXT_OBS],
+                                    np.float32).reshape(n, self.obs_dim),
+        })
+        self._update_fn = jax.jit(self._crr_update)
+        self._num_updates = 0
+
+    # -- jitted update -----------------------------------------------------
+
+    def _logp(self, mean, log_std, act):
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * jnp.square(act - mean) / var - log_std
+            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    def _crr_update(self, params, target_params, opt_state, batch, key):
+        cfg = self.algo_config
+        obs, act = batch[sb.OBS], batch[sb.ACTIONS]
+        nonterm = 1.0 - batch[sb.DONES].astype(jnp.float32)
+        k_next, k_base = jax.random.split(key)
+
+        # TD target from target actor + min of twin target critics
+        mean_n, log_std_n = self.actor.apply(
+            {"params": target_params["actor"]}, batch[sb.NEXT_OBS])
+        a_next = jnp.clip(
+            mean_n + jnp.exp(log_std_n) * jax.random.normal(
+                k_next, mean_n.shape), -1.0, 1.0)
+        q_next = jnp.minimum(
+            self.q1.apply({"params": target_params["q1"]},
+                          batch[sb.NEXT_OBS], a_next),
+            self.q2.apply({"params": target_params["q2"]},
+                          batch[sb.NEXT_OBS], a_next))
+        y = batch[sb.REWARDS] + cfg.gamma * nonterm * \
+            jax.lax.stop_gradient(q_next)
+
+        def loss_fn(p):
+            q1 = self.q1.apply({"params": p["q1"]}, obs, act)
+            q2 = self.q2.apply({"params": p["q2"]}, obs, act)
+            critic_loss = jnp.mean(jnp.square(q1 - y)) + \
+                jnp.mean(jnp.square(q2 - y))
+
+            mean, log_std = self.actor.apply({"params": p["actor"]}, obs)
+            # advantage vs the policy's own action distribution, under
+            # the CURRENT (stop-grad) critic
+            m = cfg.n_action_samples
+            ks = jax.random.split(k_base, m)
+            q_pi = []
+            for i in range(m):
+                a_i = jnp.clip(
+                    jax.lax.stop_gradient(mean)
+                    + jnp.exp(jax.lax.stop_gradient(log_std))
+                    * jax.random.normal(ks[i], mean.shape), -1.0, 1.0)
+                q_pi.append(self.q1.apply(
+                    {"params": jax.lax.stop_gradient(p["q1"])}, obs, a_i))
+            v_base = jnp.mean(jnp.stack(q_pi), axis=0)
+            adv = jax.lax.stop_gradient(
+                self.q1.apply({"params": jax.lax.stop_gradient(p["q1"])},
+                              obs, act) - v_base)
+            if cfg.weight_mode == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / cfg.beta), cfg.weight_clip)
+            logp = self._logp(mean, log_std, act)
+            actor_loss = -jnp.mean(w * logp)
+            return critic_loss + actor_loss, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "advantage_mean": jnp.mean(adv), "weight_mean": jnp.mean(w)}
+
+        (loss, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        target_params = jax.tree.map(
+            lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+            target_params, params)
+        stats["loss"] = loss
+        return params, target_params, opt_state, stats
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        stats = {}
+        for _ in range(cfg.n_updates_per_iter):
+            batch = {k: jnp.asarray(v) for k, v in
+                     self.buffer.sample(cfg.train_batch_size).items()}
+            (self.params, self.target_params, self.opt_state,
+             stats) = self._update_fn(
+                self.params, self.target_params, self.opt_state, batch,
+                self.next_key())
+            self._num_updates += 1
+        return {"num_updates": self._num_updates,
+                "episode_reward_mean": float("nan"),
+                **{k: float(np.asarray(v)) for k, v in stats.items()}}
+
+    def compute_single_action(self, obs, explore: bool = False):
+        mean, log_std = self.actor.apply(
+            {"params": self.params["actor"]},
+            jnp.asarray(obs, jnp.float32).reshape(1, self.obs_dim))
+        a = mean[0]
+        if explore:
+            a = a + jnp.exp(log_std[0]) * jax.random.normal(
+                self.next_key(), a.shape)
+        unit = np.asarray(jnp.clip(a, -1.0, 1.0))
+        return (self._act_low
+                + (unit + 1.0) * 0.5 * (self._act_high - self._act_low))
+
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "target_params": self.target_params}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+
+
+register_algorithm("CRR", CRR)

@@ -66,15 +66,22 @@ class ES(Algorithm):
         state, obs = self.env.reset(k_reset)
 
         def step(carry, k):
-            state, obs, ret = carry
+            state, obs, ret, alive = carry
             actions, _, _ = self.module.compute_actions(
                 params, obs[None], k, explore=False)
             state, obs, r, done, _ = self.env.step(
                 state, jnp.squeeze(actions, 0), k)
-            return (state, obs, ret + r), None
+            # fitness is the FIRST episode's return: rewards after the
+            # first termination are masked (the env auto-resets, and on
+            # +1/step tasks an unmasked fixed-horizon sum would score
+            # every policy identically)
+            ret = ret + r * alive
+            alive = alive * (1.0 - done.astype(jnp.float32))
+            return (state, obs, ret, alive), None
 
         keys = jax.random.split(k_run, self.algo_config.episode_horizon)
-        (_, _, ret), _ = jax.lax.scan(step, (state, obs, 0.0), keys)
+        (_, _, ret, _), _ = jax.lax.scan(
+            step, (state, obs, 0.0, 1.0), keys)
         return ret
 
     def _es_step(self, flat, opt_state, key):

@@ -1,0 +1,328 @@
+"""MADDPG — multi-agent DDPG with centralized critics (Lowe et al. 2017).
+
+Counterpart of the reference's `rllib/algorithms/maddpg/maddpg.py`:
+decentralized actors π_i(o_i) act from LOCAL observations; per-agent
+critics Q_i(s, a_1..a_n) train on the GLOBAL state and joint action
+(centralized training, decentralized execution). Discrete actions use
+Gumbel-softmax relaxation for the actor gradient through the critic,
+the standard discrete-MADDPG treatment (and what the reference's
+contrib implementation does via a softmax action space).
+
+TPU-first shape, like QMIX: the joint rollout is one compiled
+vmap+scan; joint transitions replay host-side; the critic and actor
+updates are two jitted passes over [B, ...] batches (separate optimizers
+so actor gradients never touch critic weights and vice versa).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.env.multi_agent import is_multi_agent_env
+from ray_tpu.rllib.env.spaces import Discrete
+from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+
+class _Actor(nn.Module):
+    n_actions: int
+    hiddens: tuple = (64,)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.reshape(*obs.shape[:-1], -1) if obs.ndim > 2 else obs
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.n_actions)(x)     # logits
+
+
+class _CentralCritic(nn.Module):
+    hiddens: tuple = (128, 64)
+
+    @nn.compact
+    def __call__(self, global_obs, joint_actions):
+        x = jnp.concatenate([global_obs, joint_actions], axis=-1)
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x)[..., 0]
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MADDPG)
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.tau = 0.01                   # soft target update
+        self.gumbel_temperature = 1.0
+        self.train_batch_size = 256
+        self.buffer_size = 50_000
+        self.learning_starts = 500
+        self.n_updates_per_iter = 16
+        self.rollout_fragment_length = 16
+        self.num_envs_per_worker = 32
+        self.actor_hiddens = (64,)
+        self.critic_hiddens = (128, 64)
+
+
+class MADDPG(Algorithm):
+    _config_class = MADDPGConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.jax_env import make_env
+        self.env = make_env(cfg.env, cfg.env_config)
+        if not is_multi_agent_env(self.env):
+            raise ValueError("MADDPG requires a MultiAgentJaxEnv")
+        self.agent_ids = tuple(self.env.agent_ids)
+        for aid in self.agent_ids:
+            if not isinstance(self.env.action_space(aid), Discrete):
+                raise ValueError(
+                    "this MADDPG implements the discrete (Gumbel-"
+                    "softmax) variant; continuous multi-agent control "
+                    "is DDPG/TD3 per agent")
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self.n_actions = {aid: self.env.action_space(aid).n
+                          for aid in self.agent_ids}
+        obs_dims = {aid: int(np.prod(self.env.observation_space(aid).shape))
+                    for aid in self.agent_ids}
+        global_dim = sum(obs_dims.values())
+        joint_act_dim = sum(self.n_actions.values())
+
+        self.actors = {aid: _Actor(self.n_actions[aid],
+                                   tuple(cfg.actor_hiddens))
+                       for aid in self.agent_ids}
+        self.critics = {aid: _CentralCritic(tuple(cfg.critic_hiddens))
+                        for aid in self.agent_ids}
+        self.params = {
+            "actors": {aid: self.actors[aid].init(
+                self.next_key(), jnp.zeros((1, obs_dims[aid])))["params"]
+                for aid in self.agent_ids},
+            "critics": {aid: self.critics[aid].init(
+                self.next_key(), jnp.zeros((1, global_dim)),
+                jnp.zeros((1, joint_act_dim)))["params"]
+                for aid in self.agent_ids},
+        }
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_opt_state = self.actor_opt.init(self.params["actors"])
+        self.critic_opt_state = self.critic_opt.init(
+            self.params["critics"])
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        keys = jax.random.split(self.next_key(), cfg.num_envs_per_worker)
+        state, obs = jax.vmap(self.env.reset)(keys)
+        self._carry = {"env_state": state, "obs": obs,
+                       "ep_ret": jnp.zeros(cfg.num_envs_per_worker),
+                       "ep_len": jnp.zeros(cfg.num_envs_per_worker,
+                                           jnp.int32)}
+        self._sample_fn = jax.jit(self._unroll)
+        self._update_fn = jax.jit(self._maddpg_update)
+        self._steps_sampled = 0
+        self._num_updates = 0
+        self._ep_returns: list = []
+        self._ep_lens: list = []
+
+    # -- compiled joint rollout (stochastic softmax exploration) ----------
+
+    def _logits(self, actor_params, aid, obs):
+        return self.actors[aid].apply({"params": actor_params[aid]},
+                                      obs.reshape(obs.shape[0], -1))
+
+    def _unroll(self, params, carry, key):
+        cfg = self.algo_config
+
+        def one_step(carry, step_key):
+            k_act, k_env = jax.random.split(step_key)
+            obs = carry["obs"]
+            actions = {}
+            akeys = jax.random.split(k_act, len(self.agent_ids))
+            for i, aid in enumerate(self.agent_ids):
+                logits = self._logits(params["actors"], aid, obs[aid])
+                actions[aid] = jax.random.categorical(akeys[i], logits)
+            env_keys = jax.random.split(k_env, cfg.num_envs_per_worker)
+            state, next_obs, rewards, done, _ = jax.vmap(self.env.step)(
+                carry["env_state"], actions, env_keys)
+            team_r = rewards[self.agent_ids[0]]
+            ep_ret = carry["ep_ret"] + team_r
+            ep_len = carry["ep_len"] + 1
+            out = {"obs": obs, "actions": actions, "next_obs": next_obs,
+                   "rewards": {a: rewards[a] for a in self.agent_ids},
+                   "done": done,
+                   "episode_return": jnp.where(done, ep_ret, jnp.nan),
+                   "episode_len": jnp.where(done, ep_len, -1)}
+            new_carry = {"env_state": state, "obs": next_obs,
+                         "ep_ret": jnp.where(done, 0.0, ep_ret),
+                         "ep_len": jnp.where(done, 0, ep_len)}
+            return new_carry, out
+
+        keys = jax.random.split(key, cfg.rollout_fragment_length)
+        return jax.lax.scan(one_step, carry, keys)
+
+    # -- compiled update ---------------------------------------------------
+
+    def _flat_obs(self, obs, aid):
+        return obs[aid].reshape(obs[aid].shape[0], -1)
+
+    def _global_obs(self, obs):
+        return jnp.concatenate(
+            [self._flat_obs(obs, a) for a in self.agent_ids], axis=-1)
+
+    def _joint_onehot(self, actions):
+        return jnp.concatenate(
+            [jax.nn.one_hot(actions[a], self.n_actions[a])
+             for a in self.agent_ids], axis=-1)
+
+    def _maddpg_update(self, params, target_params, actor_opt_state,
+                       critic_opt_state, batch, key):
+        cfg = self.algo_config
+        obs = {a: batch[f"obs_{a}"] for a in self.agent_ids}
+        next_obs = {a: batch[f"next_obs_{a}"] for a in self.agent_ids}
+        acts = {a: batch[f"act_{a}"].astype(jnp.int32)
+                for a in self.agent_ids}
+        g_obs = self._global_obs(obs)
+        g_next = self._global_obs(next_obs)
+        joint_a = self._joint_onehot(acts)
+        nonterm = 1.0 - batch["done"].astype(jnp.float32)
+
+        # target joint action: greedy one-hot from the TARGET actors
+        target_joint = jnp.concatenate([
+            jax.nn.one_hot(
+                jnp.argmax(self._logits(target_params["actors"], a,
+                                        next_obs[a]), axis=-1),
+                self.n_actions[a])
+            for a in self.agent_ids], axis=-1)
+
+        # -- critics: per-agent TD on its own reward stream
+        def critic_loss(critic_params):
+            losses = []
+            for a in self.agent_ids:
+                y = batch[f"rew_{a}"] + cfg.gamma * nonterm * \
+                    jax.lax.stop_gradient(self.critics[a].apply(
+                        {"params": target_params["critics"][a]},
+                        g_next, target_joint))
+                q = self.critics[a].apply(
+                    {"params": critic_params[a]}, g_obs, joint_a)
+                losses.append(jnp.mean(jnp.square(q - y)))
+            return sum(losses), losses
+
+        (c_loss, per_critic), c_grads = jax.value_and_grad(
+            critic_loss, has_aux=True)(params["critics"])
+        c_updates, critic_opt_state = self.critic_opt.update(
+            c_grads, critic_opt_state, params["critics"])
+        new_critics = optax.apply_updates(params["critics"], c_updates)
+
+        # -- actors: maximize Q_i with agent i's action replaced by a
+        # Gumbel-softmax relaxed sample (others keep their logged
+        # actions); gradient stops at the (already-updated) critics
+        gkeys = jax.random.split(key, len(self.agent_ids))
+
+        def actor_loss(actor_params):
+            losses = []
+            for i, a in enumerate(self.agent_ids):
+                logits = self._logits(actor_params, a, obs[a])
+                g = jax.random.gumbel(gkeys[i], logits.shape)
+                relaxed = jax.nn.softmax(
+                    (logits + g) / cfg.gumbel_temperature)
+                parts = []
+                for b in self.agent_ids:
+                    parts.append(relaxed if b == a else
+                                 jax.nn.one_hot(acts[b],
+                                                self.n_actions[b]))
+                q = self.critics[a].apply(
+                    {"params": jax.lax.stop_gradient(new_critics[a])},
+                    g_obs, jnp.concatenate(parts, axis=-1))
+                losses.append(-jnp.mean(q))
+            return sum(losses), losses
+
+        (a_loss, per_actor), a_grads = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["actors"])
+        a_updates, actor_opt_state = self.actor_opt.update(
+            a_grads, actor_opt_state, params["actors"])
+        new_actors = optax.apply_updates(params["actors"], a_updates)
+
+        new_params = {"actors": new_actors, "critics": new_critics}
+        # soft target update (DDPG-style polyak)
+        new_targets = jax.tree.map(
+            lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+            target_params, new_params)
+        return (new_params, new_targets, actor_opt_state,
+                critic_opt_state,
+                {"critic_loss": c_loss, "actor_loss": a_loss})
+
+    # -- training loop -----------------------------------------------------
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        self._carry, traj = self._sample_fn(self.params, self._carry,
+                                            self.next_key())
+        host = {k: np.asarray(v) for k, v in traj.items()
+                if k in ("done",)}
+        flat = {"done": host["done"].reshape(-1)}
+        for a in self.agent_ids:
+            for src, dst in (("obs", "obs"), ("next_obs", "next_obs"),
+                             ("actions", "act"), ("rewards", "rew")):
+                v = np.asarray(traj[src][a])
+                flat[f"{dst}_{a}"] = v.reshape((-1,) + v.shape[2:])
+        self.buffer.add_batch(flat)
+        n = len(flat["done"])
+        self._steps_sampled += n
+        rets = np.asarray(traj["episode_return"]).ravel()
+        lens = np.asarray(traj["episode_len"]).ravel()
+        fin = ~np.isnan(rets)
+        self._ep_returns.extend(rets[fin].tolist())
+        self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
+        self._ep_returns = self._ep_returns[-200:]
+        self._ep_lens = self._ep_lens[-200:]
+
+        stats = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.n_updates_per_iter):
+                batch = {k: jnp.asarray(v) for k, v in
+                         self.buffer.sample(cfg.train_batch_size).items()}
+                (self.params, self.target_params, self.actor_opt_state,
+                 self.critic_opt_state, stats) = self._update_fn(
+                    self.params, self.target_params,
+                    self.actor_opt_state, self.critic_opt_state,
+                    batch, self.next_key())
+                self._num_updates += 1
+        return {
+            "episode_reward_mean": (float(np.mean(self._ep_returns))
+                                    if self._ep_returns else float("nan")),
+            "episode_len_mean": (float(np.mean(self._ep_lens))
+                                 if self._ep_lens else float("nan")),
+            "episodes_this_iter": int(fin.sum()),
+            "num_env_steps_sampled": self._steps_sampled,
+            "num_updates": self._num_updates,
+            **{k: float(np.asarray(v)) for k, v in stats.items()},
+        }
+
+    def compute_joint_action(self, obs: dict) -> dict:
+        """Greedy decentralized execution (each actor sees only its own
+        observation)."""
+        out = {}
+        for a in self.agent_ids:
+            logits = self._logits(
+                self.params["actors"], a,
+                jnp.asarray(obs[a], jnp.float32)[None])
+            out[a] = int(jnp.argmax(logits, axis=-1)[0])
+        return out
+
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "target_params": self.target_params}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+
+
+register_algorithm("MADDPG", MADDPG)

@@ -145,6 +145,16 @@ class DatasetReader:
         return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
 
 
+def actions_to_unit(actions, low, high) -> np.ndarray:
+    """Env-scaled dataset actions -> the actor's tanh range [-1, 1],
+    clipped just inside the boundary so log-prob/atanh-style losses stay
+    finite. Shared by the offline continuous-control algorithms
+    (CQL, CRR)."""
+    actions = np.asarray(actions, np.float32)
+    return np.clip(2.0 * (actions - low) / (high - low) - 1.0,
+                   -0.999, 0.999)
+
+
 def resolve_input(input_):
     """Normalize an algorithm's offline `input_` config to a reader
     (reference: `rllib/offline/io_context.py` input resolution): a

@@ -221,3 +221,33 @@ def test_async_actor_sync_methods_and_errors(ray_session):
     assert ray_tpu.get(m.plain.remote(), timeout=30) == "sync-ok"
     with pytest.raises(Exception, match="async boom"):
         ray_tpu.get(m.boom.remote(), timeout=30)
+
+
+def test_failed_constructor_recycles_pooled_worker(ray_session):
+    """A pooled worker converted into an actor host goes back to the
+    pool when the user constructor raises — repeated creation failures
+    must not strand healthy workers."""
+    import ray_tpu
+    from ray_tpu import exceptions as exc
+
+    @ray_tpu.remote(num_cpus=0)
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("nope")
+
+        def ping(self):
+            return 1
+
+    @ray_tpu.remote(num_cpus=0)
+    class Fine:
+        def ping(self):
+            return 1
+
+    for _ in range(6):
+        b = Broken.remote()
+        with pytest.raises(exc.RayTpuError):
+            ray_tpu.get(b.ping.remote(), timeout=60)
+    # the pool is intact: a healthy actor still comes up quickly
+    f = Fine.remote()
+    assert ray_tpu.get(f.ping.remote(), timeout=60) == 1
+    ray_tpu.kill(f)

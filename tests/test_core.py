@@ -258,3 +258,35 @@ def test_config_table():
             get("MAX_WORKERS_CAP")
     finally:
         del os.environ["RAY_TPU_MAX_WORKERS_CAP"]
+
+
+def test_independent_task_not_stalled_by_blocked_backlog(ray_session):
+    """A deep backlog of dep-BLOCKED tasks must not delay an
+    independent task's dispatch (the pure-enqueue submit path still
+    signals the scheduler)."""
+    import time
+    import ray_tpu
+
+    @ray_tpu.remote
+    def slow():
+        import time as _t
+        _t.sleep(3.0)
+        return 1
+
+    @ray_tpu.remote
+    def dependent(x):
+        return x
+
+    @ray_tpu.remote
+    def quick():
+        return "now"
+
+    gate = slow.remote()
+    blocked = [dependent.remote(gate) for _ in range(64)]
+    t0 = time.perf_counter()
+    out = ray_tpu.get(quick.remote(), timeout=60)
+    dt = time.perf_counter() - t0
+    assert out == "now"
+    assert dt < 2.0, f"independent task stalled {dt:.2f}s behind a " \
+                     "blocked backlog"
+    ray_tpu.get(blocked, timeout=120)

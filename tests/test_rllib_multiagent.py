@@ -43,7 +43,7 @@ def test_mappo_learns_cooperative_toy():
     >=12 of the optimal 16 episode reward (the VERDICT acceptance
     criterion: multi-agent PPO learns a cooperative toy env)."""
     result = run_tuned_example(
-        [p for p in list_tuned_examples() if "coopmatch" in p][0],
+        [p for p in list_tuned_examples() if "coopmatch-mappo" in p][0],
         verbose=False)
     assert result["passed"], result
     assert result["best_reward"] >= 12, result

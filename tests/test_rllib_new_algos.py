@@ -1,0 +1,162 @@
+"""MADDPG / ARS / CRR — the round-5 algorithm additions.
+
+References: `rllib/algorithms/maddpg/` (centralized critics,
+decentralized actors), `rllib/algorithms/ars/` (top-b direction search
+with obs whitening), `rllib/algorithms/crr/` (offline critic-regularized
+regression). Each validated the way the reference validates them:
+tuned-config learning regressions with reward thresholds, plus
+mechanism-level unit checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env.jax_env import JaxEnv
+from ray_tpu.rllib.env.spaces import Box
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.train import list_tuned_examples, run_tuned_example
+
+
+def _run_yaml(substr: str) -> dict:
+    path = [p for p in list_tuned_examples() if substr in p]
+    assert path, f"tuned example {substr} missing"
+    return run_tuned_example(path[0], verbose=False)
+
+
+def test_maddpg_coopmatch_regression():
+    out = _run_yaml("coopmatch-maddpg")
+    assert out["passed"], out
+
+
+def test_maddpg_decentralized_execution():
+    """After centralized training, each actor solves its token from its
+    LOCAL observation alone."""
+    from ray_tpu.rllib.algorithms.maddpg import MADDPGConfig
+    algo = (MADDPGConfig()
+            .environment("CoopMatch",
+                         env_config={"n_agents": 2, "n_tokens": 3,
+                                     "episode_len": 8})
+            .rollouts(num_envs_per_worker=32, rollout_fragment_length=16)
+            .training(learning_starts=500, n_updates_per_iter=16)
+            .debugging(seed=0).build())
+    for _ in range(25):
+        r = algo.train()
+    assert r["episode_reward_mean"] > 7.0, r
+    eye = np.eye(3, dtype=np.float32)
+    for t0 in range(3):
+        for t1 in range(3):
+            joint = algo.compute_joint_action(
+                {"agent_0": eye[t0], "agent_1": eye[t1]})
+            assert joint == {"agent_0": t0, "agent_1": t1}, (t0, t1, joint)
+
+
+def test_ars_cartpole_regression():
+    out = _run_yaml("cartpole-ars")
+    assert out["passed"], out
+
+
+def test_ars_observation_filter_updates():
+    """The V2 whitening stats converge to the visited-state moments."""
+    from ray_tpu.rllib.algorithms.ars import ARSConfig
+    algo = (ARSConfig().environment("CartPole-v1")
+            .training(num_directions=8, top_directions=4,
+                      episode_horizon=50,
+                      model={"fcnet_hiddens": (8,)})
+            .debugging(seed=0).build())
+    algo.train()
+    cnt, mu, m2 = algo._obs_stats
+    assert float(cnt) > 100               # many steps observed
+    assert np.all(np.isfinite(np.asarray(mu)))
+    sigma = np.sqrt(np.asarray(m2) / float(cnt))
+    assert np.all(sigma > 0) and np.all(np.isfinite(sigma))
+
+
+# ---------------------------------------------------------------------------
+# CRR: offline continuous control with a known-optimal synthetic task
+# ---------------------------------------------------------------------------
+
+
+class _ContBandit(JaxEnv):
+    """One-step continuous task used for spaces only (CRR never rolls
+    out). Optimal action a*(s) = (0.5*s0, -0.5*s1)."""
+
+    def __init__(self, env_config=None):
+        self.observation_space = Box(-1.0, 1.0, (2,))
+        self.action_space = Box(-1.0, 1.0, (2,))
+
+
+def _optimal(obs):
+    return np.stack([0.5 * obs[:, 0], -0.5 * obs[:, 1]], axis=-1)
+
+
+def _crr_dataset(n=3000, noise=0.5, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    a_star = _optimal(obs)
+    act = np.clip(a_star + rng.normal(0, noise, a_star.shape), -1, 1)
+    rew = 2.0 - np.sum(np.square(act - a_star), axis=-1)
+    return SampleBatch({
+        sb.OBS: obs,
+        sb.ACTIONS: act.astype(np.float32),
+        sb.REWARDS: rew.astype(np.float32),
+        sb.DONES: np.ones(n, bool),
+        sb.NEXT_OBS: obs,           # unused: every row terminal
+    })
+
+
+@pytest.mark.parametrize("mode", ["exp", "binary"])
+def test_crr_recovers_optimal_from_noisy_data(tmp_path, mode):
+    """Advantage-weighted regression must pull the policy from the noisy
+    behaviour toward the high-advantage actions: the learned mean action
+    lands far closer to a*(s) than the behaviour data."""
+    from ray_tpu.rllib.algorithms.crr import CRRConfig
+    from ray_tpu.rllib.offline import JsonWriter
+
+    data = _crr_dataset()
+    w = JsonWriter(str(tmp_path))
+    w.write(data)
+    w.close()
+
+    algo = (CRRConfig().environment(_ContBandit)
+            .offline_data(input_=str(tmp_path))
+            .training(weight_mode=mode, n_updates_per_iter=128,
+                      train_batch_size=256, lr=1e-3, gamma=0.0)
+            .debugging(seed=0).build())
+    for _ in range(6):
+        r = algo.train()
+    assert np.isfinite(r["critic_loss"]) and np.isfinite(r["actor_loss"])
+
+    rng = np.random.default_rng(1)
+    test_obs = rng.uniform(-1, 1, size=(256, 2)).astype(np.float32)
+    a_star = _optimal(test_obs)
+    learned = np.stack([algo.compute_single_action(o) for o in test_obs])
+    mse_learned = float(np.mean(np.square(learned - a_star)))
+    # behaviour noise sigma=0.5 -> clipped MSE ~0.4 over 2 dims
+    behav = np.clip(a_star + rng.normal(0, 0.5, a_star.shape), -1, 1)
+    mse_behaviour = float(np.mean(np.square(behav - a_star)))
+    assert mse_learned < mse_behaviour / 3, (mse_learned, mse_behaviour)
+    assert r["advantage_mean"] == pytest.approx(0.0, abs=1.0)
+
+
+def test_crr_requires_offline_input():
+    from ray_tpu.rllib.algorithms.crr import CRRConfig
+    with pytest.raises(ValueError, match="OFFLINE"):
+        CRRConfig().environment(_ContBandit).build()
+
+
+def test_es_fitness_masks_after_first_done():
+    """ES/ARS fitness is the FIRST episode's return — a policy that dies
+    immediately must score near zero even though the auto-resetting env
+    pays +1 every step (regression for the vacuous-fitness bug)."""
+    from ray_tpu.rllib.algorithms.es import ESConfig
+    algo = (ESConfig().environment("CartPole-v1")
+            .training(population_size=8, episode_horizon=200,
+                      model={"fcnet_hiddens": (8,)})
+            .debugging(seed=0).build())
+    r = algo.train()
+    # untrained population: mean first-episode return is ~10-40 steps,
+    # nowhere near the 200-step horizon
+    assert r["episode_reward_mean"] < 150, r
