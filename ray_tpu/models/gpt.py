@@ -43,6 +43,13 @@ class GPTConfig:
     max_seq_len: int = 1024
     dtype: str = "bfloat16"
     remat: bool = True
+    # What the layer-scan checkpoint saves for backward:
+    #   "nothing"  - recompute the whole block (min HBM, max recompute)
+    #   "dots"     - save matmul/attention outputs, recompute elementwise
+    #                (jax.checkpoint_policies.checkpoint_dots_with_no_
+    #                batch_dims; bwd skips re-running the big einsums)
+    #   "attn_out" - save only the attention-kernel outputs
+    remat_policy: str = "nothing"
     attn_impl: str = "auto"        # auto | ring | flash | xla
     # Output dtype of the block einsums. MXU accumulation is f32 either
     # way; materializing f32 OUTPUTS doubles activation HBM writes, so
@@ -195,12 +202,19 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
 
     block = partial(_block, cfg=cfg, mesh=mesh)
     if cfg.remat:
-        # Measured on v5e: the default save-nothing policy beats both
+        # Measured on v5e (B=16, T=1024 bench shape): save-nothing beats
         # save_only_these_names("attn_out") and no remat — the recomputed
-        # forward overlaps with backward HBM traffic, so saving activations
-        # only adds bandwidth. The checkpoint_name tag stays available for
-        # bigger-than-HBM configs to flip the policy.
-        block = jax.checkpoint(block)
+        # forward overlaps with backward HBM traffic, so saving
+        # activations often only adds bandwidth. remat_policy exposes the
+        # alternatives for shapes where recompute dominates instead.
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies \
+                .checkpoint_dots_with_no_batch_dims
+        elif cfg.remat_policy == "attn_out":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out")
+        block = jax.checkpoint(block, policy=policy)
 
     def scan_body(x, lp):
         return block(x, lp), None
