@@ -214,6 +214,10 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
         elif cfg.remat_policy == "attn_out":
             policy = jax.checkpoint_policies.save_only_these_names(
                 "attn_out")
+        elif cfg.remat_policy != "nothing":
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                "(expected 'nothing' | 'dots' | 'attn_out')")
         block = jax.checkpoint(block, policy=policy)
 
     def scan_body(x, lp):

@@ -1,0 +1,176 @@
+"""ray_tpu-on-Spark: start a ray_tpu cluster on a Spark cluster's
+executors.
+
+Counterpart of the reference's `python/ray/util/spark/`
+(`setup_ray_cluster`: the head runs on the Spark driver, and a
+long-running background Spark job holds one task per worker node, each
+task hosting a ray worker node for the cluster's lifetime).
+
+The shim depends only on the tiny RDD protocol it actually uses —
+``spark.sparkContext.parallelize(seq, n).foreachPartition(fn)`` — so the
+seam is testable without pyspark (tests drive it with a fake
+SparkSession whose "executors" are local threads); a real SparkSession
+satisfies the same protocol unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import ray_tpu
+
+__all__ = ["setup_ray_cluster", "shutdown_ray_cluster", "RayClusterOnSpark"]
+
+_active: Optional["RayClusterOnSpark"] = None
+
+
+@dataclass
+class RayClusterOnSpark:
+    address: str
+    num_worker_nodes: int
+    _stop_event: threading.Event = None
+    _job_thread: threading.Thread = None
+
+    def shutdown(self):
+        if self._stop_event is not None:
+            self._stop_event.set()      # flag file (shared-fs fast path)
+        # head teardown is the cluster-visible signal: daemons lose the
+        # head channel, exit after their reconnect window, and the Spark
+        # tasks holding the executors return
+        ray_tpu.shutdown()
+        if self._job_thread is not None:
+            self._job_thread.join(timeout=120)
+
+
+def _worker_partition_fn(head_address: str, authkey_hex: str,
+                         num_cpus: int, stop_flag_path: str):
+    """Runs INSIDE a Spark task on an executor: host one ray_tpu worker
+    node (HostDaemon) for the cluster's lifetime. Returned as a closure
+    so pyspark can pickle it to the executor."""
+
+    def fn(_iter):
+        import uuid
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = authkey_hex
+        # pid alone collides when two partition tasks share an executor
+        node_id = f"spark_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.daemon",
+             head_address, node_id,
+             json.dumps({"CPU": float(num_cpus)})],
+            env=env)
+        try:
+            # hold the Spark task (and with it the executor slot) until
+            # shutdown. Two signals, because executors usually do NOT
+            # share a filesystem with the driver: (1) the stop-flag file
+            # (fast path when shared_dir IS shared or same-host), and
+            # (2) the daemon process EXITING — shutdown_ray_cluster
+            # tears the head down, every daemon loses its head channel
+            # and exits after its reconnect window, which releases the
+            # executor slot on any topology.
+            while not os.path.exists(stop_flag_path):
+                if proc.poll() is not None:
+                    return iter(())
+                time.sleep(1.0)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+        return iter(())
+
+    return fn
+
+
+def setup_ray_cluster(spark, *, num_worker_nodes: int,
+                      num_cpus_per_node: int = 1,
+                      shared_dir: str = "/tmp",
+                      wait_timeout_s: float = 120.0) -> str:
+    """Start the head in THIS process (the Spark driver) and one worker
+    node per Spark task via a background job. Returns the cluster
+    address; call shutdown_ray_cluster() (or .shutdown() on the handle)
+    to tear down (reference: util/spark/cluster_init.py
+    setup_ray_cluster)."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("a ray-on-spark cluster is already active; "
+                           "call shutdown_ray_cluster() first")
+    client = ray_tpu.init(num_cpus=0)
+    node = client.node
+    # TCP address when the head listens cross-host (RAY_TPU_HEAD_PORT /
+    # TRANSPORT=tcp — real Spark executors are other machines); the UDS
+    # session address otherwise (same-host testing)
+    address = node.tcp_address or node._address
+    authkey_hex = node._authkey.hex()
+    stop_flag = os.path.join(
+        shared_dir, f"ray_tpu_spark_stop_{os.getpid()}_{int(time.time())}")
+
+    stop_event = threading.Event()
+    fn = _worker_partition_fn(address, authkey_hex, num_cpus_per_node,
+                              stop_flag)
+    job_error: list = []
+
+    def run_job():
+        rdd = spark.sparkContext.parallelize(
+            range(num_worker_nodes), num_worker_nodes)
+        try:
+            rdd.foreachPartition(fn)    # blocks until shutdown
+        except Exception as e:          # surfaced by the register wait
+            job_error.append(e)
+
+    job = threading.Thread(target=run_job, daemon=True,
+                           name="ray_tpu-spark-job")
+    job.start()
+
+    def stopper():
+        stop_event.wait()
+        with open(stop_flag, "w") as f:
+            f.write("stop")
+
+    threading.Thread(target=stopper, daemon=True).start()
+
+    # wait for every worker node to register
+    alive: list = []
+    deadline = time.monotonic() + wait_timeout_s
+    while time.monotonic() < deadline:
+        if job_error:
+            stop_event.set()
+            ray_tpu.shutdown()
+            raise RuntimeError(
+                "the background Spark job failed before the worker "
+                "nodes registered") from job_error[0]
+        alive = [n for n in client.control("list_nodes")
+                 if n.get("node_id", "").startswith("spark_")
+                 and n.get("alive", n.get("state") != "DEAD")]
+        if len(alive) >= num_worker_nodes:
+            break
+        time.sleep(0.5)
+    else:
+        stop_event.set()
+        ray_tpu.shutdown()
+        raise TimeoutError(
+            f"only {len(alive)}/{num_worker_nodes} spark worker nodes "
+            "registered"
+            + (f" (spark job error: {job_error[0]!r})" if job_error
+               else ""))
+
+    _active = RayClusterOnSpark(address, num_worker_nodes,
+                                _stop_event=stop_event, _job_thread=job)
+    return address
+
+
+def shutdown_ray_cluster() -> None:
+    """Reference: util/spark/cluster_init.py shutdown_ray_cluster."""
+    global _active
+    if _active is None:
+        return
+    _active.shutdown()
+    _active = None

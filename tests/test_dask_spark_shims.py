@@ -1,0 +1,182 @@
+"""dask and spark integration shims.
+
+References: `python/ray/util/dask/` (ray_dask_get scheduler over the
+dask graph spec) and `python/ray/util/spark/` (setup_ray_cluster: head
+on the driver, worker nodes held by a background Spark job). The dask
+scheduler is exercised on hand-built graphs (the documented dask spec —
+no dask needed); the spark seam is driven by a fake SparkSession whose
+executors are local threads, the same RDD protocol a real session
+provides.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import ray_dask_get, ray_dask_get_sync
+
+
+def _inc(x):
+    return x + 1
+
+
+def _add(a, b):
+    return a + b
+
+
+def _sum(xs):
+    return sum(xs)
+
+
+GRAPH = {
+    "a": 1,
+    "b": (_inc, "a"),            # 2
+    "c": (_inc, "b"),            # 3
+    "d": (_add, "b", "c"),       # 5
+    "e": (_sum, ["b", "c", "d"]),  # 10
+    "alias": "d",
+}
+
+
+def test_dask_get_executes_graph(ray_session):
+    assert ray_dask_get(GRAPH, "e") == 10
+    assert ray_dask_get(GRAPH, ["b", "d"]) == [2, 5]
+    # nested key structure comes back with matching shape
+    assert ray_dask_get(GRAPH, [["b", "c"], "alias"]) == [[2, 3], 5]
+
+
+def test_dask_get_shares_subgraphs(ray_session):
+    """A diamond's shared node computes once (its ObjectRef is reused)."""
+    calls = []
+
+    def probe(x):
+        import os
+        return (x, os.getpid())
+
+    dsk = {
+        "base": (probe, 1),
+        "l": (lambda t: t[1], "base"),
+        "r": (lambda t: t[1], "base"),
+        "pair": (lambda a, b: (a, b), "l", "r"),
+    }
+    left, right = ray_dask_get(dsk, "pair")
+    assert left == right       # same execution, not two probe() calls
+
+
+def test_dask_get_nested_tasks_and_literals(ray_session):
+    dsk = {
+        "x": (_add, (_inc, 1), 10),        # nested task -> inline
+        "y": (_sum, [1, 2, (_inc, 0)]),
+    }
+    assert ray_dask_get(dsk, "x") == 12
+    assert ray_dask_get(dsk, "y") == 4
+
+
+def test_dask_get_sync_matches(ray_session):
+    for keys in ("e", ["b", "d"], [["b"], "c"]):
+        assert ray_dask_get_sync(GRAPH, keys) == ray_dask_get(GRAPH, keys)
+
+
+def test_dask_get_cycle_detection(ray_session):
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (_inc, "b"), "b": (_inc, "a")}, "a")
+
+
+def test_dask_numpy_partitions(ray_session):
+    """Array-chunk style graph: partition tasks -> tree reduction."""
+    dsk = {
+        ("x", i): (np.arange, 5) for i in range(4)
+    }
+    dsk["total"] = (lambda parts: float(np.sum(parts)),
+                    [("x", i) for i in range(4)])
+    assert ray_dask_get(dsk, "total") == 40.0
+
+
+# ---------------------------------------------------------------------------
+# spark
+# ---------------------------------------------------------------------------
+
+
+class _FakeRDD:
+    def __init__(self, seq, n):
+        self._parts = [[x] for x in seq]
+
+    def foreachPartition(self, fn):
+        threads = [threading.Thread(target=fn, args=(iter(p),),
+                                    daemon=True) for p in self._parts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+
+class _FakeSparkContext:
+    def parallelize(self, seq, n):
+        return _FakeRDD(seq, n)
+
+
+class _FakeSparkSession:
+    sparkContext = _FakeSparkContext()
+
+
+_SPARK_DRIVER = r"""
+import threading
+import ray_tpu
+from ray_tpu.util import spark as ray_spark
+
+class _FakeRDD:
+    def __init__(self, seq, n):
+        self._parts = [[x] for x in seq]
+    def foreachPartition(self, fn):
+        ts = [threading.Thread(target=fn, args=(iter(p),), daemon=True)
+              for p in self._parts]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+
+class _FakeSparkContext:
+    def parallelize(self, seq, n):
+        return _FakeRDD(seq, n)
+
+class _FakeSparkSession:
+    sparkContext = _FakeSparkContext()
+
+import sys
+shared = sys.argv[1]
+address = ray_spark.setup_ray_cluster(
+    _FakeSparkSession(), num_worker_nodes=2, num_cpus_per_node=1,
+    shared_dir=shared)
+assert address
+from ray_tpu._private.worker import get_client
+nodes = get_client().control("list_nodes")
+spark_nodes = [n for n in nodes
+               if str(n.get("node_id", "")).startswith("spark_")]
+assert len(spark_nodes) == 2, nodes
+
+@ray_tpu.remote
+def where():
+    import os
+    return os.getpid()
+
+# the head has 0 CPUs: work MUST run on the spark worker nodes
+pids = set(ray_tpu.get([where.remote() for _ in range(4)], timeout=120))
+assert pids
+ray_spark.shutdown_ray_cluster()
+print("SPARK_OK")
+"""
+
+
+def test_setup_ray_cluster_on_spark(tmp_path):
+    """Head on the driver + one worker node per 'executor' (local
+    threads standing in for Spark tasks); tasks run on the worker
+    nodes; shutdown releases the executors. Runs in a subprocess so the
+    shim's own ray_tpu.init doesn't collide with the shared session."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c", _SPARK_DRIVER, str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "SPARK_OK" in out.stdout
