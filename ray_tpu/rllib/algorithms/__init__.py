@@ -24,6 +24,10 @@ from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
+from ray_tpu.rllib.algorithms.pg import PG, PGConfig
+from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig
+from ray_tpu.rllib.algorithms.simple_q import (
+    A3C, A3CConfig, SimpleQ, SimpleQConfig)
 
 __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "register_algorithm", "PPO", "PPOConfig", "DQN", "DQNConfig",
@@ -38,4 +42,6 @@ __all__ = ["Algorithm", "AlgorithmConfig", "get_algorithm_class",
            "AlphaZero", "AlphaZeroConfig",
            "DreamerV3", "DreamerV3Config",
            "MADDPG", "MADDPGConfig", "ARS", "ARSConfig",
-           "CRR", "CRRConfig"]
+           "CRR", "CRRConfig", "PG", "PGConfig",
+           "SlateQ", "SlateQConfig", "SimpleQ", "SimpleQConfig",
+           "A3C", "A3CConfig"]

@@ -1,0 +1,106 @@
+"""PG — vanilla policy gradient / REINFORCE (Williams 1992).
+
+Counterpart of the reference's `rllib/algorithms/pg/pg.py` (the simplest
+on-policy baseline in its roster: Monte-Carlo returns, no critic, no
+clipping). TPU-first shape matches PPO's in-graph path: rollout
+(vmap+scan), reward-to-go (reverse scan) and the gradient step compile
+as ONE jitted program per iteration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm, AlgorithmConfig, register_algorithm)
+from ray_tpu.rllib.env.jax_env import is_jax_env
+from ray_tpu.rllib.rollout import InGraphSampler, episode_stats
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PG)
+        self.lr = 4e-3
+        self.gamma = 0.99
+        self.rollout_fragment_length = 128
+        self.num_envs_per_worker = 16
+        # reward-to-go is standardized per batch (the standard variance
+        # reduction; the reference's PG leaves returns raw)
+        self.standardize_returns = True
+
+
+def _rewards_to_go(rewards, dones, gamma):
+    """[T, B] discounted reward-to-go, zeroed across episode bounds."""
+
+    def back(acc, xs):
+        r, d = xs
+        acc = r + gamma * acc * (1.0 - d.astype(jnp.float32))
+        return acc, acc
+
+    _, rtg = jax.lax.scan(back, jnp.zeros(rewards.shape[1:]),
+                          (rewards, dones), reverse=True)
+    return rtg
+
+
+class PG(Algorithm):
+    _config_class = PGConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        if not is_jax_env(self.env):
+            raise ValueError("PG v1 requires a JaxEnv (in-graph rollouts)")
+
+    def build_learner(self) -> None:
+        cfg = self.algo_config
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.sampler = InGraphSampler(
+            self.env, self.module, cfg.num_envs_per_worker,
+            cfg.rollout_fragment_length)
+        self._carry = self.sampler.init_state(self.next_key())
+        self._train_fn = jax.jit(self._iteration)
+
+    def _iteration(self, params, opt_state, carry, key):
+        cfg = self.algo_config
+        carry, traj, _ = self.sampler._unroll_impl(params, carry, key)
+        rtg = _rewards_to_go(traj[sb.REWARDS], traj[sb.DONES], cfg.gamma)
+        if cfg.standardize_returns:
+            rtg = (rtg - rtg.mean()) / (rtg.std() + 1e-8)
+
+        def loss_fn(p):
+            dist, _ = self.module.forward(p, traj[sb.OBS])
+            logp = dist.logp(traj[sb.ACTIONS])
+            pg_loss = -jnp.mean(logp * rtg)
+            return pg_loss, {"policy_loss": pg_loss,
+                             "entropy": jnp.mean(dist.entropy())}
+
+        (_, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        ep = {"episode_return": traj["episode_return"],
+              "episode_len": traj["episode_len"]}
+        return params, opt_state, carry, stats, ep
+
+    def training_step(self) -> dict:
+        self.params, self.opt_state, self._carry, stats, ep = \
+            self._train_fn(self.params, self.opt_state, self._carry,
+                           self.next_key())
+        metrics = episode_stats(ep)
+        metrics.update({k: float(np.asarray(v)) for k, v in stats.items()})
+        return metrics
+
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_state(self, state: dict) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+register_algorithm("PG", PG)

