@@ -48,7 +48,7 @@ def main():
         cfg = gpt.GPTConfig(vocab_size=50304, d_model=1024, n_layers=12,
                             n_heads=16, d_ff=4096, max_seq_len=1024,
                             attn_impl="flash", logits_dtype="bfloat16",
-                            remat_policy="dots")
+                            remat_policy="dots", loss_impl="fused")
         # bf16 unembed output (loss upcasts before logsumexp): halves
         # the HBM traffic of the biggest activation; measured +2.3%
         # tok/s on v5e at loss parity to 3 decimals (57.6k -> 59.0k)
@@ -58,11 +58,20 @@ def main():
         # attn_out 58.0k, dots 61.6k (+5.8%, loss parity to 4 decimals)
         # — saving matmul outputs lets backward skip re-running the
         # einsums AND the flash-fwd residual recompute; B=24/32 with
-        # dots exceed what the compiler will schedule (remote compile
-        # OOM), so B=16 stays the sweet spot.
-        batch_size, steps, warmup = 16, 20, 3
-    else:   # CPU smoke mode so the benchmark is runnable anywhere
-        cfg = gpt.small()
+        # dots previously exceeded what the compiler would schedule
+        # (remote compile OOM): the [B, T, V] logits tensor plus its
+        # backward was the peak.
+        # loss_impl="fused" (ops/fused_xent.py) removes that tensor —
+        # the loss streams the unembed in vocab chunks, peak loss
+        # activation O(B*T*chunk) — which is exactly what the B>16
+        # compile OOM was made of, so the batch sweep reopens above 16.
+        # B=24 is the conservative middle of the newly-compilable range;
+        # re-sweep 24/32 on silicon and record here.
+        batch_size, steps, warmup = 24, 20, 3
+    else:   # CPU smoke mode so the benchmark is runnable anywhere.
+        # Runs the fused loss end-to-end too (scan path: the pure-JAX
+        # lax.scan blockwise fallback — same custom_vjp, no Pallas).
+        cfg = gpt.small(loss_impl="fused")
         batch_size, steps, warmup = 4, 5, 1
 
     devices = jax.devices()

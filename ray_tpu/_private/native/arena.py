@@ -175,12 +175,18 @@ class Arena:
         page = mmap.ALLOCATIONGRANULARITY
         base = (off // page) * page
         delta = off - base
-        fd = os.open(self._path, os.O_RDONLY)
         try:
-            m = mmap.mmap(fd, delta + size.value, offset=base,
-                          access=mmap.ACCESS_READ)
-        finally:
-            os.close(fd)
+            fd = os.open(self._path, os.O_RDONLY)
+            try:
+                m = mmap.mmap(fd, delta + size.value, offset=base,
+                              access=mmap.ACCESS_READ)
+            finally:
+                os.close(fd)
+        except Exception:
+            # rts_acquire already pinned the block; failing to map must
+            # not leak the pin (a leaked pin condemns the block forever).
+            self._lib.rts_pin(self._h, object_id.encode(), -1)
+            raise
         return m, memoryview(m)[delta:delta + size.value]
 
     def poisoned(self) -> bool:

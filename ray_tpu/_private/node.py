@@ -3064,6 +3064,13 @@ class NodeServer:
                             w.kind = "generic"
                             w.idle = True
                             a.worker = None
+                            # a.worker was just nulled, so the `a.worker
+                            # is w` check below can't clear w.current —
+                            # do it here, or the recycled worker keeps
+                            # pointing at the dead actor's creation task
+                            # and a later worker death re-credits its
+                            # resources / re-queues it.
+                            w.current = None
                             self._sched_event.set()
                     else:
                         a.ready = True

@@ -38,8 +38,12 @@ const fmt = {
     return (s / 3600).toFixed(1) + "h ago";
   },
   esc(s) {
-    return String(s ?? "").replace(/[&<>"]/g,
-      c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+    // includes ' — escaped values land inside single-quoted inline
+    // onclick handlers (stopJob('${id}') etc.), where a bare quote
+    // breaks out of the attribute
+    return String(s ?? "").replace(/[&<>"']/g,
+      c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;",
+             "'": "&#39;"}[c]));
   },
 };
 

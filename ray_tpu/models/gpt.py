@@ -61,6 +61,23 @@ class GPTConfig:
     # the single biggest activation tensor — the loss upcasts to f32
     # before logsumexp either way.
     logits_dtype: str = "float32"   # float32 | bfloat16
+    # Cross-entropy implementation (validated at trace time, like
+    # remat_policy):
+    #   "dense" - materialize [B, T, vocab] logits, then softmax-xent.
+    #   "fused" - ops/fused_xent.py streams the unembed matmul in vocab
+    #             chunks with an online logsumexp (forward AND backward
+    #             recompute per-chunk logits), so the loss's peak live
+    #             activation is O(B*T*chunk) instead of O(B*T*vocab).
+    #             At bench shape the dense logits tensor is 1.6 GB f32 —
+    #             the single biggest array in the step and what capped
+    #             batch size at 16. Accumulation is f32 either way;
+    #             fused vs dense agrees to ~1e-6 with f32 logits.
+    loss_impl: str = "dense"        # dense | fused
+    # Vocab rows per online-softmax step of the fused loss (also its
+    # preferred Pallas vocab block). The loss's transient logits block
+    # is [B, T, loss_chunk]; smaller chunks mean less live memory and
+    # more loop steps.
+    loss_chunk: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -192,9 +209,11 @@ def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
     return x + down
 
 
-def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
-    """tokens [B, T] int32 -> logits [B, T, vocab] in cfg.logits_dtype
-    (float32 by default)."""
+def forward_features(params, tokens, cfg: GPTConfig,
+                     mesh: Mesh | None = None):
+    """tokens [B, T] int32 -> final-norm activations [B, T, d_model] in
+    cfg.dtype — everything except the unembed matmul. The fused loss
+    consumes these directly so [B, T, vocab] logits never exist."""
     adt = cfg.activation_dtype()
     t = tokens.shape[1]
     x = params["embed"].astype(adt)[tokens]
@@ -224,18 +243,42 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
         return block(x, lp), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    return _rms_norm(x, params["final_ln_scale"].astype(adt))
+
+
+def forward(params, tokens, cfg: GPTConfig, mesh: Mesh | None = None):
+    """tokens [B, T] int32 -> logits [B, T, vocab] in cfg.logits_dtype
+    (float32 by default)."""
+    adt = cfg.activation_dtype()
+    x = forward_features(params, tokens, cfg, mesh)
     logits = jnp.einsum("btd,vd->btv", x, params["embed"].astype(adt),
                         preferred_element_type=jnp.dtype(cfg.logits_dtype))
     return logits
+
+
+def check_loss_impl(cfg: GPTConfig) -> str:
+    """Trace-time validation of the loss_impl knob (remat_policy idiom:
+    a typo'd config fails the first trace, not some later step)."""
+    if cfg.loss_impl not in ("dense", "fused"):
+        raise ValueError(
+            f"unknown loss_impl {cfg.loss_impl!r} "
+            "(expected 'dense' | 'fused')")
+    return cfg.loss_impl
 
 
 def loss_fn(params, batch, cfg: GPTConfig, mesh: Mesh | None = None):
     """Next-token cross entropy. batch: {"tokens": [B, T]} — token t
     predicts token t+1."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
+    if check_loss_impl(cfg) == "fused":
+        from ray_tpu.ops.fused_xent import fused_softmax_xent
+        x = forward_features(params, tokens[:, :-1], cfg, mesh)
+        nll = fused_softmax_xent(
+            x, params["embed"].astype(cfg.activation_dtype()), targets,
+            vocab_chunk=cfg.loss_chunk, mesh=mesh)
+        return jnp.mean(nll)
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
     # upcast before the softmax so logits_dtype="bfloat16" configs keep
     # an f32 logsumexp (same guard as spmd.softmax_xent)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -41,6 +41,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x ships the TPU params dataclass as TPUCompilerParams; newer
+# releases renamed it CompilerParams. Resolve once so the kernels run
+# on both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 from ray_tpu.parallel.ring_attention import reference_attention
 
 NEG_INF = -1e30
@@ -142,7 +148,7 @@ def _flash_bhtd(q, k, v, *, sm_scale: float, causal: bool, block_q: int,
             pltpu.VMEM((block_q, 128), jnp.float32),   # l
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -264,7 +270,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, sm_scale: float,
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -282,7 +288,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, delta, *, sm_scale: float,
         out_specs=(kspec2, kspec2),
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel.sharding import shard_map
+
 
 def stack_stage_params(per_stage_params: list):
     """Stack a list of per-stage pytrees into one pytree with a leading
@@ -93,7 +95,7 @@ def pipeline_apply(stage_fn, per_stage_params: list, x, *,
 
     io_spec = P() if batch_spec is None else batch_spec
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipe_loop, stage_fn=stage_fn,
                           axis_name=axis_name),
         mesh=mesh,

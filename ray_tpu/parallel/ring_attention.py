@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.parallel.sharding import shard_map
+
 NEG_INF = -1e30
 
 
@@ -104,7 +106,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
         return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attn_sharded, axis_name=axis_name,
                           causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -31,6 +31,20 @@ DEFAULT_RULES: dict[str, object] = {
 }
 
 
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions: newer releases expose
+    ``jax.shard_map(..., check_vma=)``; 0.4.x has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (the same
+    knob under its old name). Every shard_map in the tree goes through
+    here so the version probe lives in one place."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def _mesh_axes(mesh: Mesh) -> set:
     return set(mesh.axis_names)
 
@@ -98,6 +112,24 @@ def shard_batch(batch, mesh: Mesh):
         ndim_spec = PartitionSpec(*(list(spec) + [None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(mesh, ndim_spec))
     return jax.tree.map(place, batch)
+
+
+def fused_xent_specs(mesh: Mesh, rules: dict | None = None
+                     ) -> tuple[PartitionSpec, PartitionSpec,
+                                PartitionSpec]:
+    """(x, embed, targets) PartitionSpecs for ops.fused_xent's
+    vocab-parallel shard_map.
+
+    Activations and targets follow the batch/length rules; the embedding
+    keeps its vocab sharding but replicates d_model (each shard reduces
+    its local vocab rows to a partial log-sum-exp and partial target
+    logit, then one psum over the vocab mesh axis combines them — the
+    only cross-shard traffic the fused loss needs is two [B, T] f32
+    arrays, vs. the dense path's [B, T, V] logits collective)."""
+    x_spec = logical_to_spec(("batch", "length", None), rules, mesh)
+    t_spec = logical_to_spec(("batch", "length"), rules, mesh)
+    e_spec = logical_to_spec(("vocab", None), rules, mesh)
+    return x_spec, e_spec, t_spec
 
 
 def replicated(mesh: Mesh):

@@ -43,6 +43,14 @@ class DQNConfig(AlgorithmConfig):
         self.prioritized_replay_alpha = 0.6
         self.prioritized_replay_beta = 0.4
         self.n_updates_per_iter = 64
+        # Multi-step TD backup (reference: dqn.py `n_step`; Ape-X uses 3).
+        # Each stored transition carries the k-step discounted return, the
+        # observation k steps ahead, and an explicit per-sample discount
+        # gamma^k * nonterminal (k <= n_step, truncating at episode or
+        # fragment end). Value then propagates k steps per target sync
+        # instead of one — the difference between learning and stalling
+        # when the update budget only affords a handful of syncs.
+        self.n_step = 1
         # epsilon-greedy linear schedule, in env steps
         self.epsilon_initial = 1.0
         self.epsilon_final = 0.05
@@ -62,6 +70,38 @@ class DQNConfig(AlgorithmConfig):
         if input_ is not None:
             self.input_ = input_
         return self
+
+
+def _n_step_fragment(host: dict, n: int, gamma: float) -> dict:
+    """Fold a sampled [T, B] fragment into n-step transitions.
+
+    REWARDS becomes the k-step discounted return, NEXT_OBS the
+    observation k steps ahead, and a new "discounts" column carries
+    gamma^k * nonterminal, where k <= n truncates at episode end (done)
+    or fragment end. OBS/ACTIONS stay at the transition start. The TD
+    update consumes "discounts" directly, so no gamma bookkeeping leaks
+    into the loss."""
+    src_next = np.asarray(host[sb.NEXT_OBS])
+    r = np.asarray(host[sb.REWARDS], np.float32)
+    d = np.asarray(host[sb.DONES], bool)
+    ret = r.copy()
+    next_obs = src_next.copy()
+    disc = gamma * (~d).astype(np.float32)
+    t_len = r.shape[0]
+    for i in range(1, n):
+        for t in range(t_len - i):
+            cont = disc[t] != 0.0
+            ret[t] = np.where(cont, ret[t] + disc[t] * r[t + i], ret[t])
+            next_obs[t][cont] = src_next[t + i][cont]
+            disc[t] = np.where(cont,
+                               disc[t] * gamma * (~d[t + i]), disc[t])
+    out = dict(host)
+    out[sb.REWARDS] = ret
+    out[sb.NEXT_OBS] = next_obs
+    # dones stays the per-step flag (episode accounting); the bootstrap
+    # mask lives entirely in "discounts".
+    out["discounts"] = disc
+    return out
 
 
 class DQN(Algorithm):
@@ -162,7 +202,11 @@ class DQN(Algorithm):
             q_next = jnp.take_along_axis(
                 q_next_target, best[..., None], axis=-1)[..., 0]
             nonterm = 1.0 - batch[sb.DONES].astype(jnp.float32)
-            target = batch[sb.REWARDS] + cfg.gamma * nonterm * \
+            # n-step batches carry their own gamma^k * nonterminal column;
+            # 1-step batches (external input, Ape-X shards) fall back to
+            # the classic gamma * (1 - done) mask.
+            disc = batch.get("discounts", cfg.gamma * nonterm)
+            target = batch[sb.REWARDS] + disc * \
                 jax.lax.stop_gradient(q_next)
             td_error = q_sel - target
             weights = batch.get("weights", jnp.ones_like(td_error))
@@ -252,6 +296,8 @@ class DQN(Algorithm):
             self._ep_lens.extend(lens[fin & (lens >= 0)].tolist())
             self._ep_returns = self._ep_returns[-100:]
             self._ep_lens = self._ep_lens[-100:]
+            if cfg.n_step > 1:
+                host = _n_step_fragment(host, cfg.n_step, cfg.gamma)
             flat = {k: v.reshape((-1,) + v.shape[2:])
                     for k, v in host.items()}
             self.buffer.add_batch(flat)

@@ -62,9 +62,12 @@ class PG(Algorithm):
             self.env, self.module, cfg.num_envs_per_worker,
             cfg.rollout_fragment_length)
         self._carry = self.sampler.init_state(self.next_key())
-        self._train_fn = jax.jit(self._iteration)
+        # NB: named _pg_iteration, not _iteration — Trainable.__init__
+        # stores the training-iteration COUNTER as self._iteration, which
+        # shadows a method of the same name (jax.jit(0) -> TypeError).
+        self._train_fn = jax.jit(self._pg_iteration)
 
-    def _iteration(self, params, opt_state, carry, key):
+    def _pg_iteration(self, params, opt_state, carry, key):
         cfg = self.algo_config
         carry, traj, _ = self.sampler._unroll_impl(params, carry, key)
         rtg = _rewards_to_go(traj[sb.REWARDS], traj[sb.DONES], cfg.gamma)

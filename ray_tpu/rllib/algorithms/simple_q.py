@@ -24,6 +24,14 @@ class SimpleQConfig(DQNConfig):
         super().__init__(algo_class or SimpleQ)
         self.double_q = False
         self.prioritized_replay = False
+        # SimpleQ presets assume a small update budget (it is the "quick
+        # baseline" entry). Without double-Q and with sparse target syncs,
+        # 1-step backups propagate value one sync at a time and the Q
+        # ranking never separates; a 5-step backup + a hotter lr and a
+        # faster epsilon decay make the small budget sufficient.
+        self.n_step = 5
+        self.lr = 3e-3
+        self.epsilon_timesteps = 8000
 
 
 class SimpleQ(DQN):

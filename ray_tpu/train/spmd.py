@@ -101,10 +101,13 @@ def make_train_step(loss_fn: Callable,
 # ---------------------------------------------------------------------------
 
 def softmax_xent(logits, targets):
-    """Fused cross entropy: ``gather - logsumexp`` touches the [B, T, V]
+    """Dense cross entropy: ``gather - logsumexp`` touches the [B, T, V]
     logits twice instead of log_softmax's materialize-then-gather (the
     logits tensor is the biggest array in an LM step — at GPT-2 bench
-    shape it is 1.6 GB f32, so every avoided pass is ~2 ms of HBM)."""
+    shape it is 1.6 GB f32, so every avoided pass is ~2 ms of HBM).
+    cfg.loss_impl="fused" (ops/fused_xent.py) goes further and never
+    materializes the logits at all — gpt_loss_fn routes between the
+    two."""
     logits = logits.astype(jnp.float32)   # no-op for f32; bf16 logits
     #                                       upcast before the logsumexp
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
@@ -120,8 +123,15 @@ def gpt_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
     """
     from ray_tpu.models import gpt
 
-    logits = gpt.forward(params, batch["inputs"], cfg, mesh)
-    nll = softmax_xent(logits, batch["targets"])
+    if gpt.check_loss_impl(cfg) == "fused":
+        from ray_tpu.ops.fused_xent import fused_softmax_xent
+        x = gpt.forward_features(params, batch["inputs"], cfg, mesh)
+        nll = fused_softmax_xent(
+            x, params["embed"].astype(cfg.activation_dtype()),
+            batch["targets"], vocab_chunk=cfg.loss_chunk, mesh=mesh)
+    else:
+        logits = gpt.forward(params, batch["inputs"], cfg, mesh)
+        nll = softmax_xent(logits, batch["targets"])
     mask = batch.get("mask")
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
