@@ -1,6 +1,29 @@
-"""Flagship benchmark: GPT train-step throughput on the local chip(s).
+"""Flagship benchmark: GPT train throughput, streaming fresh host batches
+through the overlapped training loop (ray_tpu/train/loop.py).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology (changed in PR 2): earlier rounds re-dispatched one jitted
+step per Python iteration on a single pre-sharded device batch, so the
+number excluded host→device transfer and dispatch overhead. The loop now
+generates a FRESH host batch every step and streams it through the
+double-buffered prefetcher with fused multi-step dispatch, so tokens/s is
+an honest end-to-end figure — host feed, transfer, dispatch, compute and
+the (ring-buffered, every-K-steps) metrics fetch all inside the timed
+region. The overlap work keeps it at or above the r5 fixed-batch number
+(61.6k tok/s on v5e).
+
+Knobs (env vars, platform-tuned defaults below):
+  RAY_TPU_BENCH_ACCUM     gradient-accumulation microbatches per step
+                          (spmd.make_train_step(accum=k); k splits the
+                          batch, so tokens/step is unchanged)
+  RAY_TPU_BENCH_UNROLL    steps fused into one jitted dispatch
+                          (loop.TrainLoop(unroll=u))
+  RAY_TPU_BENCH_PREFETCH  host→device transfers kept in flight
+                          (loop.DevicePrefetcher(depth=d))
+  RAY_TPU_BENCH_INTERVAL  steps between host metric fetches
+                          (loop.MetricsRing(interval=K))
+  RAY_TPU_BENCH_BATCH / RAY_TPU_BENCH_STEPS  shape of the timed region
 
 The reference publishes no committed throughput numbers (BASELINE.md —
 "harness only"); its north star is "ResNet-50 / GPT wall-clock at >= NCCL
@@ -12,6 +35,7 @@ means the TPU path beats the reference's realistic efficiency envelope.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -38,12 +62,17 @@ def peak_flops(device) -> float:
     return 197e12
 
 
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
 def main():
     from ray_tpu.models import gpt
     from ray_tpu.parallel import MeshSpec
-    from ray_tpu.train import spmd
+    from ray_tpu.train import loop, spmd
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
     if on_tpu:
         cfg = gpt.GPTConfig(vocab_size=50304, d_model=1024, n_layers=12,
                             n_heads=16, d_ff=4096, max_seq_len=1024,
@@ -55,46 +84,70 @@ def main():
         # Batch swept on v5e: 8 -> 55.2k tok/s (0.468 MFU), 16 -> 58.4k
         # (0.495), 32 -> 58.5k (plateau; remat required above 8 anyway).
         # remat_policy swept on v5e at B=16 (r5): save-nothing 58.2k,
-        # attn_out 58.0k, dots 61.6k (+5.8%, loss parity to 4 decimals)
-        # — saving matmul outputs lets backward skip re-running the
-        # einsums AND the flash-fwd residual recompute; B=24/32 with
-        # dots previously exceeded what the compiler would schedule
-        # (remote compile OOM): the [B, T, V] logits tensor plus its
-        # backward was the peak.
-        # loss_impl="fused" (ops/fused_xent.py) removes that tensor —
-        # the loss streams the unembed in vocab chunks, peak loss
-        # activation O(B*T*chunk) — which is exactly what the B>16
-        # compile OOM was made of, so the batch sweep reopens above 16.
-        # B=24 is the conservative middle of the newly-compilable range;
-        # re-sweep 24/32 on silicon and record here.
-        batch_size, steps, warmup = 24, 20, 3
+        # attn_out 58.0k, dots 61.6k (+5.8%, loss parity to 4 decimals).
+        # loss_impl="fused" (ops/fused_xent.py) streams the unembed in
+        # vocab chunks so the [B, T, V] logits tensor never exists; that
+        # is what reopened B>16 (r5 runs B=24).
+        # accum=1: B=24 fits, so accumulation is off on the bench; flip
+        # RAY_TPU_BENCH_ACCUM to trade peak activations for scan steps
+        # when sweeping B beyond HBM. unroll=4 amortizes one Python
+        # dispatch over 4 steps; prefetch=2 double-buffers the host feed.
+        batch_size, steps, warmup = 24, 20, 4
+        accum, unroll, prefetch, interval = 1, 4, 2, 10
     else:   # CPU smoke mode so the benchmark is runnable anywhere.
-        # Runs the fused loss end-to-end too (scan path: the pure-JAX
-        # lax.scan blockwise fallback — same custom_vjp, no Pallas).
-        cfg = gpt.small(loss_impl="fused")
-        batch_size, steps, warmup = 4, 5, 1
+        # Exercises the full overlap path end-to-end: fused loss (scan
+        # fallback), accum=2 microbatching, unroll=2 fused dispatch,
+        # depth-2 prefetch, ring-buffered metrics. XLA:CPU compile of the
+        # nested scans dominates wall-clock, so the model is as small as
+        # the path allows — the number only matters on silicon.
+        cfg = gpt.small(loss_impl="fused", n_layers=1, max_seq_len=64,
+                        d_model=64, d_ff=256, n_heads=2, vocab_size=256)
+        steps, warmup = 8, 2
+        accum, unroll, prefetch, interval = 2, 2, 2, 4
+        # microbatches shard over the data axes, so the batch must hold
+        # accum * n_devices rows (tests force an 8-device CPU mesh)
+        grain = accum * len(devices)
+        batch_size = grain * max(1, 4 // grain)
 
-    devices = jax.devices()
+    batch_size = _env_int("RAY_TPU_BENCH_BATCH", batch_size)
+    steps = _env_int("RAY_TPU_BENCH_STEPS", steps)
+    accum = _env_int("RAY_TPU_BENCH_ACCUM", accum)
+    unroll = _env_int("RAY_TPU_BENCH_UNROLL", unroll)
+    prefetch = _env_int("RAY_TPU_BENCH_PREFETCH", prefetch)
+    interval = _env_int("RAY_TPU_BENCH_INTERVAL", interval)
+    warmup = max(unroll * ((warmup + unroll - 1) // unroll), unroll)
+    steps = max(unroll * (steps // unroll), unroll)
+
     mesh = MeshSpec(data=-1).build(devices)
-    state, step_fn, shard_tokens = spmd.make_gpt_trainer(cfg, mesh)
+    state, step_fn, _ = spmd.make_gpt_trainer(cfg, mesh, accum=accum)
 
-    rng = np.random.default_rng(0)
-    tokens = rng.integers(0, cfg.vocab_size,
-                          (batch_size, cfg.max_seq_len + 1), np.int32)
-    batch = shard_tokens({"inputs": tokens[:, :-1].copy(),
-                          "targets": tokens[:, 1:].copy()})
+    # Fresh host batch every step — the data plane the loop must hide.
+    def host_batches():
+        rng = np.random.default_rng(0)
+        while True:
+            toks = rng.integers(0, cfg.vocab_size,
+                                (batch_size, cfg.max_seq_len + 1),
+                                np.int32)
+            yield {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
 
-    for _ in range(warmup):
-        state, metrics = step_fn(state, batch)
-    # device_get (not just block_until_ready) so remote-tunnel backends
-    # can't report completion before execution finishes.
-    float(jax.device_get(metrics["loss"]))
+    place = loop.make_placer(mesh, stacked=unroll > 1)
+    batches = loop.DevicePrefetcher(host_batches(), place,
+                                    depth=prefetch, group=unroll)
+    train = loop.TrainLoop(step_fn, unroll=unroll,
+                           metrics_interval=interval)
+
+    # Warmup compiles the fused dispatch and fills the prefetch ring;
+    # drain() inside run() blocks until the device finishes, so the
+    # timed region starts on an idle device with transfers in flight.
+    state, metrics = train.run(state, batches, num_steps=warmup)
+    assert np.isfinite(metrics[-1]["loss"])
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch)
-    float(jax.device_get(metrics["loss"]))
+    state, metrics = train.run(state, batches, num_steps=steps)
+    # run() already drained the ring (a device_get of every pending
+    # dispatch), so execution — not just dispatch — is inside dt.
     dt = time.perf_counter() - t0
+    assert np.isfinite(metrics[-1]["loss"])
 
     tokens_per_step = batch_size * cfg.max_seq_len
     tok_s = tokens_per_step * steps / dt

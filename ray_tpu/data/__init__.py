@@ -3,7 +3,9 @@
 Counterpart of the reference's Ray Data (`python/ray/data/`, SURVEY.md
 §2.7): lazy logical plans, fused map stages over tasks/actor pools,
 two-phase exchanges for shuffle/sort/groupby, and `iter_batches` feeding
-`jax.device_put` for TPU ingest.
+`jax.device_put` for TPU ingest — `Dataset.iter_device_batches(mesh=...)`
+streams sharded device batches through the overlap-aware prefetcher in
+`ray_tpu/train/loop.py`.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata

@@ -213,6 +213,19 @@ class Dataset:
             local_shuffle_seed=local_shuffle_seed,
             prefetch_batches=prefetch_batches)
 
+    def iter_device_batches(self, *, mesh, batch_size: int | None = 256,
+                            prefetch: int = 2, group: int = 1,
+                            rules: dict | None = None,
+                            drop_last: bool = True, **kw) -> Iterator:
+        """`iter_batches` → train-loop bridge: numpy batches placed on
+        the mesh sharded over its data-like axes, `prefetch` transfers
+        ahead of the consumer (see ray_tpu/train/loop.py). group=u
+        stacks u batches per yield — the input of a fused multi-step
+        dispatch (`TrainLoop(unroll=u)`)."""
+        return DataIterator(self).iter_device_batches(
+            mesh=mesh, batch_size=batch_size, prefetch=prefetch,
+            group=group, rules=rules, drop_last=drop_last, **kw)
+
     def iterator(self) -> "DataIterator":
         return DataIterator(self)
 
@@ -393,6 +406,26 @@ class DataIterator:
 
     def iter_rows(self):
         return self._ds.iter_rows()
+
+    def iter_device_batches(self, *, mesh, batch_size: int | None = 256,
+                            prefetch: int = 2, group: int = 1,
+                            rules: dict | None = None,
+                            drop_last: bool = True, **kw) -> Iterator:
+        """Stream batches onto the mesh with host→device prefetch: each
+        numpy batch from `iter_batches` is `device_put` sharded
+        (batch→data-like axes) up to `prefetch` batches ahead, so
+        transfer overlaps the consumer's compute. drop_last defaults to
+        True — device batches must be shape-stable or every ragged tail
+        recompiles the step."""
+        from ray_tpu.train import loop as train_loop
+
+        host = self.iter_batches(batch_size=batch_size,
+                                 batch_format="numpy",
+                                 drop_last=drop_last, **kw)
+        place = train_loop.make_placer(mesh, rules=rules,
+                                       stacked=group > 1)
+        return train_loop.DevicePrefetcher(host, place, depth=prefetch,
+                                           group=group)
 
     def materialize(self):
         return self._ds.materialize()

@@ -25,7 +25,12 @@ class APPOConfig(IMPALAConfig):
         self.clip_param = 0.4            # reference appo.py default
         self.use_kl_loss = False
         self.kl_coeff = 1.0
-        self.lr = 5e-4
+        self.lr = 1e-3
+        # The clipped surrogate exists to make batch reuse safe (that is
+        # APPO's delta over IMPALA), so default to two SGD passes per
+        # learner batch (reference: appo.py replays via
+        # minibatch_buffer_size/num_sgd_iter on the learner thread).
+        self.num_sgd_iter = 2
 
 
 class APPO(IMPALA):

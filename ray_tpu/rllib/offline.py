@@ -326,36 +326,54 @@ def doubly_robust(batch: SampleBatch, target_logp: np.ndarray,
                   target_probs: np.ndarray,
                   q_model: FittedQEvaluation,
                   gamma: float = 1.0) -> dict:
-    """DR (Jiang & Li 2016; reference:
-    `offline/estimators/doubly_robust.py`): backward recursion
+    """Weighted doubly-robust estimation (WDR, Thomas & Brunskill 2016,
+    eqn 10; reference: `offline/estimators/doubly_robust.py`, which
+    likewise defaults to the self-normalized weights):
 
-        V_DR(t) = V̂(s_t) + ρ_t [r_t + γ V_DR(t+1) − Q̂(s_t, a_t)]
+        V_WDR = Σ_i Σ_t γ^t [ w_t^i r_t^i − w_t^i Q̂(s_t^i, a_t^i)
+                              + w_{t−1}^i V̂(s_t^i) ]
 
-    with per-step weight ρ_t = π(a_t|s_t)/β(a_t|s_t) — unbiased if
-    EITHER the model or the weights are right."""
+    where w_t^i = ρ_{0:t}^i / Σ_j ρ_{0:t}^j is the cumulative importance
+    weight of episode i self-normalized over the episodes still alive at
+    step t (w_{−1}^i = 1/n). Plain DR (per-step ρ, no normalization) is
+    unbiased if EITHER the model or the weights are right, but when the
+    model is wrong its correction term inherits the full variance of the
+    weights; self-normalizing trades a vanishing bias for a large
+    variance cut, so a wrong model degrades gracefully instead of
+    swinging the estimate."""
     behaviour_logp = np.asarray(batch[sb.ACTION_LOGP])
     obs = np.asarray(batch[sb.OBS])
     act = np.asarray(batch[sb.ACTIONS], np.int64)
     q_all = q_model.q_values(obs)
     v_all = (q_all * np.asarray(target_probs)).sum(-1)
     q_sa = np.take_along_axis(q_all, act[:, None], axis=-1)[:, 0]
-    vals, raw = [], []
+    eps, raw = [], []
     offset = 0
     for ep in _per_episode(batch):
         t = len(ep[sb.REWARDS])
         sl = slice(offset, offset + t)
-        rho = np.exp(target_logp[sl] - behaviour_logp[sl])
+        w = np.exp(np.cumsum(target_logp[sl] - behaviour_logp[sl]))
         r = np.asarray(ep[sb.REWARDS])
-        v_hat, q_hat = v_all[sl], q_sa[sl]
-        v_dr = 0.0
-        for i in range(t - 1, -1, -1):
-            v_dr = v_hat[i] + rho[i] * (r[i] + gamma * v_dr - q_hat[i])
-        vals.append(float(v_dr))
+        eps.append((w, r, v_all[sl], q_sa[sl]))
         raw.append(float(np.sum(gamma ** np.arange(t) * r)))
         offset += t
-    return {"v_target": float(np.mean(vals)),
+    n = len(eps)
+    max_t = max(len(w) for w, _, _, _ in eps)
+    norm = np.zeros(max_t)
+    for w, _, _, _ in eps:
+        norm[:len(w)] += w
+    norm = np.maximum(norm, 1e-8)
+    v_target = 0.0
+    for w, r, v_hat, q_hat in eps:
+        t = len(w)
+        disc = gamma ** np.arange(t)
+        wt = w / norm[:t]
+        wtm1 = np.concatenate([[1.0 / n], wt[:-1]])
+        v_target += float(np.sum(disc * (wt * r - wt * q_hat
+                                         + wtm1 * v_hat)))
+    return {"v_target": float(v_target),
             "v_behavior": float(np.mean(raw)),
-            "v_gain": float(np.mean(vals) / (np.mean(raw) + 1e-8))}
+            "v_gain": float(v_target / (np.mean(raw) + 1e-8))}
 
 
 def weighted_importance_sampling(batch: SampleBatch,

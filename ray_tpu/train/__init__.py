@@ -6,7 +6,7 @@ session API + checkpointing; the torch/NCCL backend seam
 + mesh SPMD.
 """
 
-from ray_tpu.train import session
+from ray_tpu.train import loop, session
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -36,7 +36,7 @@ __all__ = [
     "Predictor", "JaxPredictor", "BatchPredictor",
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
     "session", "report", "get_checkpoint", "get_dataset_shard",
-    "get_world_size", "get_world_rank", "get_mesh_spec",
+    "get_world_size", "get_world_rank", "get_mesh_spec", "loop",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

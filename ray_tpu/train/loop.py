@@ -1,0 +1,238 @@
+"""Overlapped training loop: host→device prefetch, fused multi-step
+dispatch, async metrics.
+
+The jitted step (train/spmd.py) is fast; what stalls real training is
+everything AROUND it: waiting on host→device transfer of the next batch,
+re-entering Python once per step to dispatch, and pulling metrics to the
+host after every step. The Podracer "sebulba" split (arXiv:2104.06272)
+wins TPU throughput by overlapping the host data feed with device compute
+and batching many steps per dispatch; this module is that loop for the
+SPMD trainers:
+
+  * `DevicePrefetcher` — keeps `depth` sharded `device_put` transfers in
+    flight ahead of the consumer, so DMA of batch N+1 rides under compute
+    of step N.
+  * `fuse_steps` / `TrainLoop(unroll=u)` — `lax.scan`s u steps into one
+    jitted dispatch with state donation: one Python round-trip and one
+    XLA launch per u steps.
+  * `MetricsRing` — device-side metric handles ride in a ring and are
+    fetched to host at most every `interval` steps, always from a
+    dispatch that is already `lag` dispatches old, so no step ever blocks
+    on a host sync.
+
+`ray_tpu.data.Dataset.iter_device_batches` bridges `iter_batches` into a
+`DevicePrefetcher`, and `bench.py` streams fresh host batches through the
+whole thing.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ray_tpu.parallel.sharding import logical_to_spec
+
+# Host-fetch seam: the ONLY place this module moves device values to the
+# host. Tests monkeypatch it to assert the no-per-step-sync property.
+_device_get = jax.device_get
+
+
+def make_placer(mesh: Mesh, rules: dict | None = None,
+                stacked: bool = False) -> Callable[[Any], Any]:
+    """Host-batch placement fn: leaves go to the mesh sharded over the
+    data-like axes on their leading dim (batch→data/fsdp), trailing dims
+    replicated. stacked=True expects a leading unroll/group axis ahead of
+    the batch dim (kept unsharded — it is the scan axis of a fused
+    multi-step dispatch)."""
+    spec = logical_to_spec(("batch",), rules, mesh)
+    lead = [None] if stacked else []
+
+    def place(tree):
+        def put(a):
+            dims = lead + list(spec)
+            full = PartitionSpec(*(dims + [None] * (a.ndim - len(dims))))
+            return jax.device_put(a, NamedSharding(mesh, full))
+        return jax.tree.map(put, tree)
+    return place
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device prefetcher (flax `prefetch_to_device`
+    idiom, sharding-aware).
+
+    Keeps `depth` transfers in flight: `device_put` of batch N+depth is
+    issued before batch N is consumed, and JAX transfers are async, so
+    host→device DMA overlaps device compute. Every yielded batch is a
+    FRESH device allocation — a yielded buffer is never re-filled or
+    re-yielded, so a consumer that donates batch buffers into its step
+    can never alias a transfer still in flight (donation-safe rotation);
+    rotation is the deque of in-flight batches, bounded at `depth`.
+
+    group=g stacks g host batches leaf-wise (leading [g, ...] axis)
+    before placing — the input shape of a fused multi-step dispatch
+    (`TrainLoop(unroll=g)`). A trailing ragged group is dropped.
+    """
+
+    def __init__(self, host_iter: Iterable, place: Callable[[Any], Any],
+                 *, depth: int = 2, group: int = 1):
+        self._host = iter(host_iter)
+        self._place = place
+        self._depth = max(1, int(depth))
+        self._group = max(1, int(group))
+        self._buf: collections.deque = collections.deque()
+        self.issued = 0         # transfers dispatched (observability)
+
+    def _next_host_batch(self):
+        if self._group == 1:
+            return next(self._host)
+        parts = list(itertools.islice(self._host, self._group))
+        if len(parts) < self._group:
+            raise StopIteration
+        return jax.tree.map(lambda *xs: np.stack(xs), *parts)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        while len(self._buf) < self._depth:
+            try:
+                self._buf.append(self._place(self._next_host_batch()))
+                self.issued += 1
+            except StopIteration:
+                break
+        if not self._buf:
+            raise StopIteration
+        return self._buf.popleft()
+
+
+class MetricsRing:
+    """Device-side metrics ring with bounded, lagged host fetches.
+
+    `push` stores the device pytree a dispatch returned (no sync);
+    entries are fetched to host at most every `interval` steps, and only
+    once they are at least `lag` dispatches old — by then the device has
+    long finished computing them (the loop has dispatched past them), so
+    the `device_get` returns without stalling the device queue. `drain`
+    fetches everything left (the one deliberate end-of-run sync).
+    """
+
+    def __init__(self, interval: int = 10, lag: int = 2):
+        self.interval = max(1, int(interval))
+        self.lag = max(0, int(lag))
+        self._pending: collections.deque = collections.deque()
+        self.history: list = []
+        self.fetches = 0        # host syncs performed (tests assert this)
+        self._steps_pushed = 0
+        self._last_sync = 0
+
+    def push(self, metrics, count: int = 1) -> None:
+        """Store one dispatch's device metrics (`count` = steps in the
+        dispatch; leaves carry a leading [count] axis when count > 1)."""
+        self._pending.append((count, metrics))
+        self._steps_pushed += count
+        if (self._steps_pushed - self._last_sync >= self.interval
+                and len(self._pending) > self.lag):
+            self._sync(keep=self.lag)
+            self._last_sync = self._steps_pushed
+
+    def _sync(self, keep: int) -> None:
+        """ONE host fetch covering every pending entry older than the
+        newest `keep` dispatches."""
+        take = len(self._pending) - keep
+        if take <= 0:
+            return
+        items = [self._pending.popleft() for _ in range(take)]
+        hosts = _device_get([m for _, m in items])
+        self.fetches += 1
+        for (count, _), host in zip(items, hosts):
+            if count == 1:
+                self.history.append(host)
+            else:
+                self.history.extend(
+                    jax.tree.map(lambda a, i=i: a[i], host)
+                    for i in range(count))
+
+    def drain(self) -> list:
+        self._sync(keep=0)
+        return self.history
+
+
+def fuse_steps(step_fn: Callable, unroll: int,
+               donate: bool = True) -> Callable:
+    """One jitted dispatch running `unroll` chained steps via lax.scan.
+
+    step_fn: (state, batch) -> (state, metrics); jitted is fine (the
+    inner pjit inlines under the outer trace). The fused call takes
+    batch leaves stacked [unroll, ...] and returns metrics stacked the
+    same way. State is donated across the dispatch, so param/opt
+    buffers update in place exactly as in the single-step path.
+    """
+    unroll = int(unroll)
+    if unroll < 1:
+        raise ValueError(f"unroll must be >= 1, got {unroll}")
+
+    def multi(state, stacked):
+        return jax.lax.scan(step_fn, state, stacked)
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(multi, **kwargs)
+
+
+class TrainLoop:
+    """Overlap-aware driver around a (state, batch) -> (state, metrics)
+    step.
+
+    Builds its dispatch once (so repeated `run` calls — warmup then the
+    timed region — hit the same jit cache): the step itself for
+    unroll=1, `fuse_steps(step_fn, unroll)` otherwise. Metrics go
+    through a `MetricsRing` (host fetch at most every
+    `metrics_interval` steps, `metrics_lag` dispatches behind); `run`
+    returns the drained per-step host metrics, so the only blocking
+    sync is at the very end of each run.
+    """
+
+    def __init__(self, step_fn: Callable, *, unroll: int = 1,
+                 metrics_interval: int = 10, metrics_lag: int = 2,
+                 donate: bool = True):
+        self.unroll = max(1, int(unroll))
+        self.metrics_interval = metrics_interval
+        self.metrics_lag = metrics_lag
+        self._dispatch = (step_fn if self.unroll == 1
+                          else fuse_steps(step_fn, self.unroll, donate))
+        self.last_ring: MetricsRing | None = None
+
+    def run(self, state, device_batches: Iterable,
+            num_steps: int | None = None):
+        """Drive steps until `num_steps` are dispatched (or the batch
+        iterator ends). `device_batches` yields one pytree per DISPATCH:
+        leaves [B, ...] for unroll=1, [unroll, B, ...] otherwise —
+        exactly what `DevicePrefetcher(group=unroll)` produces. Returns
+        (state, per-step host metrics list)."""
+        ring = MetricsRing(self.metrics_interval, self.metrics_lag)
+        self.last_ring = ring
+        done = 0
+        for batch in device_batches:
+            state, metrics = self._dispatch(state, batch)
+            ring.push(metrics, count=self.unroll)
+            done += self.unroll
+            if num_steps is not None and done >= num_steps:
+                break
+        return state, ring.drain()
+
+
+def run_steps(step_fn: Callable, state, device_batches: Iterable,
+              *, num_steps: int | None = None, unroll: int = 1,
+              metrics_interval: int = 10, metrics_lag: int = 2):
+    """One-shot convenience over `TrainLoop` (build + run). Prefer
+    holding a `TrainLoop` when calling more than once — each `run_steps`
+    call with unroll > 1 builds (and re-compiles) its own fused
+    dispatch."""
+    loop = TrainLoop(step_fn, unroll=unroll,
+                     metrics_interval=metrics_interval,
+                     metrics_lag=metrics_lag)
+    return loop.run(state, device_batches, num_steps=num_steps)

@@ -73,17 +73,75 @@ def create_sharded_state(init_fn: Callable[[jax.Array], Any],
 def make_train_step(loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     mesh: Mesh,
-                    donate: bool = True):
+                    donate: bool = True,
+                    accum: int = 1,
+                    rules: dict | None = None,
+                    jit: bool = True):
     """Build the jitted (state, batch) -> (state, metrics) step.
 
     loss_fn(params, batch) -> scalar loss. The batch is a pytree of global
     arrays sharded over the data-like axes; gradient synchronization is
     implicit (jit sees replicated params + sharded batch and inserts the
     reduce). Donation reuses param/opt-state HBM buffers in place.
+
+    accum=k splits the batch's leading axis into k microbatches and
+    `lax.scan`s value_and_grad over them, keeping a running f32 mean of
+    loss and grads, then applies ONE optimizer update — peak activation
+    memory is that of a single microbatch, so effective batch sizes grow
+    k-fold beyond what fits in HBM at once. Each microbatch keeps the
+    batch sharding over the data-like mesh axes (the leading k axis is
+    the scan axis, unsharded). accum=k matches accum=1 on the same batch
+    up to summation-order float error (~1e-6 f32); with a padding mask
+    the per-microbatch normalization means exact parity only holds when
+    mask counts are equal across microbatches.
     """
+    accum = int(accum)
+    if accum < 1:
+        raise ValueError(f"accum must be >= 1, got {accum}")
+    micro_spec = logical_to_spec(("batch",), rules, mesh)
+
+    def split_micro(batch):
+        def rs(a):
+            if a.shape[0] % accum:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} not divisible by "
+                    f"accum={accum}")
+            a = a.reshape(accum, a.shape[0] // accum, *a.shape[1:])
+            spec = PartitionSpec(
+                None, *(list(micro_spec) + [None] * (a.ndim - 2)))
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec))
+        return jax.tree.map(rs, batch)
+
+    def value_and_mean_grad(params, batch):
+        if accum == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        def micro_step(carry, mb):
+            i, loss_mean, gmean = carry
+            loss, g = jax.value_and_grad(loss_fn)(params, mb)
+            # running mean in f32 regardless of param/grad dtype: the
+            # k-th increment is (x_k - mean)/k, so bf16 grads never
+            # accumulate in their own (3-bit-mantissa-per-step) dtype
+            inv = 1.0 / (i + 1.0)
+            loss_mean = loss_mean + (loss.astype(jnp.float32)
+                                     - loss_mean) * inv
+            gmean = jax.tree.map(
+                lambda m, x: m + (x.astype(jnp.float32) - m) * inv,
+                gmean, g)
+            return (i + 1.0, loss_mean, gmean), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (_, loss, gmean), _ = jax.lax.scan(
+            micro_step, (jnp.zeros(()), jnp.zeros(()), zeros),
+            split_micro(batch))
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                             gmean, params)
+        return loss, grads
 
     def step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        loss, grads = value_and_mean_grad(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -92,6 +150,8 @@ def make_train_step(loss_fn: Callable,
         return new_state, {"loss": loss, "grad_norm": gnorm,
                            "step": new_state.step}
 
+    if not jit:
+        return step
     kwargs = {"donate_argnums": (0,)} if donate else {}
     return jax.jit(step, **kwargs)
 
@@ -140,19 +200,20 @@ def gpt_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
 
 def make_gpt_trainer(cfg, mesh: Mesh, rng=None,
                      optimizer: optax.GradientTransformation | None = None,
-                     rules: dict | None = None):
+                     rules: dict | None = None, accum: int = 1):
     """One-call assembly: sharded state + jitted step + batch sharding.
 
     Returns (state, step_fn, batch_sharding_fn). batch_sharding_fn places a
     host batch {"inputs","targets"} [B,T] onto the mesh sharded
-    (batch→data/fsdp, length→seq).
+    (batch→data/fsdp, length→seq). accum=k makes the step accumulate
+    gradients over k microbatches (see make_train_step).
     """
     from ray_tpu.models import gpt
 
     return _make_lm_trainer(
         lambda key: gpt.init_params(key, cfg), gpt.param_logical_axes(cfg),
         partial(gpt_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
-        rules)
+        rules, accum=accum)
 
 
 def moe_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
@@ -173,13 +234,14 @@ def moe_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
 
 
 def _make_lm_trainer(init_fn, logical_axes, loss_fn, mesh: Mesh, rng,
-                     optimizer, rules):
+                     optimizer, rules, accum: int = 1):
     """Shared assembly behind make_gpt_trainer / make_moe_trainer."""
     rng = jax.random.key(0) if rng is None else rng
     optimizer = optimizer or default_optimizer()
     state, _ = create_sharded_state(
         init_fn, logical_axes, mesh, rng, optimizer, rules)
-    step_fn = make_train_step(loss_fn, optimizer, mesh)
+    step_fn = make_train_step(loss_fn, optimizer, mesh, accum=accum,
+                              rules=rules)
 
     tok_spec = logical_to_spec(("batch", "length"), rules, mesh)
     tok_sharding = NamedSharding(mesh, tok_spec)
@@ -248,7 +310,7 @@ def make_gpt_pipeline_trainer(cfg, mesh: Mesh, num_microbatches: int = 2,
 
 def make_moe_trainer(cfg, mesh: Mesh, rng=None,
                      optimizer: optax.GradientTransformation | None = None,
-                     rules: dict | None = None):
+                     rules: dict | None = None, accum: int = 1):
     """MoE assembly: expert weights shard over the mesh's `expert` axis,
     so the dispatch/combine einsums lower to all-to-alls over ICI."""
     from ray_tpu.models import moe
@@ -256,7 +318,7 @@ def make_moe_trainer(cfg, mesh: Mesh, rng=None,
     return _make_lm_trainer(
         lambda key: moe.init_params(key, cfg), moe.param_logical_axes(cfg),
         partial(moe_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
-        rules)
+        rules, accum=accum)
 
 
 def train_flops_per_token(cfg, seq_len: int) -> float:

@@ -1,0 +1,27 @@
+"""Tier-1 bench smoke: `bench.main()` end-to-end in CPU mode through the
+overlapped loop (prefetch + accum + fused dispatch + metrics ring), so
+bench breakage is caught here instead of on silicon. Asserts the one-line
+JSON contract the driver scrapes.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_bench_cpu_smoke(capsys, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BENCH_STEPS", "4")   # keep CI fast
+    import bench
+
+    bench.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "gpt_train_tokens_per_sec"
+    assert rec["unit"] == "tokens/s"
+    assert np.isfinite(rec["value"]) and rec["value"] > 0
+    assert rec["vs_baseline"] == 0.0        # CPU mode reports no MFU ratio

@@ -1,0 +1,250 @@
+"""Overlapped training loop (ray_tpu/train/loop.py + spmd accum):
+accumulation parity, prefetcher ordering/donation under buffer rotation,
+fused-dispatch unroll parity, and the no-per-step-host-sync property.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt
+from ray_tpu.parallel import MeshSpec
+from ray_tpu.train import loop, spmd
+
+
+def _tiny(**kw):
+    return gpt.small(**{**dict(vocab_size=128, d_model=32, n_layers=1,
+                               n_heads=2, d_ff=64, max_seq_len=16), **kw})
+
+
+def _trainer_pieces(cfg, mesh, accum, donate=False):
+    opt = spmd.default_optimizer()
+    loss = partial(spmd.gpt_loss_fn, cfg=cfg, mesh=mesh)
+    state, _ = spmd.create_sharded_state(
+        lambda k: gpt.init_params(k, cfg), gpt.param_logical_axes(cfg),
+        mesh, jax.random.key(0), opt)
+    step = spmd.make_train_step(loss, opt, mesh, donate=donate,
+                                accum=accum)
+    return state, step
+
+
+def _tokens(cfg, b, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, (b, cfg.max_seq_len + 1),
+                        np.int32)
+    return {"inputs": toks[:, :-1].copy(), "targets": toks[:, 1:].copy()}
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 1e-5),
+                                       ("bfloat16", 1e-2)])
+def test_accum_matches_single_step(dtype, tol):
+    """accum=4 on one [8, T] batch == accum=1 on the same batch: same
+    loss (>= 4 decimals for f32) and same updated params — the scan over
+    microbatches with a running f32 mean is the identical update."""
+    cfg = _tiny(dtype=dtype)
+    mesh = MeshSpec(data=-1).build()
+    state1, step1 = _trainer_pieces(cfg, mesh, accum=1)
+    state4, step4 = _trainer_pieces(cfg, mesh, accum=4)
+    batch = loop.make_placer(mesh)(_tokens(cfg, 8))
+
+    for _ in range(2):      # two steps so opt-state divergence would show
+        state1, m1 = step1(state1, batch)
+        state4, m4 = step4(state4, batch)
+        l1, l4 = float(m1["loss"]), float(m4["loss"])
+        assert l1 == pytest.approx(l4, abs=tol), (l1, l4)
+        assert float(m1["grad_norm"]) == pytest.approx(
+            float(m4["grad_norm"]), rel=tol)
+    for a, b in zip(jax.tree.leaves(state1.params),
+                    jax.tree.leaves(state4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=tol, rtol=tol)
+
+
+def test_accum_rejects_indivisible_batch():
+    cfg = _tiny()
+    mesh = MeshSpec(data=1, fsdp=1).build(jax.devices()[:1])
+    _, step = _trainer_pieces(cfg, mesh, accum=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(_trainer_pieces(cfg, mesh, accum=1)[0],
+             loop.make_placer(mesh)(_tokens(cfg, 8)))
+
+
+# ---------------------------------------------------------------------------
+# prefetcher
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_ordering_and_donation_safety():
+    """Yielded batches arrive in host order with fresh buffers each time:
+    a consumer that DONATES its batch into a jitted step (buffers deleted
+    after the call) never corrupts later prefetched batches, because the
+    rotation never re-yields or re-fills a buffer."""
+    mesh = MeshSpec(data=-1).build()
+    place = loop.make_placer(mesh)
+
+    def host():
+        for i in range(7):
+            yield {"x": np.full((8, 4), i, np.float32)}
+
+    pf = loop.DevicePrefetcher(host(), place, depth=3)
+    bump = jax.jit(lambda b: jax.tree.map(lambda a: a + 1, b),
+                   donate_argnums=(0,))
+    first = next(pf)
+    assert pf.issued == 3           # depth transfers in flight ahead
+    out = bump(first)               # donates first's buffers
+    assert float(np.asarray(out["x"])[0, 0]) == 1.0
+    with pytest.raises(RuntimeError):
+        np.asarray(first["x"])      # donated buffer really is gone
+    for i, b in enumerate(pf, start=1):
+        assert float(np.asarray(b["x"])[0, 0]) == i     # order intact
+        bump(b)
+    assert pf.issued == 7
+
+
+def test_prefetcher_group_stacks_and_drops_ragged_tail():
+    mesh = MeshSpec(data=-1).build()
+    place = loop.make_placer(mesh, stacked=True)
+
+    def host():
+        for i in range(5):
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    got = list(loop.DevicePrefetcher(host(), place, depth=2, group=2))
+    assert len(got) == 2            # 5 host batches -> 2 groups, tail dropped
+    for j, g in enumerate(got):
+        assert g["x"].shape == (2, 8, 2)
+        np.testing.assert_array_equal(
+            np.asarray(g["x"])[:, 0, 0], [2 * j, 2 * j + 1])
+
+
+def test_dataset_iter_device_batches_bridge(ray_session):
+    """ray_tpu.data → loop bridge: numpy batches land on the mesh sharded
+    over the data-like axes, in dataset order."""
+    from ray_tpu import data as rdata
+
+    mesh = MeshSpec(data=-1).build()
+    ds = rdata.from_items([{"x": float(i)} for i in range(64)])
+    out = list(ds.iter_device_batches(mesh=mesh, batch_size=16))
+    assert len(out) == 4
+    for b in out:
+        assert isinstance(b["x"], jax.Array)
+        assert b["x"].sharding.spec[0] == ("data", "fsdp")
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["x"]) for b in out]),
+        np.arange(64, dtype=np.float64))
+
+
+# ---------------------------------------------------------------------------
+# fused multi-step dispatch
+# ---------------------------------------------------------------------------
+
+def test_unroll_parity_with_step_at_a_time():
+    """One fused dispatch of 4 steps == 4 single-step dispatches over the
+    same batch sequence: identical per-step losses and final params."""
+    cfg = _tiny()
+    mesh = MeshSpec(data=-1).build()
+    state_a, step = _trainer_pieces(cfg, mesh, accum=1)
+    state_b, _ = _trainer_pieces(cfg, mesh, accum=1)
+    host = [_tokens(cfg, 8, seed=s) for s in range(4)]
+    place = loop.make_placer(mesh)
+
+    losses_a = []
+    for hb in host:
+        state_a, m = step(state_a, place(hb))
+        losses_a.append(float(m["loss"]))
+
+    multi = loop.fuse_steps(step, unroll=4, donate=False)
+    stacked = loop.make_placer(mesh, stacked=True)(
+        jax.tree.map(lambda *xs: np.stack(xs), *host))
+    state_b, ms = multi(state_b, stacked)
+
+    np.testing.assert_allclose(np.asarray(ms["loss"]), losses_a,
+                               atol=1e-5)
+    assert list(np.asarray(ms["step"])) == [1, 2, 3, 4]
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_train_loop_end_to_end_with_prefetch_and_accum():
+    """TrainLoop + DevicePrefetcher(group=unroll) + accum: 8 real GPT
+    steps in 4 dispatches, metrics arrive per-step and in order."""
+    cfg = _tiny()
+    mesh = MeshSpec(data=-1).build()
+    state, step = _trainer_pieces(cfg, mesh, accum=2, donate=True)
+
+    def host():
+        s = 0
+        while True:
+            yield _tokens(cfg, 8, seed=s)
+            s += 1
+
+    pf = loop.DevicePrefetcher(host(), loop.make_placer(mesh,
+                                                        stacked=True),
+                               depth=2, group=2)
+    tl = loop.TrainLoop(step, unroll=2, metrics_interval=3)
+    state, metrics = tl.run(state, pf, num_steps=8)
+    assert len(metrics) == 8
+    assert [int(m["step"]) for m in metrics] == list(range(1, 9))
+    assert all(np.isfinite(m["loss"]) for m in metrics)
+    assert int(state.step) == 8
+
+
+# ---------------------------------------------------------------------------
+# async metrics ring
+# ---------------------------------------------------------------------------
+
+def test_no_per_step_host_sync(monkeypatch):
+    """20 steps at metrics_interval=5 cost at most 20/5 + 1 host fetches
+    — the loop's ONLY device→host seam is loop._device_get, so counting
+    it bounds every sync in the steady-state path."""
+    calls = {"n": 0}
+    real = loop._device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(loop, "_device_get", counting)
+    mesh = MeshSpec(data=-1).build()
+
+    def host():
+        for i in range(20):
+            yield {"x": np.full((8,), float(i), np.float32)}
+
+    step = jax.jit(lambda s, b: (s + 1, {"loss": b["x"].mean(), "i": s}))
+    tl = loop.TrainLoop(step, unroll=1, metrics_interval=5,
+                        metrics_lag=2)
+    state, hist = tl.run(jnp.zeros((), jnp.int32),
+                         loop.DevicePrefetcher(host(),
+                                               loop.make_placer(mesh)),
+                         num_steps=20)
+    assert len(hist) == 20
+    assert [float(m["loss"]) for m in hist] == [float(i)
+                                                for i in range(20)]
+    assert tl.last_ring.fetches == calls["n"]
+    assert calls["n"] <= 20 // 5 + 1
+
+
+def test_metrics_ring_interval_and_lag():
+    ring = loop.MetricsRing(interval=4, lag=1)
+    for i in range(10):
+        ring.push(jnp.asarray(float(i)))
+    assert ring.fetches <= 10 // 4 + 1      # lagged, batched syncs
+    hist = ring.drain()
+    assert [float(h) for h in hist] == [float(i) for i in range(10)]
+
+
+def test_metrics_ring_unstacks_fused_dispatch():
+    ring = loop.MetricsRing(interval=100, lag=0)
+    ring.push({"loss": jnp.asarray([0.0, 1.0, 2.0])}, count=3)
+    hist = ring.drain()
+    assert [float(h["loss"]) for h in hist] == [0.0, 1.0, 2.0]
+    assert ring.fetches == 1
