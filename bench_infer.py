@@ -1,0 +1,147 @@
+"""Inference benchmark: autoregressive decode throughput through the
+continuous-batching engine (ray_tpu/serve/engine.py).
+
+Prints ONE JSON line. Headline fields follow bench.py's contract
+({"metric", "value", "unit", "vs_baseline"}); the inference-specific
+extras ride alongside:
+
+  prefill_tokens_per_sec   prompt tokens absorbed per second (bucketized
+                           full-sequence forward, cache write included)
+  decode_tokens_per_sec    generated tokens per second across all slots
+                           (the headline `value`)
+  p50_token_latency_ms     per-decode-step wall latency percentiles —
+  p99_token_latency_ms     each step emits one token per resident slot,
+                           so this IS per-token latency for a stream
+  slot_occupancy           mean fraction of cache slots resident over
+                           the timed region (continuous batching's job
+                           is to keep this near 1.0)
+
+Knobs (env vars, platform-tuned defaults in main()):
+  RAY_TPU_INFER_BENCH_SLOTS     resident decode slots (cache batch)
+  RAY_TPU_INFER_BENCH_MAX_LEN   per-slot cache capacity
+  RAY_TPU_INFER_BENCH_PROMPT    prompt length per request
+  RAY_TPU_INFER_BENCH_NEW       generated tokens per request
+  RAY_TPU_INFER_BENCH_REQUESTS  total requests in the timed region
+
+Baseline: single-token decode is HBM-bandwidth-bound — every step
+streams the full parameter set plus the live KV prefix through the chip
+regardless of batch. `vs_baseline` is measured decode tokens/s divided
+by the bandwidth-roofline tokens/s (params + mean live cache bytes per
+step, slots tokens per step, chip HBM bandwidth from the table below):
+1.0 means decode runs at memory speed; the gap is dispatch + compute +
+unfused overhead. CPU smoke reports 0.0, as in bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+# HBM bandwidth per chip, bytes/s, by device kind substring (same probe
+# idiom as bench.py's _PEAK_FLOPS).
+_HBM_BW = (
+    ("v6", 1638e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def hbm_bandwidth(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _HBM_BW:
+        if key in kind:
+            return val
+    return 819e9
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def decode_roofline_tokens_per_sec(cfg, slots: int, mean_ctx: float,
+                                   device) -> float:
+    """Bandwidth-bound decode ceiling: one step reads all params once
+    plus each slot's live K/V prefix, and emits `slots` tokens."""
+    # param count straight from config (no tracing needed):
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    n_params = v * d + cfg.max_seq_len * d + d + L * (
+        2 * d + 4 * d * d + 3 * d * f)
+    bpe = 2 if "bfloat16" in cfg.dtype else 4
+    kv_bytes = slots * mean_ctx * 2 * cfg.n_heads * cfg.head_dim * bpe
+    bytes_per_step = n_params * bpe + kv_bytes
+    return hbm_bandwidth(device) * slots / bytes_per_step
+
+
+def main():
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import InferenceEngine
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        cfg = gpt.GPTConfig(vocab_size=50304, d_model=1024, n_layers=12,
+                            n_heads=16, d_ff=4096, max_seq_len=1024)
+        slots, max_len, prompt_len, new_tokens, requests = \
+            8, 1024, 128, 128, 32
+    else:   # CPU smoke mode — the full engine path on a toy model.
+        cfg = gpt.small(n_layers=1, max_seq_len=64, d_model=64,
+                        d_ff=256, n_heads=2, vocab_size=256)
+        slots, max_len, prompt_len, new_tokens, requests = 2, 32, 6, 4, 4
+
+    slots = _env_int("RAY_TPU_INFER_BENCH_SLOTS", slots)
+    max_len = _env_int("RAY_TPU_INFER_BENCH_MAX_LEN", max_len)
+    prompt_len = _env_int("RAY_TPU_INFER_BENCH_PROMPT", prompt_len)
+    new_tokens = _env_int("RAY_TPU_INFER_BENCH_NEW", new_tokens)
+    requests = _env_int("RAY_TPU_INFER_BENCH_REQUESTS", requests)
+    if prompt_len + new_tokens > max_len:
+        raise SystemExit("PROMPT + NEW must fit in MAX_LEN")
+
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, slots=slots, max_len=max_len)
+    rng = np.random.default_rng(0)
+
+    def submit(n):
+        for _ in range(n):
+            engine.submit(rng.integers(0, cfg.vocab_size, prompt_len),
+                          max_new_tokens=new_tokens)
+
+    # Warmup: compiles the prompt bucket's prefill and the (single)
+    # decode executable, then drops compile time from the accounting.
+    submit(min(requests, slots))
+    engine.run_until_idle()
+    engine.reset_stats()
+
+    submit(requests)
+    engine.run_until_idle()
+    s = engine.stats()
+    assert s["decode_traces"] == 1, "decode recompiled mid-bench"
+
+    prefill_tok_s = s["prefill_tokens"] / max(s["prefill_time_s"], 1e-9)
+    decode_tok_s = s["decode_tokens"] / max(s["decode_time_s"], 1e-9)
+    mean_ctx = prompt_len + new_tokens / 2
+    vs_baseline = (decode_tok_s / decode_roofline_tokens_per_sec(
+        cfg, slots, mean_ctx, devices[0])) if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "gpt_decode_tokens_per_sec",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+        "decode_tokens_per_sec": round(decode_tok_s, 1),
+        "p50_token_latency_ms": round(s["p50_token_latency_ms"], 3),
+        "p99_token_latency_ms": round(s["p99_token_latency_ms"], 3),
+        "slot_occupancy": round(s["slot_occupancy"], 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,11 @@ class GPTConfig:
     # is [B, T, loss_chunk]; smaller chunks mean less live memory and
     # more loop steps.
     loss_chunk: int = 512
+    # Attention implementation for single-token decode over the KV cache
+    # (decode_step). "auto" picks the Pallas decode kernel on TPU and the
+    # pure-JAX fallback elsewhere; both share the same math
+    # (ops/decode_attention.py).
+    decode_attn_impl: str = "auto"   # auto | pallas | jax
 
     @property
     def head_dim(self) -> int:
@@ -175,9 +180,11 @@ def _attention(q, k, v, cfg: GPTConfig, mesh: Mesh | None):
     return checkpoint_name(out, "attn_out")
 
 
-def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
+def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None, with_kv: bool = False):
     """One transformer block. x: [B, T, D] activations in cfg.dtype;
-    lp: this layer's param slice (f32, cast here)."""
+    lp: this layer's param slice (f32, cast here). With ``with_kv`` the
+    block also returns this layer's (k, v) [B, T, H, Dh] — exactly what a
+    KV cache stores — so prefill reuses the training forward verbatim."""
     adt = cfg.activation_dtype()
     pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
     b, t, d = x.shape
@@ -206,20 +213,34 @@ def _block(x, lp, cfg: GPTConfig, mesh: Mesh | None):
     ff = jax.nn.silu(gate) * up
     down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(adt),
                       preferred_element_type=pet).astype(adt)
+    if with_kv:
+        return x + down, (k, v)
     return x + down
 
 
 def forward_features(params, tokens, cfg: GPTConfig,
-                     mesh: Mesh | None = None):
+                     mesh: Mesh | None = None, *, with_kv: bool = False):
     """tokens [B, T] int32 -> final-norm activations [B, T, d_model] in
     cfg.dtype — everything except the unembed matmul. The fused loss
-    consumes these directly so [B, T, vocab] logits never exist."""
+    consumes these directly so [B, T, vocab] logits never exist.
+
+    With ``with_kv`` (the prefill path) additionally returns the
+    per-layer attention keys/values stacked over layers:
+    ``(x, (k [L, B, T, H, Dh], v [L, B, T, H, Dh]))`` — the scan's ys
+    stacking produces the KV-cache layout directly. No remat is applied
+    in this mode (prefill has no backward pass to save memory for)."""
     adt = cfg.activation_dtype()
     t = tokens.shape[1]
     x = params["embed"].astype(adt)[tokens]
     x = x + params["pos_embed"].astype(adt)[:t][None]
 
     block = partial(_block, cfg=cfg, mesh=mesh)
+    if with_kv:
+        def scan_body_kv(x, lp):
+            return block(x, lp, with_kv=True)
+
+        x, kv = jax.lax.scan(scan_body_kv, x, params["layers"])
+        return _rms_norm(x, params["final_ln_scale"].astype(adt)), kv
     if cfg.remat:
         # Measured on v5e (B=16, T=1024 bench shape): save-nothing beats
         # save_only_these_names("attn_out") and no remat — the recomputed
@@ -284,6 +305,155 @@ def loss_fn(params, batch, cfg: GPTConfig, mesh: Mesh | None = None):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# autoregressive inference: KV cache, prefill, single-token decode
+# ---------------------------------------------------------------------------
+# The Podracer recipe (Hessel et al., 2104.06272) applied to serving: device
+# shapes are static and resident. The cache is allocated ONCE at
+# [L, slots, max_len, H, Dh]; sequences stream through fixed slots
+# (serve/engine.py), so prefill compiles once per length bucket and
+# decode_step compiles exactly once for the engine's lifetime.
+
+def kv_cache_logical_axes():
+    """Logical-axis tuples for the KV cache pytree (layer stack and cache
+    length replicated; batch over the data axes, heads tensor-parallel —
+    matching the wq/wk/wv column split, so each tensor shard owns its own
+    heads' cache rows)."""
+    axes = (None, "batch", None, "heads", None)
+    return {"k": axes, "v": axes}
+
+
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: int,
+                  mesh: Mesh | None = None):
+    """Preallocated ring cache {"k", "v"} of [L, batch, max_len, H, Dh]
+    in cfg.dtype, zero-filled, placed with its sharding annotation when a
+    mesh is given. `batch` is the number of resident decode slots, NOT a
+    per-request batch — the engine multiplexes requests into it."""
+    if max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"max_len {max_len} exceeds cfg.max_seq_len "
+            f"{cfg.max_seq_len} (pos_embed table size)")
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    cache = {"k": jnp.zeros(shape, cfg.activation_dtype()),
+             "v": jnp.zeros(shape, cfg.activation_dtype())}
+    if mesh is not None:
+        from ray_tpu.parallel.sharding import kv_cache_shardings
+        sh = kv_cache_shardings(mesh)
+        cache = {name: jax.device_put(arr, sh[name])
+                 for name, arr in cache.items()}
+    return cache
+
+
+def prefill(params, tokens, cache, cfg: GPTConfig,
+            mesh: Mesh | None = None, *, lengths=None, slot=None):
+    """Process prompt tokens in one full-sequence forward, write their
+    K/V into the cache, and return ``(last_logits [B, vocab] f32,
+    cache)`` — the [B, T, vocab] logits tensor is never materialized
+    (only the last/`lengths-1` position is unembedded).
+
+    tokens: [B, T] int32, right-padded to the bucket length. `lengths`
+    [B] gives each row's true prompt length (defaults to T); under causal
+    attention right-padding cannot influence positions < length, and the
+    pad garbage written to the cache tail is masked away by decode's
+    position mask.
+
+    `slot` (traced scalar ok): tokens must then be [1, T] and the
+    sequence lands in cache row `slot` — the continuous-batching
+    admission path, which therefore never retraces per slot. Without
+    `slot`, tokens rows map 1:1 onto cache rows."""
+    b, t = tokens.shape
+    cache_b = cache["k"].shape[1]
+    if slot is None and b != cache_b:
+        raise ValueError(
+            f"prefill batch {b} != cache slots {cache_b}; pass slot= to "
+            "target one slot")
+    if slot is not None and b != 1:
+        raise ValueError(f"slot-targeted prefill wants tokens [1, T], "
+                         f"got batch {b}")
+    if t > cache["k"].shape[2]:
+        raise ValueError(
+            f"prompt length {t} exceeds cache max_len "
+            f"{cache['k'].shape[2]}")
+    x, (ks, vs) = forward_features(params, tokens, cfg, mesh,
+                                   with_kv=True)
+    if lengths is None:
+        last = x[:, -1]
+    else:
+        last = jnp.take_along_axis(
+            x, (lengths.astype(jnp.int32) - 1)[:, None, None], axis=1
+        )[:, 0]
+    logits = jnp.einsum(
+        "bd,vd->bv", last, params["embed"].astype(cfg.activation_dtype()),
+        preferred_element_type=jnp.float32)
+    start = (0, 0 if slot is None else slot, 0, 0, 0)
+    dt = cache["k"].dtype
+    cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(dt),
+                                          start),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(dt),
+                                          start),
+    }
+    return logits, cache
+
+
+def decode_step(params, tokens, cache, pos, cfg: GPTConfig,
+                mesh: Mesh | None = None):
+    """One autoregressive step for every cache slot: ``tokens [B]`` int32
+    (each slot's current token) at positions ``pos [B]`` int32. Writes
+    each token's K/V at ``pos`` and attends over cache positions
+    ``<= pos``, so no prefix is ever re-run. Returns
+    ``(logits [B, vocab] f32, cache)``.
+
+    All shapes are static — B is the slot count, the cache length is the
+    preallocated max — so the engine's jitted wrapper compiles exactly
+    once. Donate the cache argument at the jit boundary: XLA then aliases
+    the cache in/out and the update is in-place in HBM."""
+    from ray_tpu.ops.decode_attention import decode_attention
+    adt = cfg.activation_dtype()
+    pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
+    b = tokens.shape[0]
+    nh, hd = cfg.n_heads, cfg.head_dim
+    rows = jnp.arange(b)
+    pos = pos.astype(jnp.int32)
+    x = params["embed"].astype(adt)[tokens]
+    x = x + params["pos_embed"].astype(adt)[pos]
+
+    def body(x, layer):
+        lp, kc, vc = layer                      # kc/vc [B, S, H, Dh]
+        h = _rms_norm(x, lp["ln1_scale"].astype(adt))
+        q = jnp.einsum("bd,dh->bh", h, lp["wq"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        k = jnp.einsum("bd,dh->bh", h, lp["wk"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        v = jnp.einsum("bd,dh->bh", h, lp["wv"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        q = q.reshape(b, nh, hd)
+        kc = kc.at[rows, pos].set(k.reshape(b, nh, hd).astype(kc.dtype))
+        vc = vc.at[rows, pos].set(v.reshape(b, nh, hd).astype(vc.dtype))
+        att = decode_attention(q, kc, vc, pos,
+                               impl=cfg.decode_attn_impl)
+        att = jnp.einsum("bh,hd->bd", att.reshape(b, nh * hd),
+                         lp["wo"].astype(adt),
+                         preferred_element_type=pet).astype(adt)
+        x = x + att
+        h = _rms_norm(x, lp["ln2_scale"].astype(adt))
+        up = jnp.einsum("bd,df->bf", h, lp["w_up"].astype(adt),
+                        preferred_element_type=pet).astype(adt)
+        gate = jnp.einsum("bd,df->bf", h, lp["w_gate"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        ff = jax.nn.silu(gate) * up
+        down = jnp.einsum("bf,fd->bd", ff, lp["w_down"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        return x + down, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
 
 
 def num_params(params) -> int:

@@ -1,0 +1,203 @@
+"""Single-token decode attention over a resident KV cache — Pallas TPU
+kernel plus a pure-JAX fallback with identical math.
+
+The autoregressive hot path: one new query per sequence attends over that
+sequence's cached keys/values. There is no O(T^2) score matrix here — per
+(batch, head) the work is a [1, D] x [D, S] matvec — so the op is purely
+HBM-bandwidth-bound (arithmetic intensity ~1 flop/byte). What the kernel
+buys over the XLA fallback is the same thing flash_attention buys the
+training path: the masked scores, softmax statistics and weighted sum all
+live in VMEM while K/V blocks stream through, so the [B, H, S] score
+tensor is never written to HBM and the per-position mask costs no extra
+pass.
+
+Structure mirrors `ops/flash_attention.py`: grid (B*H, S/block_kv) with
+the kv dimension innermost/sequential, per-row running (m, l, acc)
+softmax statistics in VMEM scratch, finalize on the last kv block. Two
+decode-specific twists:
+
+- **position masking**: each sequence attends to cache positions
+  ``<= pos[b]`` (its current token's position — the caller writes the new
+  K/V at ``pos`` *before* attending). ``pos`` rides in as a per-row
+  [BH, 128] i32 tile (the fused_xent `_rows128` idiom).
+- **data-dependent block skip**: kv blocks strictly past ``pos`` are
+  predicated away with ``pl.when(k_start <= pos)`` — a *runtime* branch,
+  unlike flash's static causal predicate — so short sequences in a long
+  preallocated cache don't pay for the empty tail.
+
+Layout: the public cache layout is ``[B, S, H, D]`` (matching
+`models.gpt.init_kv_cache`'s ``[L, B, S, H, D]``); the kernel wants
+(S, D) as the trailing tile per (b, h), so the wrapper transposes K/V to
+``[B*H, S, D]`` on entry. The fallback consumes ``[B, S, H, D]``
+directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.flash_attention import (
+    _CompilerParams,
+    _head_pad_target,
+    _pad_heads,
+    _pick_block,
+)
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# pure-JAX fallback (the everywhere-correct path; CPU/CI default)
+# ---------------------------------------------------------------------------
+
+def reference_decode_attention(q, k, v, pos):
+    """q [B, H, D]; k, v [B, S, H, D]; pos [B] i32. Attends to cache
+    positions <= pos[b] and returns [B, H, D] in q.dtype. Accumulation is
+    f32 regardless of input dtype (same contract as the kernel)."""
+    b, s, h, d = k.shape
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    live = jnp.arange(s, dtype=jnp.int32)[None, None, :] <= \
+        pos.astype(jnp.int32)[:, None, None]
+    scores = jnp.where(live, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(q_ref, k_ref, v_ref, pos_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale: float,
+                   block_kv: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[0, 0]
+    k_start = ki * block_kv
+
+    # Runtime predicate: blocks wholly past this row's position contribute
+    # nothing — skip them (pos is data, so this is a dynamic branch, not
+    # flash's static causal one).
+    @pl.when(k_start <= pos)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [1, D]
+        k = k_ref[0].astype(jnp.float32)            # [bkv, D]
+        s = jax.lax.dot_general(
+            q * sm_scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, bkv]
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(col <= pos, s, NEG_INF)
+        m_prev = m_scr[:1, :1]                      # [1, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                      # [1, bkv]
+        l_scr[:1, :1] = l_scr[:1, :1] * corr + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scr[:1, :1] = m_new
+        v = v_ref[0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, D]
+        acc_scr[:1] = acc_scr[:1] * corr + pv
+
+    # Finalize unconditionally at the last block: the last kv block may
+    # itself be dead (pos early in the cache), but the output write must
+    # still happen (flash's _finalize structure).
+    @pl.when(ki == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:1] / l_scr[:1, :1]).astype(o_ref.dtype)
+
+
+def _decode_bhsd(q, k, v, pos, *, sm_scale: float, block_kv: int,
+                 interpret: bool):
+    """q [BH, 1, D]; k, v [BH, S, D]; pos [BH, 128] i32 -> [BH, 1, D]."""
+    bh, s, d = k.shape
+    grid = (bh, s // block_kv)
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale,
+                          block_kv=block_kv),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 128), lambda b, j: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),    # m (cell [0, 0] used)
+            pltpu.VMEM((8, 128), jnp.float32),    # l
+            pltpu.VMEM((8, d), jnp.float32),      # acc (row 0 used)
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, pos)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k, v, pos, *, impl: str = "auto",
+                     block_kv: int = 512):
+    """Decode-step attention: ``q [B, H, D]`` against a KV cache
+    ``k, v [B, S, H, D]``, attending to positions ``<= pos[b]``
+    (``pos [B]`` i32, the position of the token q was computed from).
+    Returns ``[B, H, D]`` in q.dtype.
+
+    impl: "auto" (pallas on TPU-friendly shapes, else jax) | "pallas" |
+    "jax". The two paths share the same masking/accumulation math and
+    agree to f32 tolerance."""
+    if q.ndim != 3 or k.ndim != 4:
+        raise ValueError(
+            f"decode_attention wants q [B, H, D] and k/v [B, S, H, D]; "
+            f"got {q.shape} and {k.shape}")
+    b, s, h, d = k.shape
+    bkv = _pick_block(s, block_kv)
+    if impl == "auto":
+        impl = "pallas" if (jax.default_backend() == "tpu"
+                            and bkv is not None) else "jax"
+    if impl == "jax":
+        return reference_decode_attention(q, k, v, pos)
+    if impl != "pallas":
+        raise ValueError(
+            f"unknown decode_attention impl {impl!r} "
+            "(expected 'auto' | 'pallas' | 'jax')")
+    if bkv is None:
+        raise ValueError(
+            f"cache length {s} has no pallas block plan; use impl='jax'")
+    interpret = jax.default_backend() != "tpu"
+    d_pad = _head_pad_target(d)
+    # [B, S, H, D] -> [B*H, S, D]: (S, D) become the trailing tile per
+    # row. On TPU this is one cache-sized transpose per call — the price
+    # of keeping the public cache layout sequence-major; a head-major
+    # resident cache is the follow-up that removes it.
+    kt = _pad_heads(k, d_pad).transpose(0, 2, 1, 3).reshape(b * h, s, d_pad)
+    vt = _pad_heads(v, d_pad).transpose(0, 2, 1, 3).reshape(b * h, s, d_pad)
+    qt = _pad_heads(q, d_pad).reshape(b * h, 1, d_pad)
+    pos_rows = jnp.broadcast_to(
+        pos.astype(jnp.int32).reshape(b, 1, 1), (b, h, 128)
+    ).reshape(b * h, 128)
+    out = _decode_bhsd(qt, kt, vt, pos_rows, sm_scale=d ** -0.5,
+                       block_kv=bkv, interpret=interpret)
+    return out.reshape(b, h, d_pad)[..., :d]

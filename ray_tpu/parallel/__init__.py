@@ -16,6 +16,8 @@ from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
     global_from_local,
+    kv_cache_shardings,
+    kv_cache_specs,
     logical_to_spec,
     named_sharding,
     replicate_tree,
@@ -28,7 +30,8 @@ from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "AXIS_ORDER", "BATCH_AXES", "MeshSpec", "dp_mesh", "single_device_mesh",
-    "DEFAULT_RULES", "constrain", "global_from_local", "logical_to_spec",
+    "DEFAULT_RULES", "constrain", "global_from_local",
+    "kv_cache_shardings", "kv_cache_specs", "logical_to_spec",
     "named_sharding", "replicate_tree", "replicated", "shard_batch",
     "tree_shardings",
     "reference_attention", "ring_attention",

@@ -31,7 +31,20 @@ __all__ = [
     "DeploymentHandle", "batch", "Request", "StreamingResponse",
     "multiplexed", "get_multiplexed_model_id", "apply_config",
     "build_app_from_config",
+    "InferenceEngine", "InferenceReplica",
 ]
+
+# The inference engine pulls in jax; most serve workers never touch it,
+# so it loads lazily (PEP 562) instead of taxing every import.
+_LAZY = {"InferenceEngine": "ray_tpu.serve.engine",
+         "InferenceReplica": "ray_tpu.serve.engine"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu("serve")

@@ -13,6 +13,7 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+import time
 from typing import Callable, List, Optional
 
 
@@ -37,17 +38,20 @@ class _Batcher:
         while True:
             first = self._queue.get()
             batch = [first]
-            deadline = threading.Event()
-            # accumulate until size or timeout
-            timer = threading.Timer(self.timeout, deadline.set)
-            timer.start()
-            while len(batch) < self.max_batch_size and \
-                    not deadline.is_set():
+            # Accumulate until size or timeout, BLOCKING on the remaining
+            # deadline each wait (the old loop spun on get(timeout=1ms),
+            # burning a core and adding up to 1 ms of jitter per item).
+            # A full batch falls out of the size check immediately; a
+            # timed-out get ends the window without a timer thread.
+            deadline = time.monotonic() + self.timeout
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self._queue.get(timeout=0.001))
+                    batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
-                    continue
-            timer.cancel()
+                    break
             inputs = [item[0] for item in batch]
             events = [item[1] for item in batch]
             results = [item[2] for item in batch]

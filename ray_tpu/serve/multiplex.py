@@ -24,6 +24,70 @@ from functools import wraps
 _MODEL_ID: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_multiplexed_model_id", default="")
 
+# Guards lazy creation of per-instance multiplex state (two first-ever
+# requests racing the `_mux_cache is None` check would otherwise each
+# build a cache and one set of loads would be orphaned).
+_MUX_INIT_LOCK = threading.Lock()
+
+
+def _mux_get(f, max_models: int, self, model_id: str):
+    """Body of the multiplexed wrapper. Lives at module level so the
+    decorated method's closure/referenced-globals stay free of lock
+    objects: deployments are cloudpickled to replicas, and cloudpickle
+    serializes test-/__main__-module classes BY VALUE together with
+    every global their methods name (same rule as batching._dispatch) —
+    a captured threading.Lock would make the deployment unpicklable."""
+    # Lazy per-instance state; _mux_lock is assigned LAST so any thread
+    # that sees it also sees the cache/in-flight dicts.
+    if getattr(self, "_mux_lock", None) is None:
+        with _MUX_INIT_LOCK:
+            if getattr(self, "_mux_lock", None) is None:
+                self._mux_cache = OrderedDict()
+                self._mux_inflight = {}
+                self._mux_lock = threading.Lock()
+    # The load runs OUTSIDE the lock (it is the expensive part), but a
+    # per-key in-flight event makes exactly one caller the loader; the
+    # rest wait on the event and re-check the cache. Without it,
+    # concurrent misses for the same id each loaded the model, and
+    # eviction could close() a copy still in use by a loser.
+    while True:
+        with self._mux_lock:
+            cache = self._mux_cache
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            event = self._mux_inflight.get(model_id)
+            if event is None:
+                event = threading.Event()
+                self._mux_inflight[model_id] = event
+                loading = True
+            else:
+                loading = False
+        if not loading:
+            event.wait()
+            continue   # loaded (hit) or failed (become loader)
+        try:
+            model = f(self, model_id)
+        except BaseException:
+            with self._mux_lock:
+                self._mux_inflight.pop(model_id, None)
+            event.set()   # wake waiters; one of them retries
+            raise
+        with self._mux_lock:
+            cache[model_id] = model
+            cache.move_to_end(model_id)
+            self._mux_inflight.pop(model_id, None)
+            while len(cache) > max_models:
+                _evicted_id, evicted = cache.popitem(last=False)
+                close = getattr(evicted, "close", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception:
+                        pass
+        event.set()
+        return model
+
 
 def get_multiplexed_model_id() -> str:
     """Inside a replica: the model id of the CURRENT request (reference:
@@ -56,27 +120,8 @@ def multiplexed(func=None, *, max_num_models_per_replica: int = 3):
     def wrap(f):
         @wraps(f)
         def cached(self, model_id: str):
-            cache = getattr(self, "_mux_cache", None)
-            if cache is None:
-                cache = self._mux_cache = OrderedDict()
-                self._mux_lock = threading.Lock()
-            with self._mux_lock:
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache[model_id]
-            model = f(self, model_id)
-            with self._mux_lock:
-                cache[model_id] = model
-                cache.move_to_end(model_id)
-                while len(cache) > max_num_models_per_replica:
-                    _evicted_id, evicted = cache.popitem(last=False)
-                    close = getattr(evicted, "close", None)
-                    if callable(close):
-                        try:
-                            close()
-                        except Exception:
-                            pass
-            return model
+            return _mux_get(f, max_num_models_per_replica, self,
+                            model_id)
 
         cached.__ray_tpu_multiplexed__ = True
         return cached

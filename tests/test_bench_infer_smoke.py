@@ -1,0 +1,33 @@
+"""Tier-1 inference-bench smoke: `bench_infer.main()` end-to-end in CPU
+mode through the continuous-batching engine, asserting the one-line JSON
+contract (headline fields plus the inference extras) the driver
+scrapes."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_bench_infer_cpu_smoke(capsys, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_REQUESTS", "3")  # CI fast
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_NEW", "3")
+    import bench_infer
+
+    bench_infer.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "gpt_decode_tokens_per_sec"
+    assert rec["unit"] == "tokens/s"
+    assert rec["vs_baseline"] == 0.0     # CPU mode: no roofline ratio
+    for key in ("value", "prefill_tokens_per_sec",
+                "decode_tokens_per_sec", "p50_token_latency_ms",
+                "p99_token_latency_ms"):
+        assert np.isfinite(rec[key]) and rec[key] > 0, (key, rec)
+    assert rec["value"] == rec["decode_tokens_per_sec"]
+    assert 0 < rec["slot_occupancy"] <= 1.0
+    assert rec["p50_token_latency_ms"] <= rec["p99_token_latency_ms"]

@@ -1,0 +1,196 @@
+"""Batching and multiplexing concurrency tests: the batcher must block
+(not spin) yet return a full batch immediately, errors must fan out to
+every caller without killing the loop thread, and multiplexed model
+loads must be deduplicated under concurrency."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import multiplexed
+
+
+def _run_threads(n, fn):
+    ts = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+class TestBatcher:
+    def test_full_batch_returns_without_waiting_out_timeout(self):
+        """max_batch_size arrivals dispatch immediately — the 5 s window
+        must NOT be slept out."""
+        @batch(max_batch_size=4, batch_wait_timeout_s=5.0)
+        def double(xs):
+            return [x * 2 for x in xs]
+
+        outs = {}
+        t0 = time.monotonic()
+        _run_threads(4, lambda i: outs.__setitem__(i, double(i)))
+        assert time.monotonic() - t0 < 2.0
+        assert outs == {i: i * 2 for i in range(4)}
+
+    def test_partial_batch_respects_deadline(self):
+        """A lone caller waits ~the window (once), not forever — and the
+        blocking wait means no 1 ms-spin poll while it does."""
+        @batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def ident(xs):
+            return xs
+
+        t0 = time.monotonic()
+        assert ident(7) == 7
+        dt = time.monotonic() - t0
+        assert 0.15 <= dt < 2.0
+
+    def test_error_propagates_to_every_caller_and_thread_survives(self):
+        calls = []
+
+        @batch(max_batch_size=2, batch_wait_timeout_s=0.05)
+        def flaky(xs):
+            calls.append(list(xs))
+            if len(calls) == 1:
+                raise RuntimeError("batch boom")
+            return [x + 1 for x in xs]
+
+        errs = []
+
+        def call(i):
+            try:
+                flaky(i)
+            except RuntimeError as e:
+                errs.append(str(e))
+        _run_threads(2, call)
+        assert errs == ["batch boom", "batch boom"]
+        # the loop thread survived the exception and serves again
+        assert flaky(10) == 11
+
+    def test_batch_sizes_seen(self):
+        sizes = []
+
+        @batch(max_batch_size=4, batch_wait_timeout_s=0.3)
+        def record(xs):
+            sizes.append(len(xs))
+            return xs
+
+        _run_threads(8, lambda i: record(i))
+        assert sum(sizes) == 8
+        assert max(sizes) <= 4
+
+    def test_wrong_result_count_raises_for_callers(self):
+        @batch(max_batch_size=2, batch_wait_timeout_s=0.05)
+        def bad(xs):
+            return xs[:-1] if len(xs) > 1 else ["lonely"]
+
+        errs = []
+
+        def call(i):
+            try:
+                bad(i)
+            except ValueError as e:
+                errs.append("results" in str(e))
+        _run_threads(2, call)
+        assert errs == [True, True]
+
+
+class TestMultiplex:
+    def test_model_loaded_exactly_once_under_concurrency(self):
+        loads = []
+
+        class Server:
+            @multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                loads.append(model_id)
+                time.sleep(0.1)     # wide race window
+                return f"model:{model_id}"
+
+        srv = Server()
+        got = []
+        _run_threads(8, lambda i: got.append(srv.get_model("m1")))
+        assert loads == ["m1"]
+        assert got == ["model:m1"] * 8
+
+    def test_distinct_ids_load_independently(self):
+        loads = []
+
+        class Server:
+            @multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id):
+                loads.append(model_id)
+                time.sleep(0.02)
+                return model_id.upper()
+
+        srv = Server()
+        got = {}
+        _run_threads(6, lambda i: got.__setitem__(
+            i, srv.get_model(f"m{i % 3}")))
+        assert sorted(loads) == ["m0", "m1", "m2"]
+        assert set(got.values()) == {"M0", "M1", "M2"}
+
+    def test_eviction_closes_lru_model(self):
+        closed = []
+
+        class Model:
+            def __init__(self, mid):
+                self.mid = mid
+
+            def close(self):
+                closed.append(self.mid)
+
+        class Server:
+            @multiplexed(max_num_models_per_replica=1)
+            def get_model(self, model_id):
+                return Model(model_id)
+
+        srv = Server()
+        a = srv.get_model("a")
+        b = srv.get_model("b")
+        assert closed == ["a"]
+        assert (a.mid, b.mid) == ("a", "b")
+
+    def test_failed_load_lets_waiter_retry(self):
+        """The loser of a failed load becomes the new loader instead of
+        hanging on a never-cached event."""
+        attempts = []
+
+        class Server:
+            @multiplexed
+            def get_model(self, model_id):
+                attempts.append(model_id)
+                if len(attempts) == 1:
+                    time.sleep(0.05)
+                    raise RuntimeError("load failed")
+                return "ok"
+
+        srv = Server()
+        results = []
+
+        def call(i):
+            try:
+                results.append(srv.get_model("x"))
+            except RuntimeError:
+                results.append("err")
+        _run_threads(3, call)
+        assert sorted(results) == ["err", "ok", "ok"]
+        assert len(attempts) == 2
+
+    def test_loads_after_failure_still_cached(self):
+        n = {"calls": 0}
+
+        class Server:
+            @multiplexed
+            def get_model(self, model_id):
+                n["calls"] += 1
+                if n["calls"] == 1:
+                    raise RuntimeError("nope")
+                return "fine"
+
+        srv = Server()
+        with pytest.raises(RuntimeError):
+            srv.get_model("z")
+        assert srv.get_model("z") == "fine"
+        assert srv.get_model("z") == "fine"
+        assert n["calls"] == 2
