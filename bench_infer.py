@@ -5,8 +5,8 @@ Prints ONE JSON line. Headline fields follow bench.py's contract
 ({"metric", "value", "unit", "vs_baseline"}); the inference-specific
 extras ride alongside:
 
-  prefill_tokens_per_sec   prompt tokens absorbed per second (bucketized
-                           full-sequence forward, cache write included)
+  prefill_tokens_per_sec   prompt tokens absorbed per second (chunked
+                           prefill, cache write included)
   decode_tokens_per_sec    generated tokens per second across all slots
                            (the headline `value`)
   p50_token_latency_ms     per-decode-step wall latency percentiles —
@@ -15,13 +15,27 @@ extras ride alongside:
   slot_occupancy           mean fraction of cache slots resident over
                            the timed region (continuous batching's job
                            is to keep this near 1.0)
+  prefix_hit_rate          fraction of prompt tokens served from the
+                           radix prefix cache instead of prefilled
+  cache_block_utilization  mean fraction of the paged pool's blocks
+                           live during the timed region
+  max_admission_stall_ms   the longest a decode step waited on that
+                           tick's admission work (chunked prefill is
+                           supposed to bound this to one chunk)
 
 Knobs (env vars, platform-tuned defaults in main()):
-  RAY_TPU_INFER_BENCH_SLOTS     resident decode slots (cache batch)
-  RAY_TPU_INFER_BENCH_MAX_LEN   per-slot cache capacity
-  RAY_TPU_INFER_BENCH_PROMPT    prompt length per request
-  RAY_TPU_INFER_BENCH_NEW       generated tokens per request
-  RAY_TPU_INFER_BENCH_REQUESTS  total requests in the timed region
+  RAY_TPU_INFER_BENCH_SLOTS          resident decode slots (cache batch)
+  RAY_TPU_INFER_BENCH_MAX_LEN        per-request cache capacity
+  RAY_TPU_INFER_BENCH_PROMPT        prompt length per request
+  RAY_TPU_INFER_BENCH_NEW            generated tokens per request
+  RAY_TPU_INFER_BENCH_REQUESTS       total requests in the timed region
+  RAY_TPU_INFER_BENCH_BLOCK          paged-cache block size
+  RAY_TPU_INFER_BENCH_CHUNK          prefill chunk budget (tokens/tick)
+  RAY_TPU_INFER_BENCH_SHARED_PREFIX  tokens of system prompt shared by
+                                     every request (0 = fully random);
+                                     exercises radix sharing
+  RAY_TPU_INFER_BENCH_RAGGED         1 = ragged prompt lengths, drawn
+                                     uniformly from [PROMPT/2, PROMPT]
 
 Baseline: single-token decode is HBM-bandwidth-bound — every step
 streams the full parameter set plus the live KV prefix through the chip
@@ -36,7 +50,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import jax
 import numpy as np
@@ -101,19 +114,35 @@ def main():
     prompt_len = _env_int("RAY_TPU_INFER_BENCH_PROMPT", prompt_len)
     new_tokens = _env_int("RAY_TPU_INFER_BENCH_NEW", new_tokens)
     requests = _env_int("RAY_TPU_INFER_BENCH_REQUESTS", requests)
+    block_size = _env_int("RAY_TPU_INFER_BENCH_BLOCK", 16)
+    chunk = _env_int("RAY_TPU_INFER_BENCH_CHUNK", 0)
+    shared_prefix = _env_int("RAY_TPU_INFER_BENCH_SHARED_PREFIX", 0)
+    ragged = _env_int("RAY_TPU_INFER_BENCH_RAGGED", 0)
     if prompt_len + new_tokens > max_len:
         raise SystemExit("PROMPT + NEW must fit in MAX_LEN")
+    if shared_prefix >= prompt_len:
+        raise SystemExit("SHARED_PREFIX must be < PROMPT")
 
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
-    engine = InferenceEngine(params, cfg, slots=slots, max_len=max_len)
+    engine = InferenceEngine(params, cfg, slots=slots, max_len=max_len,
+                             block_size=block_size,
+                             prefill_chunk=chunk or None)
     rng = np.random.default_rng(0)
+    system_prompt = rng.integers(0, cfg.vocab_size, shared_prefix)
+
+    def make_prompt():
+        p = prompt_len
+        if ragged:
+            p = int(rng.integers(max(prompt_len // 2, shared_prefix + 1),
+                                 prompt_len + 1))
+        suffix = rng.integers(0, cfg.vocab_size, p - shared_prefix)
+        return np.concatenate([system_prompt, suffix]).astype(np.int32)
 
     def submit(n):
         for _ in range(n):
-            engine.submit(rng.integers(0, cfg.vocab_size, prompt_len),
-                          max_new_tokens=new_tokens)
+            engine.submit(make_prompt(), max_new_tokens=new_tokens)
 
-    # Warmup: compiles the prompt bucket's prefill and the (single)
+    # Warmup: compiles the prefill chunk buckets and the (single)
     # decode executable, then drops compile time from the accounting.
     submit(min(requests, slots))
     engine.run_until_idle()
@@ -140,6 +169,14 @@ def main():
         "p50_token_latency_ms": round(s["p50_token_latency_ms"], 3),
         "p99_token_latency_ms": round(s["p99_token_latency_ms"], 3),
         "slot_occupancy": round(s["slot_occupancy"], 3),
+        "prefix_hit_rate": round(s["prefix_hit_rate"], 3),
+        "cache_block_utilization": round(
+            s["cache_block_utilization"], 3),
+        "max_admission_stall_ms": round(
+            s["max_admission_stall_ms"], 3),
+        "block_size": s["block_size"],
+        "cache_blocks": s["cache_blocks"],
+        "shared_prefix": shared_prefix,
     }))
 
 

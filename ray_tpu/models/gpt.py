@@ -456,5 +456,223 @@ def decode_step(params, tokens, cache, pos, cfg: GPTConfig,
     return logits, {"k": k_new, "v": v_new}
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache: block pool + block tables
+# ---------------------------------------------------------------------------
+# Paging is the Podracer philosophy scaled to ragged traffic: the device
+# allocation is still ONE static pool, but its unit is a block of
+# `block_size` positions instead of a full max_len row. Sequences name
+# their blocks through an int32 block table [B, max_blocks] that rides
+# into the jits as data — shapes never change, so decode still compiles
+# exactly once, while the host (serve/engine.py) is free to share,
+# copy-on-write, and recycle blocks between requests.
+
+def kv_pool_logical_axes():
+    """Logical-axis tuples for the paged block pool {"k", "v"} of
+    [L, n_blocks, block_size, H, Dh]. Heads stay tensor-parallel
+    (matching the wq/wk/wv column split, exactly like the unpaged
+    cache); the block axis is replicated — any block must be assignable
+    to any sequence, so it cannot ride the data axes the way dedicated
+    slot rows could."""
+    axes = (None, None, None, "heads", None)
+    return {"k": axes, "v": axes}
+
+
+def init_kv_pool(cfg: GPTConfig, n_blocks: int, block_size: int,
+                 mesh: Mesh | None = None):
+    """Preallocated paged cache {"k", "v"} of
+    [L, n_blocks, block_size, H, Dh] in cfg.dtype, zero-filled, placed
+    with its sharding annotation when a mesh is given. Block 0 is
+    conventionally the engine's trash block (idle decode rows scatter
+    there), but nothing here enforces that — allocation policy is the
+    host's job."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_heads,
+             cfg.head_dim)
+    pool = {"k": jnp.zeros(shape, cfg.activation_dtype()),
+            "v": jnp.zeros(shape, cfg.activation_dtype())}
+    if mesh is not None:
+        from ray_tpu.parallel.sharding import kv_pool_shardings
+        sh = kv_pool_shardings(mesh)
+        pool = {name: jax.device_put(arr, sh[name])
+                for name, arr in pool.items()}
+    return pool
+
+
+def copy_block(cache, src, dst):
+    """Copy physical block `src` onto `dst` in every layer of the pool —
+    the device half of copy-on-write prefix sharing. src/dst may be
+    traced scalars, so one jit (with the cache donated) serves every
+    copy the engine ever issues."""
+    out = {}
+    for name in ("k", "v"):
+        blk = jax.lax.dynamic_slice_in_dim(cache[name], src, 1, axis=1)
+        out[name] = jax.lax.dynamic_update_slice_in_dim(
+            cache[name], blk, dst, axis=1)
+    return out
+
+
+def prefill_paged(params, tokens, cache, cfg: GPTConfig,
+                  mesh: Mesh | None = None, *, block_table, start,
+                  length=None):
+    """One chunk of paged prefill for a single sequence: ``tokens
+    [1, C]`` (right-padded to the chunk bucket C) are processed at
+    absolute positions ``start .. start + length - 1``; their K/V are
+    scattered into the block pool through ``block_table [max_blocks]``
+    i32, and the returned logits ``[1, vocab]`` f32 are the chunk's last
+    *real* position (``start + length - 1``) — the engine samples the
+    request's first token from the final chunk's logits and ignores the
+    rest.
+
+    Attention is causal over the WHOLE prefix: each chunk token attends
+    to every cached position written by earlier chunks (or shared via
+    the radix tree) plus the causal part of its own chunk — gathered
+    from the pool through the same block table it writes. `start`,
+    `length` and the table are traced, so prefill compiles once per
+    chunk bucket, ever."""
+    b, c = tokens.shape
+    if b != 1:
+        raise ValueError(f"paged prefill wants tokens [1, C], got "
+                         f"batch {b}")
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    max_ctx = block_table.shape[0] * bs
+    if start is None:
+        raise ValueError("prefill_paged needs start=")
+    adt = cfg.activation_dtype()
+    pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
+    nh, hd = cfg.n_heads, cfg.head_dim
+    start = jnp.asarray(start, jnp.int32)
+    length = jnp.asarray(c if length is None else length, jnp.int32)
+    table = jnp.asarray(block_table, jnp.int32)
+
+    offs = jnp.arange(c, dtype=jnp.int32)
+    positions = start + offs
+    valid = offs < length
+    # Physical flat write indices; padded tail rows scatter out of
+    # bounds and are dropped, so chunk garbage never lands in a block.
+    widx = jnp.where(valid, table[positions // bs] * bs + positions % bs,
+                     nb * bs)
+    # Flat gather indices for the sequence's whole logical context.
+    gidx = (table[:, None] * bs
+            + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(-1)
+
+    x = params["embed"].astype(adt)[tokens[0]]
+    x = x + params["pos_embed"].astype(adt)[positions]      # [C, D]
+
+    def body(x, layer):
+        lp, kc, vc = layer                    # kc/vc [nb, bs, H, Dh]
+        h = _rms_norm(x, lp["ln1_scale"].astype(adt))
+        q = jnp.einsum("td,dh->th", h, lp["wq"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        k = jnp.einsum("td,dh->th", h, lp["wk"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        v = jnp.einsum("td,dh->th", h, lp["wv"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        q = q.reshape(c, nh, hd)
+        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
+            k.reshape(c, nh, hd).astype(kc.dtype), mode="drop")
+        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
+            v.reshape(c, nh, hd).astype(vc.dtype), mode="drop")
+        kctx = kf[gidx]                       # [max_ctx, H, Dh]
+        vctx = vf[gidx]
+        scores = jnp.einsum(
+            "thd,shd->hts", q.astype(jnp.float32),
+            kctx.astype(jnp.float32),
+            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        cols = jnp.arange(max_ctx, dtype=jnp.int32)
+        live = cols[None, None, :] <= positions[None, :, None]
+        scores = jnp.where(live, scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("hts,shd->thd", p, vctx.astype(jnp.float32),
+                         preferred_element_type=jnp.float32
+                         ).astype(adt).reshape(c, nh * hd)
+        att = jnp.einsum("th,hd->td", att, lp["wo"].astype(adt),
+                         preferred_element_type=pet).astype(adt)
+        x = x + att
+        h = _rms_norm(x, lp["ln2_scale"].astype(adt))
+        up = jnp.einsum("td,df->tf", h, lp["w_up"].astype(adt),
+                        preferred_element_type=pet).astype(adt)
+        gate = jnp.einsum("td,df->tf", h, lp["w_gate"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        ff = jax.nn.silu(gate) * up
+        down = jnp.einsum("tf,fd->td", ff, lp["w_down"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        return x + down, (kf.reshape(nb, bs, nh, hd),
+                          vf.reshape(nb, bs, nh, hd))
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    last = jnp.take_along_axis(x, (length - 1)[None, None], axis=0)
+    logits = jnp.einsum("td,vd->tv", last, params["embed"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def decode_step_paged(params, tokens, cache, pos, tables,
+                      cfg: GPTConfig, mesh: Mesh | None = None):
+    """One autoregressive step for every slot through the paged cache:
+    ``tokens [B]`` at positions ``pos [B]``, each slot's blocks named by
+    ``tables [B, max_blocks]`` i32. Writes each token's K/V at its
+    logical position's block/offset and attends over logical positions
+    ``<= pos`` via `ops.decode_attention.paged_decode_attention`.
+    Returns ``(logits [B, vocab] f32, cache)``.
+
+    Shapes are static (B slots, fixed pool, fixed table width), so the
+    engine's jitted wrapper still compiles exactly once; idle rows
+    should point their table at the trash block (0) and any position —
+    their writes collide harmlessly there and nobody reads the output."""
+    from ray_tpu.ops.decode_attention import paged_decode_attention
+    adt = cfg.activation_dtype()
+    pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
+    b = tokens.shape[0]
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    nh, hd = cfg.n_heads, cfg.head_dim
+    pos = pos.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    widx = blk * bs + pos % bs                   # [B] flat write index
+    x = params["embed"].astype(adt)[tokens]
+    x = x + params["pos_embed"].astype(adt)[pos]
+
+    def body(x, layer):
+        lp, kc, vc = layer                       # kc/vc [nb, bs, H, Dh]
+        h = _rms_norm(x, lp["ln1_scale"].astype(adt))
+        q = jnp.einsum("bd,dh->bh", h, lp["wq"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        k = jnp.einsum("bd,dh->bh", h, lp["wk"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        v = jnp.einsum("bd,dh->bh", h, lp["wv"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        q = q.reshape(b, nh, hd)
+        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
+            k.reshape(b, nh, hd).astype(kc.dtype))
+        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
+            v.reshape(b, nh, hd).astype(vc.dtype))
+        kc = kf.reshape(nb, bs, nh, hd)
+        vc = vf.reshape(nb, bs, nh, hd)
+        att = paged_decode_attention(q, kc, vc, tables, pos,
+                                     impl=cfg.decode_attn_impl)
+        att = jnp.einsum("bh,hd->bd", att.reshape(b, nh * hd),
+                         lp["wo"].astype(adt),
+                         preferred_element_type=pet).astype(adt)
+        x = x + att
+        h = _rms_norm(x, lp["ln2_scale"].astype(adt))
+        up = jnp.einsum("bd,df->bf", h, lp["w_up"].astype(adt),
+                        preferred_element_type=pet).astype(adt)
+        gate = jnp.einsum("bd,df->bf", h, lp["w_gate"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        ff = jax.nn.silu(gate) * up
+        down = jnp.einsum("bf,fd->bd", ff, lp["w_down"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        return x + down, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
 def num_params(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

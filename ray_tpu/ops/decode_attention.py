@@ -30,6 +30,20 @@ Layout: the public cache layout is ``[B, S, H, D]`` (matching
 (S, D) as the trailing tile per (b, h), so the wrapper transposes K/V to
 ``[B*H, S, D]`` on entry. The fallback consumes ``[B, S, H, D]``
 directly.
+
+**Paged variant** (`paged_decode_attention`): K/V live in a shared block
+pool ``[n_blocks, block_size, H, D]`` and each sequence names its blocks
+through an int32 block table ``[B, max_blocks]`` (logical block j of
+sequence b is physical block ``tables[b, j]``). The Pallas kernel rides
+the same online-softmax structure with the kv grid dimension walking
+*logical* blocks; the block table and positions arrive as scalar
+prefetch (`pltpu.PrefetchScalarGridSpec`), so the K/V BlockSpec index
+maps dereference the table and the DMA engine fetches exactly the
+blocks the sequence owns — the pool is never materialized per sequence.
+The JAX fallback gathers ``pool[tables]`` and reuses
+`reference_decode_attention`; both paths mask logical positions
+``> pos[b]``, so stale data in partially-filled tail blocks never
+contributes.
 """
 
 from __future__ import annotations
@@ -200,4 +214,166 @@ def decode_attention(q, k, v, pos, *, impl: str = "auto",
     ).reshape(b * h, 128)
     out = _decode_bhsd(qt, kt, vt, pos_rows, sm_scale=d ** -0.5,
                        block_kv=bkv, interpret=interpret)
+    return out.reshape(b, h, d_pad)[..., :d]
+
+
+# ---------------------------------------------------------------------------
+# paged variant: K/V behind a block table
+# ---------------------------------------------------------------------------
+
+def gather_kv_pages(pool, tables):
+    """Materialize per-sequence K or V from a block pool:
+    ``pool [n_blocks, bs, H, D]`` gathered through ``tables
+    [B, max_blocks]`` -> ``[B, max_blocks * bs, H, D]`` where row b's
+    logical position ``p`` lives at ``(tables[b, p // bs], p % bs)``.
+    The JAX fallback path and the chunked-prefill context read share
+    this one gather."""
+    b, mb = tables.shape
+    nb, bs = pool.shape[0], pool.shape[1]
+    flat = pool.reshape((nb * bs,) + pool.shape[2:])
+    idx = (tables.astype(jnp.int32)[:, :, None] * bs
+           + jnp.arange(bs, dtype=jnp.int32)[None, None, :]).reshape(
+        b, mb * bs)
+    return flat[idx]
+
+
+def reference_paged_decode_attention(q, k_pool, v_pool, tables, pos):
+    """q [B, H, D]; k_pool, v_pool [n_blocks, bs, H, D]; tables
+    [B, max_blocks] i32; pos [B] i32. Gather-then-attend fallback with
+    the exact masking/accumulation math of the paged kernel."""
+    k_seq = gather_kv_pages(k_pool, tables)
+    v_seq = gather_kv_pages(v_pool, tables)
+    return reference_decode_attention(q, k_seq, v_seq, pos)
+
+
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, sm_scale: float,
+                  block_size: int, n_heads: int):
+    ji = pl.program_id(1)
+
+    @pl.when(ji == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[pl.program_id(0) // n_heads]
+    k_start = ji * block_size     # LOGICAL position of this kv block --
+    # the BlockSpec index maps already dereferenced tbl_ref, so k_ref
+    # holds the right physical block; masking stays in logical space.
+
+    @pl.when(k_start <= pos)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [1, D]
+        k = k_ref[0, 0].astype(jnp.float32)         # [bs, D]
+        s = jax.lax.dot_general(
+            q * sm_scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, bs]
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col <= pos, s, NEG_INF)
+        m_prev = m_scr[:1, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:1, :1] = l_scr[:1, :1] * corr + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scr[:1, :1] = m_new
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, D]
+        acc_scr[:1] = acc_scr[:1] * corr + pv
+
+    @pl.when(ji == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:1] / l_scr[:1, :1]).astype(o_ref.dtype)
+
+
+def _paged_bhsd(q, k, v, tables, pos, *, sm_scale: float, n_heads: int,
+                interpret: bool):
+    """q [BH, 1, D]; k, v [n_blocks, H, bs, D] head-major pool; tables
+    [B, max_blocks]; pos [B] i32 -> [BH, 1, D]. Grid walks (row, logical
+    block); the physical block index comes out of the scalar-prefetched
+    table inside the BlockSpec index maps — paging lives entirely in the
+    DMA schedule, the kernel body is the stock online softmax."""
+    bh, _, d = q.shape
+    mb = tables.shape[1]
+    bs = k.shape[2]
+    grid = (bh, mb)
+    h = n_heads
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda i, j, tbl, ps: (i, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, tbl, ps: (tbl[i // h, j],
+                                                i % h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, tbl, ps: (tbl[i // h, j],
+                                                i % h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, j, tbl, ps: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),    # m (cell [0, 0] used)
+            pltpu.VMEM((8, 128), jnp.float32),    # l
+            pltpu.VMEM((8, d), jnp.float32),      # acc (row 0 used)
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=sm_scale,
+                          block_size=bs, n_heads=n_heads),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, pos, q, k, v)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, pos, *,
+                           impl: str = "auto"):
+    """Decode-step attention through a paged KV cache: ``q [B, H, D]``
+    against a block pool ``k_pool, v_pool [n_blocks, block_size, H, D]``
+    indexed by ``tables [B, max_blocks]`` i32 (logical block j of row b
+    is physical block ``tables[b, j]``; entries past the allocated
+    length may be any valid block — they are masked). Attends to logical
+    positions ``<= pos[b]`` and returns ``[B, H, D]`` in q.dtype.
+
+    impl: "auto" (pallas on TPU-friendly shapes, else jax) | "pallas" |
+    "jax". Paths share masking/accumulation math exactly like
+    `decode_attention`."""
+    if q.ndim != 3 or k_pool.ndim != 4 or tables.ndim != 2:
+        raise ValueError(
+            "paged_decode_attention wants q [B, H, D], pools "
+            f"[n_blocks, bs, H, D] and tables [B, max_blocks]; got "
+            f"{q.shape}, {k_pool.shape}, {tables.shape}")
+    b, h, d = q.shape
+    bs = k_pool.shape[1]
+    if impl == "auto":
+        impl = "pallas" if (jax.default_backend() == "tpu"
+                            and bs % 8 == 0) else "jax"
+    if impl == "jax":
+        return reference_paged_decode_attention(q, k_pool, v_pool,
+                                                tables, pos)
+    if impl != "pallas":
+        raise ValueError(
+            f"unknown paged_decode_attention impl {impl!r} "
+            "(expected 'auto' | 'pallas' | 'jax')")
+    if bs % 8 != 0:
+        raise ValueError(
+            f"block_size {bs} is not a multiple of 8; use impl='jax'")
+    interpret = jax.default_backend() != "tpu"
+    d_pad = _head_pad_target(d)
+    # [n_blocks, bs, H, D] -> head-major [n_blocks, H, bs, D]: the
+    # kernel's per-(row, block) tile is (bs, D) for one head.
+    kt = _pad_heads(k_pool, d_pad).transpose(0, 2, 1, 3)
+    vt = _pad_heads(v_pool, d_pad).transpose(0, 2, 1, 3)
+    qt = _pad_heads(q, d_pad).reshape(b * h, 1, d_pad)
+    out = _paged_bhsd(qt, kt, vt, tables.astype(jnp.int32),
+                      pos.astype(jnp.int32), sm_scale=d ** -0.5,
+                      n_heads=h, interpret=interpret)
     return out.reshape(b, h, d_pad)[..., :d]

@@ -152,6 +152,27 @@ def kv_cache_shardings(mesh: Mesh, rules: dict | None = None
             for name, spec in kv_cache_specs(mesh, rules).items()}
 
 
+def kv_pool_specs(mesh: Mesh, rules: dict | None = None):
+    """PartitionSpec pytree for a paged KV block pool {"k", "v"} of
+    [L, n_blocks, block_size, H, D]: heads ride the tensor axis (same
+    wq/wk/wv column-split alignment as the unpaged cache — the blocks a
+    tensor shard writes hold the heads it attends over). The block axis
+    is replicated: the allocator hands any physical block to any
+    sequence, so blocks cannot be pinned to data shards the way whole
+    slot rows were."""
+    from ray_tpu.models.gpt import kv_pool_logical_axes
+    return {name: logical_to_spec(axes, rules, mesh)
+            for name, axes in kv_pool_logical_axes().items()}
+
+
+def kv_pool_shardings(mesh: Mesh, rules: dict | None = None
+                      ) -> dict[str, NamedSharding]:
+    """NamedShardings for `kv_pool_specs` — what
+    `models.gpt.init_kv_pool(mesh=...)` places the pool with."""
+    return {name: NamedSharding(mesh, spec)
+            for name, spec in kv_pool_specs(mesh, rules).items()}
+
+
 def replicated(mesh: Mesh):
     return NamedSharding(mesh, PartitionSpec())
 

@@ -1,34 +1,40 @@
-"""Continuous-batching autoregressive inference engine.
+"""Continuous-batching autoregressive inference engine over a paged KV
+cache.
 
 The Podracer serving recipe (Hessel et al., 2104.06272): device shapes
-are STATIC and the model stays resident. The engine owns a fixed-shape
-KV cache of `slots` rows (models.gpt.init_kv_cache); sequences stream
-through those slots rather than reshaping the batch per request:
+are STATIC and the model stays resident. The engine owns one fixed block
+pool (`models.gpt.init_kv_pool`, ``[L, n_blocks, block_size, H, Dh]``)
+and streams ragged traffic through it via int32 block tables — the only
+thing that changes between steps is *data*, never shapes:
 
-- **prefill** pads each prompt right up to a length *bucket* and writes
-  one cache row (`gpt.prefill(slot=...)` — slot and true length are
-  traced scalars), so XLA compiles prefill once per bucket, ever.
+- **paged allocation**: each request holds exactly the blocks its
+  prompt + generation footprint needs (a 100-token chat no longer pins a
+  4k-token row). `BlockAllocator` refcounts physical blocks; block 0 is
+  the trash block idle decode rows scatter into.
+- **radix prefix sharing**: a host-side `RadixTree` maps token prefixes
+  to cached blocks at block granularity. A repeated system prompt is
+  prefilled ONCE; later requests admit by taking references on the
+  shared blocks and prefilling only their suffix. A prefix that ends
+  mid-block is shared copy-on-write: the partial block is device-copied
+  into a private block before the request writes into it. Zero-ref
+  cached prefixes are evicted LRU under pool pressure.
+- **chunked prefill**: admission no longer runs a whole prompt's
+  prefill synchronously inside `step()`. Prompts prefill in fixed-size
+  chunks (bucketized, one compile per chunk bucket) interleaved between
+  decode steps — when any sequence is decoding, a tick runs at most ONE
+  chunk, so a long admission never stalls in-flight streams for more
+  than one chunk's worth of work.
 - **decode** advances ALL slots one token per call through a single
-  jitted, cache-donating wrapper around `gpt.decode_step` — compiled
-  exactly once for the engine's lifetime (asserted in tests via the
-  trace counter). Inactive slots decode garbage at position 0; nobody
-  reads it, and the next admission's prefill overwrites the row.
-- **continuous batching**: requests are admitted into free slots
-  *between* decode steps, so a late arrival never recompiles anything
-  and never perturbs resident sequences (decode math is
-  row-independent; tests assert exact greedy equality).
+  jitted, pool-donating wrapper around `gpt.decode_step_paged` —
+  compiled exactly once for the engine's lifetime (asserted in tests
+  via the trace counter). Idle and mid-prefill rows decode garbage
+  into the trash block; nobody reads it.
 
-Sampling (greedy + temperature) runs inside the jitted functions:
-temperature is a per-slot traced vector, the PRNG key is folded with the
-step counter, and `temp == 0` rows take the argmax — so switching
-sampling modes or admitting a sampled request next to a greedy one is
-not a recompile either.
-
-Driving model: `step()` is the one scheduler tick (admit, then decode).
-Any number of consumers can call `tokens_for(rid)` concurrently — each
-pump acquires the engine lock, ticks the shared engine, and drains its
-own per-request queue, which is exactly how `InferenceReplica` streams
-concurrent requests through Serve's generator/`next_chunks` machinery.
+Sampling (greedy + temperature) runs inside the jitted functions, as
+before. `step()` is the one scheduler tick (admit, chunk, decode);
+`submit()` / `tokens_for()` / `cancel()` are the request-side API. A
+consumer that stops iterating `tokens_for` releases its request's
+blocks and queues automatically (generator finalization cancels it).
 """
 
 from __future__ import annotations
@@ -50,6 +56,245 @@ def _default_buckets(max_len: int) -> tuple[int, ...]:
     return tuple(out)
 
 
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Refcounted free-list allocator over the physical blocks of a
+    paged KV pool. Block 0 is reserved as the engine's trash block
+    (never handed out — idle decode rows scatter there), so a pool of
+    ``n_blocks`` has ``n_blocks - 1`` usable blocks.
+
+    Invariants (asserted by `check()` and the fuzz tests): a block is
+    either free with refcount 0 or allocated with refcount >= 1;
+    used + free == n_blocks - 1; decref of a free block (double free)
+    raises."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need at least one usable block")
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, 0, -1))   # pop() -> 1, 2…
+        self._ref = [0] * n_blocks
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("out of KV cache blocks")
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    def ref(self, block: int):
+        if self._ref[block] <= 0:
+            raise RuntimeError(f"ref of free block {block}")
+        self._ref[block] += 1
+
+    def decref(self, block: int):
+        if block <= 0 or self._ref[block] <= 0:
+            raise RuntimeError(f"double free of block {block}")
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            self._free.append(block)
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    def check(self):
+        assert self.used + self.free == self.n_blocks - 1
+        free = set(self._free)
+        assert len(free) == len(self._free), "free-list duplicate"
+        for b in range(1, self.n_blocks):
+            if b in free:
+                assert self._ref[b] == 0, f"free block {b} has refs"
+            else:
+                assert self._ref[b] >= 1, f"used block {b} has no refs"
+
+
+# ---------------------------------------------------------------------------
+# radix tree over token prefixes
+# ---------------------------------------------------------------------------
+
+def _common(a, b) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class _RadixNode:
+    __slots__ = ("key", "blocks", "children", "parent", "last_access")
+
+    def __init__(self, key, blocks, parent):
+        self.key = key              # tuple of tokens, len % bs == 0
+        self.blocks = blocks        # physical block per key block
+        self.children = {}          # first-block token tuple -> node
+        self.parent = parent
+        self.last_access = 0
+
+
+class RadixTree:
+    """Host-side radix tree mapping token prefixes to cached KV blocks.
+
+    Keys are block-aligned (every edge covers whole blocks of
+    ``block_size`` tokens); edges are path-compressed and split at block
+    boundaries when sequences diverge inside them. Tree blocks are
+    IMMUTABLE — only full prompt blocks are ever inserted, and decode
+    never writes into a full block — so sharing needs no
+    synchronization. A match may end mid-block; the caller then shares
+    that block read-only and must copy-on-write before writing
+    (`InferenceEngine._try_admit`).
+
+    The tree holds one allocator reference per block it records;
+    `evict()` walks zero-ref leaves (blocks only the tree still holds)
+    in LRU order and releases them."""
+
+    def __init__(self, block_size: int, allocator: BlockAllocator):
+        self.bs = block_size
+        self.alloc = allocator
+        self.root = _RadixNode((), [], None)
+        self._clock = 0
+
+    # -- internals ----------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _best_child(self, node, rest):
+        best, best_c = None, 0
+        for child in node.children.values():
+            c = _common(child.key, rest)
+            if c > best_c:
+                best, best_c = child, c
+        return best, best_c
+
+    def _split(self, node, fb: int):
+        """Split `node`'s edge after `fb` blocks; returns the new upper
+        node (which keeps the prefix blocks)."""
+        parent = node.parent
+        cut = fb * self.bs
+        upper = _RadixNode(node.key[:cut], node.blocks[:fb], parent)
+        upper.last_access = node.last_access
+        del parent.children[node.key[:self.bs]]
+        parent.children[upper.key[:self.bs]] = upper
+        node.key = node.key[cut:]
+        node.blocks = node.blocks[fb:]
+        node.parent = upper
+        upper.children[node.key[:self.bs]] = node
+        return upper
+
+    def _nodes(self):
+        stack = [self.root]
+        while stack:
+            nd = stack.pop()
+            yield nd
+            stack.extend(nd.children.values())
+
+    # -- public -------------------------------------------------------
+
+    def match(self, tokens):
+        """Longest cached prefix of `tokens`: returns
+        ``(blocks, matched)`` where `blocks` covers
+        ``ceil(matched / bs)`` physical blocks. When ``matched % bs``
+        is nonzero the last block is only partially matched — the
+        caller shares it read-only and must COW before writing."""
+        toks = tuple(int(t) for t in tokens)
+        node, blocks, matched = self.root, [], 0
+        now = self._tick()
+        while matched < len(toks):
+            rest = toks[matched:]
+            best, c = self._best_child(node, rest)
+            if best is None or c == 0:
+                break
+            best.last_access = now
+            if c == len(best.key) and c < len(rest):
+                blocks += best.blocks
+                matched += c
+                node = best
+                continue
+            fb = c // self.bs
+            blocks += best.blocks[:fb]
+            if c % self.bs:
+                blocks.append(best.blocks[fb])
+            matched += c
+            break
+        return blocks, matched
+
+    def insert(self, tokens, blocks):
+        """Record `tokens` (truncated down to a block multiple) as a
+        cached prefix backed by `blocks` (one physical id per logical
+        block of `tokens`). Existing matches are walked (and split at a
+        block boundary on divergence); only the unmatched tail is
+        adopted, taking one tree reference per newly-held block."""
+        n = (len(tokens) // self.bs) * self.bs
+        toks = tuple(int(t) for t in tokens[:n])
+        node, i = self.root, 0
+        now = self._tick()
+        while i < n:
+            rest = toks[i:]
+            best, c = self._best_child(node, rest)
+            fb = c // self.bs if best is not None else 0
+            if fb == 0:
+                blks = list(blocks[i // self.bs: n // self.bs])
+                child = _RadixNode(rest, blks, node)
+                child.last_access = now
+                node.children[rest[:self.bs]] = child
+                for b in blks:
+                    self.alloc.ref(b)
+                return
+            best.last_access = now
+            if fb * self.bs < len(best.key):
+                best = self._split(best, fb)
+                best.last_access = now
+            node = best
+            i += fb * self.bs
+
+    def evict(self, need: int) -> int:
+        """Free zero-ref cached prefixes (blocks only the tree holds),
+        LRU leaves first, until `need` blocks have been released or
+        nothing more is evictable. Returns blocks freed."""
+        freed = 0
+        while freed < need:
+            leaves = [nd for nd in self._nodes()
+                      if nd is not self.root and not nd.children
+                      and all(self.alloc.refcount(b) == 1
+                              for b in nd.blocks)]
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda nd: nd.last_access)
+            for b in victim.blocks:
+                self.alloc.decref(b)
+            freed += len(victim.blocks)
+            del victim.parent.children[victim.key[:self.bs]]
+        return freed
+
+    def clear(self) -> int:
+        """Drop every cached prefix (used by tests); returns blocks
+        freed. Nodes whose blocks live requests still reference are
+        kept."""
+        return self.evict(self.n_blocks() or 1)
+
+    def n_blocks(self) -> int:
+        return sum(len(nd.blocks) for nd in self._nodes())
+
+    def n_nodes(self) -> int:
+        return sum(1 for nd in self._nodes()) - 1   # minus root
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
 @dataclass
 class _Pending:
     rid: int
@@ -61,30 +306,44 @@ class _Pending:
 
 @dataclass
 class _Slot:
-    rid: int = -1                 # -1 = free
+    rid: int = -1
+    phase: str = "idle"           # idle | prefill | decode
+    prompt: np.ndarray | None = None
+    filled: int = 0               # prompt tokens whose KV is resident
+    blocks: list = field(default_factory=list)
+    table: np.ndarray | None = None   # [max_blocks] int32 (0 = trash)
+    order: int = 0                # admission sequence (chunk FIFO)
     token: int = 0                # token the next decode consumes
-    pos: int = 0                  # its position in the cache row
+    pos: int = 0                  # its position in the logical sequence
     remaining: int = 0
     temperature: float = 0.0
     eos_id: int | None = None
 
     @property
     def active(self) -> bool:
-        return self.rid >= 0
+        return self.phase != "idle"
 
 
 class InferenceEngine:
-    """Slot-based continuous-batching scheduler over one GPT model.
+    """Slot-based continuous-batching scheduler over one GPT model with
+    a paged, prefix-shared KV cache.
 
     params/cfg are the `models.gpt` pytree and config; `slots` is the
-    resident decode batch (the cache's B), `max_len` the per-sequence
-    cache capacity (prompt + generated). All device work happens in
-    `step()`; `submit()`/`tokens_for()` are the request-side API.
-    """
+    resident decode batch, `max_len` the per-sequence logical capacity
+    (prompt + generated). `block_size` sets the paging granule and
+    `cache_blocks` the pool's usable block count (default: enough for
+    every slot at full length — shrink it to trade HBM for prefix-cache
+    churn). `prefill_chunk` caps prompt tokens absorbed per scheduler
+    tick while anything is decoding; `prefix_cache=False` disables the
+    radix tree. All device work happens in `step()`."""
 
     def __init__(self, params, cfg, *, slots: int = 4,
                  max_len: int | None = None,
                  prefill_buckets: tuple[int, ...] | None = None,
+                 block_size: int = 16,
+                 cache_blocks: int | None = None,
+                 prefill_chunk: int | None = None,
+                 prefix_cache: bool = True,
                  mesh=None, seed: int = 0):
         import jax
         import jax.numpy as jnp
@@ -96,12 +355,28 @@ class InferenceEngine:
         self.mesh = mesh
         self.num_slots = slots
         self.max_len = cfg.max_seq_len if max_len is None else max_len
+        self.block_size = block_size
+        self.max_blocks = -(-self.max_len // block_size)
+        self.cache_blocks = (slots * self.max_blocks
+                             if cache_blocks is None else cache_blocks)
         self.buckets = tuple(sorted(
             b for b in (prefill_buckets or _default_buckets(self.max_len))
             if b <= self.max_len))
         if not self.buckets:
             raise ValueError("no prefill bucket <= max_len")
-        self.cache = gpt.init_kv_cache(cfg, slots, self.max_len, mesh)
+        self.prefill_chunk = (min(64, self.buckets[-1])
+                              if prefill_chunk is None else prefill_chunk)
+        # Chunk capacities: the existing buckets up to the budget, plus
+        # the budget itself — one prefill compile per capacity, ever.
+        self.chunk_buckets = tuple(sorted(
+            {b for b in self.buckets if b < self.prefill_chunk}
+            | {self.prefill_chunk}))
+        # +1: physical block 0 is the trash block (idle rows write there).
+        self.cache = gpt.init_kv_pool(cfg, self.cache_blocks + 1,
+                                      block_size, mesh)
+        self._alloc = BlockAllocator(self.cache_blocks + 1)
+        self._tree = (RadixTree(block_size, self._alloc)
+                      if prefix_cache else None)
         self._base_key = jax.random.PRNGKey(seed)
 
         # Compile-once accounting: the counters increment inside the
@@ -119,64 +394,85 @@ class InferenceEngine:
             ).astype(jnp.int32)
             return jnp.where(temps > 0, sampled, greedy)
 
-        def _prefill(params, tokens, cache, slot, length, temp, key,
-                     step):
+        def _prefill(params, tokens, cache, table, start, length, temp,
+                     key, step):
             self.prefill_traces += 1
-            logits, cache = gpt.prefill(
-                params, tokens, cache, cfg, mesh,
-                lengths=length[None], slot=slot)
+            logits, cache = gpt.prefill_paged(
+                params, tokens, cache, cfg, mesh, block_table=table,
+                start=start, length=length)
             tok = _sample(logits, temp[None], key, step)[0]
             return tok, cache
 
-        def _decode(params, cache, tokens, pos, temps, key, step):
+        def _decode(params, cache, tokens, pos, tables, temps, key,
+                    step):
             self.decode_traces += 1
-            logits, cache = gpt.decode_step(
-                params, tokens, cache, pos, cfg, mesh)
+            logits, cache = gpt.decode_step_paged(
+                params, tokens, cache, pos, tables, cfg, mesh)
             return _sample(logits, temps, key, step), cache
 
-        # Cache donation: the [L, S, max_len, H, D] k/v buffers are by
-        # far the engine's biggest arrays; donating them lets XLA alias
-        # input to output so every step updates the cache in place in
-        # HBM instead of allocating a second copy.
+        # Cache donation: the [L, n_blocks, bs, H, D] pool is by far the
+        # engine's biggest array; donating it lets XLA alias input to
+        # output so every step updates the pool in place in HBM.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(2,))
         self._decode_fn = jax.jit(_decode, donate_argnums=(1,))
+        self._copy_fn = jax.jit(gpt.copy_block, donate_argnums=(0,))
 
         self._slots = [_Slot() for _ in range(slots)]
         self._pending: collections.deque[_Pending] = collections.deque()
         self._rid = 0
+        self._admit_seq = 0
         # rid -> deque of emitted token ids; rid dropped when done AND
-        # drained (tokens_for pops, then deletes).
+        # drained (tokens_for pops, then deletes) or cancelled.
         self._out: dict[int, collections.deque] = {}
         self._done: set[int] = set()
         self._lock = threading.RLock()
         self._decode_steps = 0
         self._step_times = collections.deque(maxlen=512)
         self._occupancy = collections.deque(maxlen=512)
+        self._block_util = collections.deque(maxlen=512)
         self._prefill_tokens = 0
         self._decode_tokens = 0
         self._prefill_time = 0.0
         self._decode_time = 0.0
+        self._prefill_chunks = 0
+        self._prefix_hit_tokens = 0
+        self._prompt_tokens = 0
+        self._cow_copies = 0
+        self._evicted_blocks = 0
+        self._cancelled = 0
+        self._max_admission_stall = 0.0
 
     # ------------------------------------------------------------------
     # request side
     # ------------------------------------------------------------------
 
+    def _blocks_for(self, p: int, max_new: int) -> int:
+        """Blocks a request's full footprint needs: prefill writes
+        positions 0..p-1, decode writes p..p+max_new-2 (the final
+        sampled token is never written)."""
+        highest = p - 1 + max(max_new - 1, 0)
+        return highest // self.block_size + 1
+
     def submit(self, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0,
                eos_id: int | None = None) -> int:
         """Queue a prompt (sequence of token ids); returns a request id
-        for `tokens_for`. Admission happens inside `step()`."""
+        for `tokens_for`. Admission happens inside `step()` — long
+        prompts are absorbed in chunks, so there is no per-bucket prompt
+        length limit, only the cache-capacity ones."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        if prompt.size > self.buckets[-1]:
-            raise ValueError(
-                f"prompt length {prompt.size} exceeds largest prefill "
-                f"bucket {self.buckets[-1]}")
         if prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
                 f"exceeds cache max_len {self.max_len}")
+        if self._blocks_for(prompt.size, max_new_tokens) > \
+                self.cache_blocks:
+            raise ValueError(
+                f"request footprint "
+                f"{self._blocks_for(prompt.size, max_new_tokens)} blocks "
+                f"exceeds cache blocks {self.cache_blocks}")
         with self._lock:
             rid = self._rid
             self._rid += 1
@@ -185,29 +481,57 @@ class InferenceEngine:
                                           temperature, eos_id))
         return rid
 
+    def cancel(self, rid: int) -> bool:
+        """Abort a request wherever it is — pending, mid-prefill,
+        decoding, or finished-but-undrained — releasing its cache
+        blocks and output queue. Idempotent; returns True if anything
+        was released."""
+        with self._lock:
+            hit = False
+            for i, req in enumerate(self._pending):
+                if req.rid == rid:
+                    del self._pending[i]
+                    hit = True
+                    break
+            for i, s in enumerate(self._slots):
+                if s.rid == rid:
+                    self._release(i)
+                    hit = True
+                    break
+            hit |= self._out.pop(rid, None) is not None
+            self._done.discard(rid)
+            if hit:
+                self._cancelled += 1
+            return hit
+
     def tokens_for(self, rid: int):
         """Generator of generated token ids for one request. Pumps the
         shared engine: each next() ticks `step()` (under the lock) until
         this request has output, so N concurrent consumers collectively
-        drive one continuously-batched device loop."""
-        while True:
-            tok = None
-            with self._lock:   # pop under the lock, yield OUTSIDE it —
-                # a generator suspends at yield, and a suspended holder
-                # would block every other consumer's pump.
-                q = self._out.get(rid)
-                if q is None:
+        drive one continuously-batched device loop. Abandoning the
+        generator (break / close / GC) cancels the request and releases
+        its cache blocks."""
+        try:
+            while True:
+                tok = None
+                with self._lock:   # pop under the lock, yield OUTSIDE
+                    # it — a generator suspends at yield, and a
+                    # suspended holder would block every consumer's pump.
+                    q = self._out.get(rid)
+                    if q is None:
+                        return
+                    while not q and rid not in self._done:
+                        self.step()
+                    if q:
+                        tok = q.popleft()
+                    if rid in self._done and not q:
+                        self._done.discard(rid)
+                        del self._out[rid]
+                if tok is None:
                     return
-                while not q and rid not in self._done:
-                    self.step()
-                if q:
-                    tok = q.popleft()
-                if rid in self._done and not q:
-                    self._done.discard(rid)
-                    del self._out[rid]
-            if tok is None:
-                return
-            yield tok
+                yield tok
+        finally:
+            self.cancel(rid)
 
     def generate(self, prompt, **kw) -> list[int]:
         """Blocking convenience: submit + drain one request."""
@@ -217,75 +541,215 @@ class InferenceEngine:
     # scheduler
     # ------------------------------------------------------------------
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
+    def _chunk_bucket_for(self, n: int) -> int:
+        for b in self.chunk_buckets:
             if n <= b:
                 return b
-        raise ValueError(f"no bucket for prompt length {n}")
+        raise ValueError(f"no chunk bucket for length {n}")
 
-    def _admit(self, slot_idx: int, req: _Pending):
-        jnp = self._jax.numpy
+    def _release(self, slot_idx: int):
+        s = self._slots[slot_idx]
+        for b in s.blocks:
+            self._alloc.decref(b)
+        self._slots[slot_idx] = _Slot()
+
+    def _try_admit(self, slot_idx: int, req: _Pending) -> bool:
+        """Allocate a slot's blocks (sharing any cached prefix) and put
+        it in the prefill phase. Returns False — leaving the request
+        pending — if the pool can't supply the footprint even after
+        evicting zero-ref cached prefixes."""
+        bs = self.block_size
         p = req.prompt.size
-        bucket = self._bucket_for(p)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :p] = req.prompt
+        total = self._blocks_for(p, req.max_new_tokens)
+        blocks, matched = ([], 0)
+        if self._tree is not None:
+            blocks, matched = self._tree.match(req.prompt)
+        # Always leave >= 1 token to prefill: the request's first
+        # generated token is sampled from its final prefill chunk.
+        matched = min(matched, p - 1)
+        blocks = blocks[:-(-matched // bs)] if matched else []
+        n_full = matched // bs
+        partial = matched % bs != 0
+        # Reference the shared blocks BEFORE any eviction so the tree
+        # can't free them out from under this admission.
+        for b in blocks:
+            self._alloc.ref(b)
+        fresh_needed = total - n_full
+        if self._alloc.free < fresh_needed and self._tree is not None:
+            self._evicted_blocks += self._tree.evict(
+                fresh_needed - self._alloc.free)
+        if self._alloc.free < fresh_needed:
+            for b in blocks:
+                self._alloc.decref(b)
+            return False
+        fresh = [self._alloc.alloc() for _ in range(fresh_needed)]
+        slot_blocks = blocks[:n_full] + fresh
+        if partial:
+            # Copy-on-write: the matched prefix ends inside a shared
+            # block; this request's own tokens land in that block, so
+            # copy it into a private one first.
+            src, dst = blocks[n_full], fresh[0]
+            self.cache = self._copy_fn(self.cache, np.int32(src),
+                                       np.int32(dst))
+            self._cow_copies += 1
+            self._alloc.decref(src)
+        table = np.zeros((self.max_blocks,), np.int32)
+        table[:len(slot_blocks)] = slot_blocks
+        s = self._slots[slot_idx]
+        s.rid, s.phase = req.rid, "prefill"
+        s.prompt, s.filled = req.prompt, matched
+        s.blocks, s.table = slot_blocks, table
+        s.order = self._admit_seq
+        self._admit_seq += 1
+        s.temperature, s.eos_id = req.temperature, req.eos_id
+        s.remaining = req.max_new_tokens
+        self._prefix_hit_tokens += matched
+        self._prompt_tokens += p
+        return True
+
+    def _admit_pending(self) -> bool:
+        """Move pending requests into idle slots. A request whose first
+        block of tokens matches an in-flight prefill's is deferred one
+        tick — once that prefill completes and its full blocks enter
+        the radix tree, the latecomer admits by reference instead of
+        re-prefilling the shared prefix."""
+        if not self._pending:
+            return False
+        free = [i for i, s in enumerate(self._slots)
+                if s.phase == "idle"]
+        if not free:
+            return False
+        bs = self.block_size
+        heads = set()
+        if self._tree is not None:
+            heads = {tuple(s.prompt[:bs].tolist())
+                     for s in self._slots
+                     if s.phase == "prefill" and s.prompt.size >= bs}
+        admitted, keep = False, []
+        for req in self._pending:
+            head = (tuple(req.prompt[:bs].tolist())
+                    if req.prompt.size >= bs else None)
+            if not free or (head is not None and head in heads
+                            and self._tree is not None):
+                keep.append(req)
+                continue
+            if self._try_admit(free[0], req):
+                free.pop(0)
+                admitted = True
+                if head is not None:
+                    heads.add(head)
+            else:
+                keep.append(req)
+        self._pending = collections.deque(keep)
+        return admitted
+
+    def _run_prefill_chunk(self, slot_idx: int):
+        jnp = self._jax.numpy
+        s = self._slots[slot_idx]
+        clen = min(self.prefill_chunk, s.prompt.size - s.filled)
+        cap = self._chunk_bucket_for(clen)
+        toks = np.zeros((1, cap), np.int32)
+        toks[0, :clen] = s.prompt[s.filled:s.filled + clen]
         t0 = time.perf_counter()
         tok, self.cache = self._prefill_fn(
             self.params, jnp.asarray(toks), self.cache,
-            np.int32(slot_idx), np.int32(p),
-            np.float32(req.temperature), self._base_key,
+            jnp.asarray(s.table), np.int32(s.filled), np.int32(clen),
+            np.float32(s.temperature), self._base_key,
             np.int32(self._decode_steps))
         tok = int(tok)    # device sync, so the timing is honest
         self._prefill_time += time.perf_counter() - t0
-        self._prefill_tokens += p
-        s = self._slots[slot_idx]
-        s.rid, s.token, s.pos = req.rid, tok, p
-        s.remaining = req.max_new_tokens - 1
-        s.temperature = req.temperature
-        s.eos_id = req.eos_id
+        self._prefill_tokens += clen
+        self._prefill_chunks += 1
+        s.filled += clen
+        if s.filled < s.prompt.size:
+            return
+        # Prefill complete: publish the prompt's full blocks to the
+        # radix tree (decode writes only past them, so they are
+        # immutable), then join the decode batch.
+        if self._tree is not None and s.prompt.size >= self.block_size:
+            self._tree.insert(s.prompt, s.blocks)
+        s.phase = "decode"
+        s.token, s.pos = tok, s.prompt.size
+        s.remaining -= 1
         self._emit(s, slot_idx, tok)
 
+    def _prefill_tick(self, had_decoders: bool) -> bool:
+        """Run prefill chunks: at most ONE while anything is decoding
+        (the per-tick admission budget that bounds decode stall); drain
+        freely when the engine is otherwise idle — nobody is waiting."""
+        did = False
+        while True:
+            prefilling = [i for i, s in enumerate(self._slots)
+                          if s.phase == "prefill"]
+            if not prefilling:
+                return did
+            prefilling.sort(key=lambda i: self._slots[i].order)
+            self._run_prefill_chunk(prefilling[0])
+            did = True
+            if had_decoders:
+                return did
+
     def _emit(self, s: _Slot, slot_idx: int, tok: int):
-        """Route one generated token; retire the slot when finished."""
+        """Route one generated token; retire the slot (releasing its
+        blocks) when finished."""
         self._out[s.rid].append(tok)
         hit_eos = s.eos_id is not None and tok == s.eos_id
         # pos of the *next* token; it must still fit in the cache row.
         if s.remaining <= 0 or hit_eos or s.pos + 1 >= self.max_len:
             self._done.add(s.rid)
-            self._slots[slot_idx] = _Slot()
+            self._release(slot_idx)
 
     def step(self) -> bool:
-        """One scheduler tick: admit pending requests into free slots
-        (prefill, which also emits each request's first token), then one
-        decode step for every resident sequence. Returns True if any
-        device work happened."""
+        """One scheduler tick: admit pending requests into free slots,
+        run at most one prefill chunk if anything is decoding (all
+        pending prefill work otherwise), then one decode step for every
+        resident sequence. Returns True if any device work happened."""
         with self._lock:
-            free = [i for i, s in enumerate(self._slots) if not s.active]
-            admitted = 0
-            while free and self._pending:
-                self._admit(free.pop(0), self._pending.popleft())
-                admitted += 1
+            t_tick = time.perf_counter()
+            had_decoders = any(s.phase == "decode" for s in self._slots)
+            admitted = self._admit_pending()
+            chunked = self._prefill_tick(had_decoders)
+            if had_decoders and (admitted or chunked):
+                self._max_admission_stall = max(
+                    self._max_admission_stall,
+                    time.perf_counter() - t_tick)
             active = [i for i, s in enumerate(self._slots) if s.active]
             self._occupancy.append(len(active) / self.num_slots)
-            if not active:   # idle, or every admission finished at token 1
-                return admitted > 0
+            self._block_util.append(
+                self._alloc.used / max(self.cache_blocks, 1))
+            decoding = [i for i, s in enumerate(self._slots)
+                        if s.phase == "decode"]
+            if not decoding:   # idle, or every admission finished early
+                return admitted or chunked
             jnp = self._jax.numpy
-            tokens = np.array([s.token for s in self._slots], np.int32)
-            pos = np.array([s.pos for s in self._slots], np.int32)
+            # Rows not decoding (idle or mid-prefill) point at the trash
+            # block with pos 0: their garbage write collides harmlessly
+            # there and their sampled token is never read.
+            zeros = np.zeros((self.max_blocks,), np.int32)
+            tokens = np.array(
+                [s.token if s.phase == "decode" else 0
+                 for s in self._slots], np.int32)
+            pos = np.array(
+                [s.pos if s.phase == "decode" else 0
+                 for s in self._slots], np.int32)
+            tables = np.stack(
+                [s.table if s.phase == "decode" else zeros
+                 for s in self._slots])
             temps = np.array([s.temperature for s in self._slots],
                              np.float32)
             t0 = time.perf_counter()
             nxt, self.cache = self._decode_fn(
                 self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(pos), jnp.asarray(temps), self._base_key,
+                jnp.asarray(pos), jnp.asarray(tables),
+                jnp.asarray(temps), self._base_key,
                 np.int32(self._decode_steps))
             nxt = np.asarray(nxt)    # device sync
             dt = time.perf_counter() - t0
             self._step_times.append(dt)
             self._decode_time += dt
             self._decode_steps += 1
-            self._decode_tokens += len(active)
-            for i in active:
+            self._decode_tokens += len(decoding)
+            for i in decoding:
                 s = self._slots[i]
                 s.token, s.pos = int(nxt[i]), s.pos + 1
                 s.remaining -= 1
@@ -306,21 +770,43 @@ class InferenceEngine:
     # introspection
     # ------------------------------------------------------------------
 
+    def check_invariants(self):
+        """Allocator/tree/slot cross-checks for the fuzz tests: every
+        allocated block is accounted for by exactly its holders."""
+        self._alloc.check()
+        holds = collections.Counter()
+        for s in self._slots:
+            holds.update(s.blocks)
+        if self._tree is not None:
+            for nd in self._tree._nodes():
+                holds.update(nd.blocks)
+        for b in range(1, self._alloc.n_blocks):
+            assert self._alloc.refcount(b) == holds[b], \
+                f"block {b}: refcount {self._alloc.refcount(b)} != " \
+                f"{holds[b]} holders"
+
     def reset_stats(self):
         """Zero the throughput/latency accounting (NOT the trace
-        counters) — benches call this after warmup so compile time stays
-        out of the timed region."""
+        counters or the cache itself) — benches call this after warmup
+        so compile time stays out of the timed region."""
         with self._lock:
             self._decode_steps = 0
             self._prefill_tokens = self._decode_tokens = 0
             self._prefill_time = self._decode_time = 0.0
+            self._prefill_chunks = 0
+            self._prefix_hit_tokens = self._prompt_tokens = 0
+            self._cow_copies = self._evicted_blocks = 0
+            self._cancelled = 0
+            self._max_admission_stall = 0.0
             self._step_times.clear()
             self._occupancy.clear()
+            self._block_util.clear()
 
     def stats(self) -> dict:
         with self._lock:
             times = sorted(self._step_times)
             occ = list(self._occupancy)
+            util = list(self._block_util)
 
             def pct(p):
                 if not times:
@@ -338,9 +824,27 @@ class InferenceEngine:
                 "decode_time_s": self._decode_time,
                 "prefill_traces": self.prefill_traces,
                 "decode_traces": self.decode_traces,
+                "prefill_chunks": self._prefill_chunks,
                 "slot_occupancy": (sum(occ) / len(occ)) if occ else 0.0,
                 "p50_token_latency_ms": pct(50),
                 "p99_token_latency_ms": pct(99),
+                # paged-cache accounting
+                "block_size": self.block_size,
+                "cache_blocks": self.cache_blocks,
+                "blocks_in_use": self._alloc.used,
+                "blocks_free": self._alloc.free,
+                "cached_prefix_blocks": (self._tree.n_blocks()
+                                         if self._tree else 0),
+                "cache_block_utilization": (sum(util) / len(util)
+                                            if util else 0.0),
+                "prefix_hit_rate": (
+                    self._prefix_hit_tokens / self._prompt_tokens
+                    if self._prompt_tokens else 0.0),
+                "prefix_hit_tokens": self._prefix_hit_tokens,
+                "cow_copies": self._cow_copies,
+                "evicted_blocks": self._evicted_blocks,
+                "cancelled": self._cancelled,
+                "max_admission_stall_ms": self._max_admission_stall * 1e3,
             }
 
 
@@ -349,7 +853,9 @@ class InferenceReplica:
     a generator of token ids, which `serve.replica` automatically turns
     into a `next_chunks` stream — so `handle.stream(prompt)` yields
     tokens as they are decoded, and concurrent requests continuously
-    batch into the shared engine's slots.
+    batch into the shared engine's slots. A client that walks away
+    mid-stream closes the generator, which cancels the request and
+    frees its cache blocks.
 
     Construction takes *config kwargs*, not arrays: params are
     initialized on the replica from `seed`, so nothing heavyweight rides
@@ -373,6 +879,9 @@ class InferenceReplica:
         rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                  temperature=temperature)
         return self.engine.tokens_for(rid)
+
+    def cancel(self, rid: int) -> bool:
+        return self.engine.cancel(rid)
 
     def stats(self) -> dict:
         return self.engine.stats()

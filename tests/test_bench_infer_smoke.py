@@ -31,3 +31,27 @@ def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     assert rec["value"] == rec["decode_tokens_per_sec"]
     assert 0 < rec["slot_occupancy"] <= 1.0
     assert rec["p50_token_latency_ms"] <= rec["p99_token_latency_ms"]
+    # paged-cache fields of the JSON contract
+    assert 0.0 <= rec["prefix_hit_rate"] <= 1.0
+    assert 0.0 < rec["cache_block_utilization"] <= 1.0
+    assert rec["max_admission_stall_ms"] >= 0.0
+    assert rec["block_size"] > 0 and rec["cache_blocks"] > 0
+    assert rec["shared_prefix"] == 0
+
+
+def test_bench_infer_shared_prefix_knobs(capsys, monkeypatch):
+    """Shared-prefix + ragged workload: the radix cache must register
+    hits and the JSON must echo the knob."""
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_REQUESTS", "4")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_NEW", "3")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_PROMPT", "24")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_SHARED_PREFIX", "16")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_RAGGED", "1")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_BLOCK", "8")
+    import bench_infer
+
+    bench_infer.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["shared_prefix"] == 16
+    assert rec["block_size"] == 8
+    assert rec["prefix_hit_rate"] > 0.0, rec

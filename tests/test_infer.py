@@ -341,10 +341,16 @@ class TestInferenceEngine:
         eng = self._engine(cfg, params)
         with pytest.raises(ValueError, match="empty"):
             eng.submit([])
-        with pytest.raises(ValueError, match="largest prefill"):
-            eng.submit(list(range(17)))
+        # chunked prefill removed the old bucket-length limit: a prompt
+        # longer than the largest prefill bucket is fine as long as it
+        # fits the cache.
+        assert len(eng.generate(list(range(1, 18)),
+                                max_new_tokens=4)) == 4
         with pytest.raises(ValueError, match="max_len"):
             eng.submit([1, 2], max_new_tokens=31)
+        tiny = self._engine(cfg, params, cache_blocks=1)
+        with pytest.raises(ValueError, match="blocks"):
+            tiny.submit(list(range(1, 18)), max_new_tokens=4)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,585 @@
+"""Paged KV cache tests: block-table attention parity, the paged model
+path vs. full forward, BlockAllocator and RadixTree invariants, engine
+prefix sharing (shared system prompt prefilled exactly once, COW on
+mid-block divergence), chunked-admission stall bounds, cancellation and
+abandoned-stream cleanup, eviction under pool pressure, and a seeded
+admit/cancel/retire fuzz (small here; the big variant is `slow`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt
+from ray_tpu.ops import decode_attention as da
+from ray_tpu.serve.engine import BlockAllocator, InferenceEngine, RadixTree
+
+
+def tiny_cfg(**kw):
+    return gpt.GPTConfig(**{**dict(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype="float32"), **kw})
+
+
+def rollout_reference(params, prompt, cfg, steps):
+    """Greedy generation via repeated FULL forward passes."""
+    toks = list(prompt)
+    for _ in range(steps):
+        logits = gpt.forward(params, jnp.asarray([toks]), cfg)[0, -1]
+        toks.append(int(jnp.argmax(logits)))
+    return toks[len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("block_size", 8)
+    return InferenceEngine(params, cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention
+# ---------------------------------------------------------------------------
+
+class TestPagedAttention:
+    def _paged(self, b, s, h, d, bs, seed=0):
+        """Random contiguous K/V scattered into a scrambled block pool;
+        returns (q, k, v, pools, tables, pos)."""
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (b, h, d))
+        k = jax.random.normal(ks[1], (b, s, h, d))
+        v = jax.random.normal(ks[2], (b, s, h, d))
+        mb = s // bs
+        rng = np.random.default_rng(seed)
+        # one shared pool; each sequence owns a disjoint scrambled set
+        perm = rng.permutation(b * mb) + 1      # keep block 0 unused
+        tables = perm.reshape(b, mb).astype(np.int32)
+        kp = np.zeros((b * mb + 1, bs, h, d), np.float32)
+        vp = np.zeros_like(kp)
+        for i in range(b):
+            for j in range(mb):
+                kp[tables[i, j]] = np.asarray(k[i, j * bs:(j + 1) * bs])
+                vp[tables[i, j]] = np.asarray(v[i, j * bs:(j + 1) * bs])
+        pos = jnp.array([s - 1, 3][:b], jnp.int32)
+        return q, k, v, jnp.asarray(kp), jnp.asarray(vp), \
+            jnp.asarray(tables), pos
+
+    def test_gather_reassembles_contiguous_kv(self):
+        q, k, v, kp, vp, tables, pos = self._paged(2, 32, 2, 8, 8)
+        got = da.gather_kv_pages(kp, tables)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(k))
+
+    def test_paged_matches_unpaged(self):
+        """Attention through a scrambled block table == attention over
+        the contiguous cache it encodes."""
+        q, k, v, kp, vp, tables, pos = self._paged(2, 32, 2, 8, 8)
+        ref = da.decode_attention(q, k, v, pos, impl="jax")
+        out = da.paged_decode_attention(q, kp, vp, tables, pos,
+                                        impl="jax")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_reference_and_auto_agree(self):
+        q, k, v, kp, vp, tables, pos = self._paged(2, 64, 2, 16, 16,
+                                                   seed=3)
+        ref = da.reference_paged_decode_attention(q, kp, vp, tables,
+                                                  pos)
+        out = da.paged_decode_attention(q, kp, vp, tables, pos)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_masks_beyond_pos(self):
+        """Blocks past pos — including live blocks of OTHER sequences
+        in the shared pool — must not leak in."""
+        q, k, v, kp, vp, tables, pos = self._paged(2, 32, 2, 8, 8)
+        # corrupt everything strictly past each row's pos
+        kp2, vp2 = np.array(kp), np.array(vp)
+        for i in range(2):
+            p = int(pos[i])
+            for j in range((p // 8), 4):
+                off = p + 1 - j * 8
+                if off < 8:
+                    kp2[tables[i, j], max(off, 0):] = 1e4
+                    vp2[tables[i, j], max(off, 0):] = -1e4
+        out = da.paged_decode_attention(q, kp, vp, tables, pos,
+                                        impl="jax")
+        out2 = da.paged_decode_attention(q, jnp.asarray(kp2),
+                                         jnp.asarray(vp2), tables, pos,
+                                         impl="jax")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# ---------------------------------------------------------------------------
+# paged model path
+# ---------------------------------------------------------------------------
+
+class TestPagedModelPath:
+    def test_chunked_prefill_then_decode_matches_full_forward(self,
+                                                              setup):
+        """Prefill in 2 chunks through a scrambled table, then decode
+        greedily — token-for-token equal to full-forward rollout."""
+        cfg, params = setup
+        bs, chunks = 8, (8, 4)
+        prompt = list(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, 12))
+        pool = gpt.init_kv_pool(cfg, 8, bs)
+        table = np.array([5, 2, 7, 1], np.int32)
+        start = 0
+        for clen in chunks:
+            toks = np.zeros((1, 8), np.int32)
+            toks[0, :clen] = prompt[start:start + clen]
+            logits, pool = gpt.prefill_paged(
+                params, jnp.asarray(toks), pool, cfg,
+                block_table=jnp.asarray(table), start=start,
+                length=jnp.int32(clen))
+            start += clen
+        toks_out, cur = [], int(jnp.argmax(logits[0]))
+        tables = jnp.asarray(table)[None]
+        for t in range(len(prompt), len(prompt) + 6):
+            toks_out.append(cur)
+            logits, pool = gpt.decode_step_paged(
+                params, jnp.asarray([cur], jnp.int32), pool,
+                jnp.asarray([t], jnp.int32), tables, cfg)
+            cur = int(jnp.argmax(logits[0]))
+        assert toks_out == rollout_reference(params, prompt, cfg, 6)
+
+    def test_copy_block(self, setup):
+        cfg, params = setup
+        pool = gpt.init_kv_pool(cfg, 4, 8)
+        pool = {k: v + jnp.arange(4, dtype=v.dtype)[None, :, None,
+                                                    None, None]
+                for k, v in pool.items()}
+        out = gpt.copy_block(pool, 3, 1)
+        np.testing.assert_array_equal(np.asarray(out["k"][:, 1]),
+                                      np.asarray(out["k"][:, 3]))
+        np.testing.assert_array_equal(np.asarray(out["v"][:, 2]),
+                                      2 * np.ones_like(
+                                          np.asarray(out["v"][:, 2])))
+
+    def test_pool_sharding_specs(self, setup):
+        from ray_tpu.parallel import MeshSpec
+        from ray_tpu.parallel.sharding import kv_pool_specs
+        cfg, _ = setup
+        mesh = MeshSpec(data=-1).build(jax.devices())
+        specs = kv_pool_specs(mesh)
+        assert set(specs) == {"k", "v"}
+        pool = gpt.init_kv_pool(tiny_cfg(n_layers=1), 4, 8, mesh=mesh)
+        assert pool["k"].sharding.spec == specs["k"]
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+
+class TestBlockAllocator:
+    def test_alloc_free_cycle(self):
+        a = BlockAllocator(5)        # blocks 1..4 usable
+        got = [a.alloc() for _ in range(4)]
+        assert sorted(got) == [1, 2, 3, 4]
+        assert a.free == 0 and a.used == 4
+        with pytest.raises(RuntimeError, match="out of"):
+            a.alloc()
+        for b in got:
+            a.decref(b)
+        assert a.free == 4 and a.used == 0
+        a.check()
+
+    def test_refcounts(self):
+        a = BlockAllocator(3)
+        b = a.alloc()
+        a.ref(b)
+        assert a.refcount(b) == 2
+        a.decref(b)
+        assert a.used == 1           # still held once
+        a.decref(b)
+        assert a.used == 0
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(3)
+        b = a.alloc()
+        a.decref(b)
+        with pytest.raises(RuntimeError, match="double free"):
+            a.decref(b)
+        with pytest.raises(RuntimeError, match="ref of free"):
+            a.ref(b)
+        with pytest.raises(RuntimeError):
+            a.decref(0)              # trash block is never freeable
+        a.check()
+
+    def test_too_small(self):
+        with pytest.raises(ValueError):
+            BlockAllocator(1)
+
+
+# ---------------------------------------------------------------------------
+# radix tree
+# ---------------------------------------------------------------------------
+
+class TestRadixTree:
+    def _tree(self, bs=4, n=32):
+        a = BlockAllocator(n)
+        return RadixTree(bs, a), a
+
+    def test_insert_match_aligned(self):
+        t, a = self._tree()
+        x = list(range(8))
+        bx = [a.alloc(), a.alloc()]
+        t.insert(x, bx)
+        assert t.match(x) == (bx, 8)
+        assert t.match(x[:4]) == (bx[:1], 4)
+        assert t.match(x + [99]) == (bx, 8)
+        assert t.match([99]) == ([], 0)
+        assert a.refcount(bx[0]) == 2    # ours + the tree's
+
+    def test_partial_block_match(self):
+        t, a = self._tree()
+        x = list(range(8))
+        bx = [a.alloc(), a.alloc()]
+        t.insert(x, bx)
+        blocks, m = t.match([0, 1, 2, 3, 4, 5, 77])
+        assert m == 6                    # diverges inside block 2
+        assert blocks == bx              # last block shared partially
+
+    def test_split_on_divergence(self):
+        t, a = self._tree()
+        x = list(range(8))
+        bx = [a.alloc(), a.alloc()]
+        t.insert(x, bx)
+        y = x[:4] + [9, 9, 9, 9]
+        c = a.alloc()
+        t.insert(y, [bx[0], c])          # engine passes shared + own
+        assert t.n_nodes() == 3          # split: upper + two tails
+        assert t.match(x) == (bx, 8)
+        assert t.match(y) == ([bx[0], c], 8)
+        assert a.refcount(bx[0]) == 2    # shared head ref'd ONCE by tree
+        assert a.refcount(c) == 2
+
+    def test_insert_existing_is_noop(self):
+        t, a = self._tree()
+        x = list(range(8))
+        bx = [a.alloc(), a.alloc()]
+        t.insert(x, bx)
+        t.insert(x, bx)
+        assert t.n_nodes() == 1
+        assert a.refcount(bx[0]) == 2
+
+    def test_evict_lru_zero_ref_leaves(self):
+        t, a = self._tree()
+        x = list(range(8))
+        bx = [a.alloc(), a.alloc()]
+        t.insert(x, bx)
+        y = x[:4] + [9, 9, 9, 9]
+        c = a.alloc()
+        t.insert(y, [bx[0], c])
+        for b in (*bx, c):               # drop our refs: tree-only now
+            a.decref(b)
+        t.match(y)                       # y's path is most recent
+        assert t.evict(1) == 1           # LRU victim: x's tail [bx[1]]
+        assert t.match(x) == ([bx[0]], 4)
+        assert t.match(y) == ([bx[0], c], 8)
+        # referenced blocks are never evicted
+        a.ref(c)
+        assert t.evict(10) == 0
+        a.decref(c)
+        t.clear()
+        assert t.n_blocks() == 0 and a.used == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: prefix sharing
+# ---------------------------------------------------------------------------
+
+class TestPrefixSharing:
+    def test_shared_system_prompt_prefilled_once(self, setup):
+        """The acceptance criterion: two requests sharing a 16-token
+        system prompt prefill it exactly once — asserted via the
+        engine's prefill-token counter — and both still decode exactly
+        what a cold engine decodes."""
+        cfg, params = setup
+        rng = np.random.default_rng(7)
+        sys_p = list(rng.integers(0, cfg.vocab_size, 16))
+        a = sys_p + list(rng.integers(0, cfg.vocab_size, 4))
+        b = sys_p + list(rng.integers(0, cfg.vocab_size, 4))
+
+        eng = make_engine(cfg, params)
+        ra = eng.submit(a, max_new_tokens=4)
+        rb = eng.submit(b, max_new_tokens=4)
+        eng.run_until_idle()
+        s = eng.stats()
+        # a: 20 prefilled; b: only its 4-token suffix
+        assert s["prefill_tokens"] == len(a) + 4
+        assert s["prefix_hit_tokens"] == 16
+        assert s["prefix_hit_rate"] == pytest.approx(16 / 40)
+        got_a = [eng._out[ra].popleft() for _ in range(4)]
+        got_b = [eng._out[rb].popleft() for _ in range(4)]
+        assert got_a == rollout_reference(params, a, cfg, 4)
+        assert got_b == rollout_reference(params, b, cfg, 4)
+        eng.check_invariants()
+
+    def test_cow_on_mid_block_divergence(self, setup):
+        """A prefix that diverges inside a cached block is shared
+        copy-on-write: one device block copy, identical tokens."""
+        cfg, params = setup
+        rng = np.random.default_rng(11)
+        x = list(rng.integers(0, cfg.vocab_size, 16))
+        y = x[:12] + list(rng.integers(0, cfg.vocab_size, 4))
+        eng = make_engine(cfg, params)
+        got_x = eng.generate(x, max_new_tokens=3)
+        got_y = eng.generate(y, max_new_tokens=3)
+        s = eng.stats()
+        assert s["cow_copies"] == 1
+        assert s["prefix_hit_tokens"] == 12
+        assert got_x == rollout_reference(params, x, cfg, 3)
+        assert got_y == rollout_reference(params, y, cfg, 3)
+        eng.check_invariants()
+
+    def test_decode_compiles_once_with_sharing(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(5)
+        sys_p = list(rng.integers(0, cfg.vocab_size, 8))
+        eng = make_engine(cfg, params)
+        for i in range(4):
+            tail = list(rng.integers(0, cfg.vocab_size, 2 + i))
+            eng.generate(sys_p + tail, max_new_tokens=3)
+        assert eng.decode_traces == 1
+        assert eng.stats()["prefix_hit_tokens"] > 0
+
+    def test_prefix_cache_off(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefix_cache=False)
+        p = list(range(1, 17))
+        g1 = eng.generate(p, max_new_tokens=3)
+        g2 = eng.generate(p, max_new_tokens=3)
+        assert g1 == g2
+        s = eng.stats()
+        assert s["prefix_hit_tokens"] == 0
+        assert s["prefill_tokens"] == 32
+        # nothing cached → pool drains completely between requests
+        assert s["blocks_in_use"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: chunked prefill
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_admission_never_stalls_decode_more_than_one_chunk(
+            self, setup):
+        """While a long prompt is being admitted, every scheduler tick
+        still advances the in-flight stream by one token and runs at
+        most ONE prefill chunk."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefill_chunk=8,
+                          prefix_cache=False)
+        eng.submit(list(range(1, 5)), max_new_tokens=24)
+        eng.step()                      # admit + drain tiny prefill
+        assert eng.stats()["decode_steps"] == 1
+        # now a 24-token prompt arrives: 3 chunks of 8
+        eng.submit(list(range(40, 64)), max_new_tokens=2)
+        for tick in range(1, 4):
+            before = eng.stats()
+            eng.step()
+            s = eng.stats()
+            assert s["prefill_chunks"] - before["prefill_chunks"] == 1
+            assert s["decode_steps"] - before["decode_steps"] == 1
+        assert s["prefill_chunks"] == 4     # 1 warm + 3 chunked
+        assert s["max_admission_stall_ms"] > 0.0
+        eng.run_until_idle()
+        eng.check_invariants()
+
+    def test_idle_engine_drains_prefill_freely(self, setup):
+        """With nothing decoding there is nobody to stall: one tick
+        absorbs every pending chunk."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefill_chunk=8,
+                          prefix_cache=False)
+        eng.submit(list(range(1, 25)), max_new_tokens=2)
+        eng.step()
+        s = eng.stats()
+        assert s["prefill_chunks"] == 3
+        assert s["prefill_tokens"] == 24
+
+    def test_long_prompt_beyond_buckets_decodes_correctly(self, setup):
+        """Chunking removed the bucket-length admission limit: a prompt
+        longer than the largest prefill bucket works and matches the
+        full-forward rollout."""
+        cfg, params = setup
+        prompt = list(np.random.default_rng(3).integers(
+            0, cfg.vocab_size, 26))
+        eng = make_engine(cfg, params, prefill_chunk=8)
+        assert eng.generate(prompt, max_new_tokens=4) == \
+            rollout_reference(params, prompt, cfg, 4)
+
+
+# ---------------------------------------------------------------------------
+# engine: cancellation and cleanup
+# ---------------------------------------------------------------------------
+
+class TestCancel:
+    def test_cancel_pending(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params)
+        rid = eng.submit([1, 2, 3], max_new_tokens=4)
+        assert eng.cancel(rid)
+        assert not eng.cancel(rid)      # idempotent
+        s = eng.stats()
+        assert s["pending"] == 0 and s["cancelled"] == 1
+        eng.check_invariants()
+
+    def test_cancel_mid_decode_releases_blocks(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefix_cache=False)
+        rid = eng.submit(list(range(1, 10)), max_new_tokens=20)
+        for _ in range(3):
+            eng.step()
+        assert eng.stats()["blocks_in_use"] > 0
+        assert eng.cancel(rid)
+        s = eng.stats()
+        assert s["blocks_in_use"] == 0 and s["active"] == 0
+        assert rid not in eng._out
+        eng.check_invariants()
+
+    def test_cancel_finished_undrained(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params)
+        rid = eng.submit([4, 5, 6], max_new_tokens=3)
+        eng.run_until_idle()
+        assert len(eng._out[rid]) == 3
+        assert eng.cancel(rid)
+        assert rid not in eng._out and rid not in eng._done
+
+    def test_abandoned_stream_releases_request(self, setup):
+        """Breaking out of `tokens_for` (generator finalization) must
+        cancel the request and free its blocks — the leak named in the
+        issue."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefix_cache=False)
+        rid = eng.submit(list(range(1, 9)), max_new_tokens=20)
+        it = eng.tokens_for(rid)
+        next(it)
+        assert eng.stats()["active"] == 1
+        it.close()                      # walk away mid-stream
+        s = eng.stats()
+        assert s["active"] == 0 and s["blocks_in_use"] == 0
+        assert s["cancelled"] == 1 and rid not in eng._out
+        eng.check_invariants()
+
+    def test_engine_continues_after_cancel(self, setup):
+        """Cancelling one stream must not disturb a co-resident one."""
+        cfg, params = setup
+        p = list(range(20, 28))
+        eng = make_engine(cfg, params, prefix_cache=False)
+        keep = eng.submit(p, max_new_tokens=6)
+        kill = eng.submit(list(range(1, 9)), max_new_tokens=6)
+        eng.step()
+        eng.cancel(kill)
+        eng.run_until_idle()
+        got = [eng._out[keep].popleft() for _ in range(6)]
+        assert got == rollout_reference(params, p, cfg, 6)
+
+
+# ---------------------------------------------------------------------------
+# engine: eviction under pressure
+# ---------------------------------------------------------------------------
+
+class TestEviction:
+    def test_cached_prefix_evicted_under_pressure(self, setup):
+        """A pool too small for two cached prompts evicts the zero-ref
+        prefix instead of failing admission."""
+        cfg, params = setup
+        rng = np.random.default_rng(13)
+        a = list(rng.integers(0, cfg.vocab_size, 16))
+        b = list(rng.integers(0, cfg.vocab_size, 16))
+        eng = make_engine(cfg, params, slots=1, cache_blocks=3)
+        got_a = eng.generate(a, max_new_tokens=2)
+        assert eng.stats()["blocks_in_use"] == 2   # a's prefix cached
+        got_b = eng.generate(b, max_new_tokens=2)
+        s = eng.stats()
+        assert s["evicted_blocks"] >= 2
+        assert got_a == rollout_reference(params, a, cfg, 2)
+        assert got_b == rollout_reference(params, b, cfg, 2)
+        eng.check_invariants()
+
+    def test_admission_waits_when_pool_fully_referenced(self, setup):
+        """When live requests hold every block, a newcomer stays
+        pending (no eviction possible) and admits once one retires."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, slots=2, cache_blocks=3,
+                          prefix_cache=False)
+        r1 = eng.submit(list(range(1, 17)), max_new_tokens=6)  # 3 blocks
+        eng.step()
+        r2 = eng.submit(list(range(30, 46)), max_new_tokens=6)
+        eng.step()
+        assert eng.stats()["pending"] == 1      # pool exhausted by r1
+        eng.run_until_idle()
+        assert len(eng._out[r1]) == 6 and len(eng._out[r2]) == 6
+        eng.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# fuzz: admit / cancel / retire
+# ---------------------------------------------------------------------------
+
+def _fuzz(setup, ops, seed, **engine_kw):
+    """Random submit/cancel/step/drain storm over a small-alphabet
+    token space (to force radix collisions, splits, COW and eviction),
+    checking allocator/tree/slot invariants after every operation."""
+    cfg, params = setup
+    eng = make_engine(cfg, params, slots=3, cache_blocks=9,
+                      **engine_kw)
+    rng = np.random.default_rng(seed)
+    live = []
+    for _ in range(ops):
+        op = rng.integers(0, 10)
+        if op < 4:      # submit (small alphabet → shared prefixes)
+            p = list(rng.integers(1, 5, int(rng.integers(1, 25))))
+            mnt = int(rng.integers(1, 6))
+            try:
+                live.append(eng.submit(p, max_new_tokens=mnt))
+            except ValueError:
+                pass    # footprint exceeds the pool — fine
+        elif op < 6 and live:   # cancel a random request
+            eng.cancel(live.pop(int(rng.integers(0, len(live)))))
+        elif op < 7 and live:   # drain one finished stream
+            rid = live.pop(0)
+            for _ in eng.tokens_for(rid):
+                pass
+        else:
+            eng.step()
+        eng.check_invariants()
+    for rid in live:
+        eng.cancel(rid)
+    eng.run_until_idle()
+    eng.check_invariants()
+    s = eng.stats()
+    assert s["active"] == 0 and s["pending"] == 0
+    # every block still allocated is held by the prefix cache only
+    assert s["blocks_in_use"] == s["cached_prefix_blocks"]
+    if eng._tree is not None:
+        eng._tree.clear()
+    assert eng.stats()["blocks_in_use"] == 0
+    eng.check_invariants()
+    return s
+
+
+def test_fuzz_small(setup):
+    s = _fuzz(setup, ops=40, seed=0)
+    assert s["decode_tokens"] > 0
+
+
+def test_fuzz_small_no_prefix_cache(setup):
+    _fuzz(setup, ops=30, seed=1, prefix_cache=False)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [2, 3, 4])
+def test_fuzz_large(setup, seed):
+    _fuzz(setup, ops=300, seed=seed)
