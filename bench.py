@@ -24,6 +24,8 @@ Knobs (env vars, platform-tuned defaults below):
   RAY_TPU_BENCH_INTERVAL  steps between host metric fetches
                           (loop.MetricsRing(interval=K))
   RAY_TPU_BENCH_BATCH / RAY_TPU_BENCH_STEPS  shape of the timed region
+  RAY_TPU_BENCH_CKPT_EVERY  async-snapshot cadence for the
+                          checkpoint-overhead region (ft.AsyncCheckpointer)
 
 The reference publishes no committed throughput numbers (BASELINE.md —
 "harness only"); its north star is "ResNet-50 / GPT wall-clock at >= NCCL
@@ -149,6 +151,34 @@ def main():
     dt = time.perf_counter() - t0
     assert np.isfinite(metrics[-1]["loss"])
 
+    # Checkpoint-overhead region: the SAME compiled loop reruns with an
+    # async checkpointer attached (device-side copies + background
+    # host fetch/commit — train/ft.py), so the delta vs the clean region
+    # is exactly what fault tolerance costs per step, end-of-run flush
+    # included.
+    import shutil
+    import tempfile
+
+    from ray_tpu.train import ft
+
+    ckpt_every = _env_int("RAY_TPU_BENCH_CKPT_EVERY",
+                          max(unroll, steps // 2))
+    ckpt_dir = tempfile.mkdtemp(prefix="ray_tpu_bench_ckpt_")
+    try:
+        ckpt = ft.AsyncCheckpointer(ckpt_dir, every=ckpt_every,
+                                    max_in_flight=2, keep=1)
+        train.checkpointer = ckpt
+        t0 = time.perf_counter()
+        state, metrics = train.run(state, batches, num_steps=steps)
+        dt_ckpt = time.perf_counter() - t0
+        train.checkpointer = None
+        assert np.isfinite(metrics[-1]["loss"])
+        assert ckpt.commits > 0, "checkpoint region committed nothing"
+        ckpt.close()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    checkpoint_overhead_pct = (dt_ckpt - dt) / dt * 100.0
+
     tokens_per_step = batch_size * cfg.max_seq_len
     tok_s = tokens_per_step * steps / dt
     flops_tok = spmd.train_flops_per_token(cfg, cfg.max_seq_len)
@@ -160,6 +190,7 @@ def main():
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 3),
+        "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
     }))
 
 

@@ -2460,6 +2460,7 @@ class NodeServer:
     def _schedule_pass(self):
         """One bounded dispatch pass. -> (n_dispatched, window_tripped)."""
         to_send = []   # (worker, message) executed outside the lock
+        retired = []   # over-cap idle workers killed outside the lock
         n_dispatched = 0
         tripped = False
         with self.lock:
@@ -2546,13 +2547,42 @@ class NodeServer:
             # `_spawning` counts workers from Popen until registration (or
             # failure); without it every schedule pass would re-spawn for the
             # same pending tasks while the first worker is still importing.
+            # Workers blocked in get() (w.released) gave their lease back,
+            # so they don't count against the cap either: a nested/reduce
+            # task blocked on an upstream result must never pin the last
+            # pool slot, or the producer can never run (the reference
+            # spawns replacement workers past the soft cap for exactly
+            # this reason, worker_pool.cc's blocked-worker accounting).
             n_generic = sum(1 for w in self.workers.values()
-                            if w.kind == "generic" and w.alive)
+                            if w.kind == "generic" and w.alive
+                            and not w.released)
             can = constants.MAX_WORKERS_CAP - n_generic - self._spawning
             for _ in range(max(0, min(want_spawn - self._spawning, can))):
                 self._spawning += 1
                 threading.Thread(target=self._spawn_generic_worker,
                                  daemon=True).start()
+            # --- worker pool scale-down ---
+            # Inverse of the blocked-worker carve-out above: once the
+            # blocked workers resume, the pool can sit over the cap.
+            # Retire idle surplus (never a busy or blocked worker, and
+            # only with an empty backlog) so one storm of nested gets
+            # doesn't leave extra worker processes around for the rest
+            # of the session.
+            if not self.pending:
+                alive_generic = [w for w in self.workers.values()
+                                 if w.kind == "generic" and w.alive]
+                excess = len(alive_generic) - constants.MAX_WORKERS_CAP
+                for w in alive_generic:
+                    if excess <= 0:
+                        break
+                    if w.idle and not w.released and w.current is None:
+                        w.idle = False
+                        w.alive = False
+                        self.workers.pop(w.worker_id, None)
+                        retired.append(w)
+                        excess -= 1
+        for w in retired:
+            w.send(protocol.KillWorker())
         for w, msg in to_send:
             if not w.send(msg):
                 if isinstance(w, _RemoteNode):

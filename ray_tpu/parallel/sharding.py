@@ -177,6 +177,65 @@ def replicated(mesh: Mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+# -- PartitionSpec (de)serialization for checkpoint manifests ---------------
+#
+# Mesh axis NAMES are stable across scale changes (MeshSpec keeps size-1
+# axes for exactly this reason), so a spec recorded at save time can be
+# re-applied to a mesh with a different device count at restore time —
+# the elastic-resume path in train/ft.py. Sizes are not recorded: only
+# names travel, and `valid_spec_for` re-validates them against the mesh
+# that exists at restore.
+
+def spec_to_json(spec) -> list:
+    """PartitionSpec -> JSON-serializable list (None | str | [str, ...]
+    per dim)."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def spec_from_json(entries) -> PartitionSpec:
+    """Inverse of `spec_to_json`."""
+    out = []
+    for entry in entries:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(tuple(entry))
+    return PartitionSpec(*out)
+
+
+def valid_spec_for(mesh: Mesh, spec, shape) -> PartitionSpec:
+    """Re-validate a recorded PartitionSpec against a (possibly different)
+    mesh: axes that don't exist on `mesh`, are already used by an earlier
+    dim, or don't divide the dim evenly are dropped (replicated) — the
+    same degrade-to-replication contract as `logical_to_spec`, applied at
+    restore time."""
+    present = _mesh_axes(mesh)
+    used: set = set()
+    out = []
+    entries = list(tuple(spec))[:len(shape)]
+    for dim, entry in zip(shape, entries):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        axes = tuple(a for a in axes if a in present and a not in used)
+        total = 1
+        for a in axes:
+            total *= mesh.shape[a]
+        if not axes or (total and dim % total):
+            out.append(None)
+            continue
+        used.update(axes)
+        out.append(axes[0] if len(axes) == 1 else axes)
+    return PartitionSpec(*out)
+
+
 def global_from_local(mesh: Mesh, local_batch, rules: dict | None = None):
     """Build a global batch-sharded array from each process's local shard —
     the multi-host ingest path (each host feeds its own data; the global

@@ -6,8 +6,8 @@ session API + checkpointing; the torch/NCCL backend seam
 + mesh SPMD.
 """
 
-from ray_tpu.train import loop, session
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train import ft, loop, session
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointError
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -32,7 +32,7 @@ get_mesh_spec = session.get_mesh_spec
 __all__ = [
     "JaxTrainer", "TorchTrainer", "SklearnTrainer", "GBDTTrainer",
     "XGBoostTrainer", "LightGBMTrainer", "TensorflowTrainer", "Result",
-    "TrainingFailedError", "Checkpoint",
+    "TrainingFailedError", "Checkpoint", "CheckpointError", "ft",
     "Predictor", "JaxPredictor", "BatchPredictor",
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
     "session", "report", "get_checkpoint", "get_dataset_shard",

@@ -15,11 +15,13 @@ same-host assumption when a worker ships a checkpoint to the driver.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import os
 import pickle
 import shutil
 import tempfile
 import threading
+import uuid
 
 import numpy as np
 
@@ -28,6 +30,55 @@ _PICKLE_FILE = "data.pkl"
 _counter_lock = threading.Lock()
 _counter = 0
 _tmpdirs: list[str] = []
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint is missing, partial, or corrupt."""
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so its entries (a just-renamed checkpoint)
+    survive power loss."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_dir(path: str):
+    """Write a directory atomically: yields a sibling temp dir to fill;
+    on clean exit the temp dir is fsynced and renamed into place (any
+    previous `path` is replaced). On error — or a crash at ANY point —
+    `path` is never a half-written directory: readers see the old
+    content, the new content, or nothing, so a crashed writer can never
+    leave a readable partial checkpoint. The `train/ft.py` commit path
+    and `Checkpoint.to_directory` both go through here."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    tag = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    tmp = os.path.join(parent, f".{os.path.basename(path)}.tmp-{tag}")
+    os.makedirs(tmp)
+    try:
+        yield tmp
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    fsync_dir(tmp)
+    if os.path.lexists(path):
+        # move the old version aside first: os.replace can't atomically
+        # swap non-empty directories, and a crash in this window leaves
+        # `path` absent (detectable), never partial
+        old = os.path.join(parent, f".{os.path.basename(path)}.old-{tag}")
+        if os.path.isdir(path):
+            os.replace(path, old)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.unlink(path)
+    os.replace(tmp, path)
+    fsync_dir(parent)
 
 
 def _next_tmpdir() -> str:
@@ -105,19 +156,22 @@ class Checkpoint:
         return out
 
     def to_directory(self, path: str) -> str:
+        # atomic_dir: a crash mid-write leaves no readable half-written
+        # checkpoint dir for from_directory to load
         if self._data is not None:
-            os.makedirs(path, exist_ok=True)
             arrays = {k: v for k, v in self._data.items()
                       if _is_array_tree(v)}
             rest = {k: v for k, v in self._data.items() if k not in arrays}
-            if arrays:
-                import orbax.checkpoint as ocp
-                ocp.PyTreeCheckpointer().save(
-                    os.path.join(path, _ORBAX_SUBDIR), arrays)
-            with open(os.path.join(path, _PICKLE_FILE), "wb") as f:
-                pickle.dump(rest, f, protocol=5)
+            with atomic_dir(path) as tmp:
+                if arrays:
+                    import orbax.checkpoint as ocp
+                    ocp.PyTreeCheckpointer().save(
+                        os.path.join(tmp, _ORBAX_SUBDIR), arrays)
+                with open(os.path.join(tmp, _PICKLE_FILE), "wb") as f:
+                    pickle.dump(rest, f, protocol=5)
         elif os.path.abspath(path) != os.path.abspath(self.path):
-            shutil.copytree(self.path, path, dirs_exist_ok=True)
+            with atomic_dir(path) as tmp:
+                shutil.copytree(self.path, tmp, dirs_exist_ok=True)
         return path
 
     def as_directory(self) -> str:
@@ -132,16 +186,27 @@ class Checkpoint:
 
     def to_uri(self, uri: str) -> str:
         """Upload this checkpoint through the URI-keyed storage seam
-        (ray_tpu.util.storage; mem:// fake or a registered gs:// etc.)."""
+        (ray_tpu.util.storage; mem:// fake or a registered gs:// etc.).
+        The upload is COMMITTED: data files go first and a checksummed
+        commit manifest lands last, so an interrupted upload is
+        distinguishable from a complete one (from_uri refuses it)."""
         from ray_tpu.util import storage
-        storage.upload_dir(self.as_directory(), uri)
+        storage.upload_dir_committed(self.as_directory(), uri)
         return uri
 
     @classmethod
     def from_uri(cls, uri: str) -> "Checkpoint":
+        """Download a COMMITTED checkpoint. Raises CheckpointError if the
+        URI holds nothing, an interrupted (uncommitted) upload, or bytes
+        that fail the commit manifest's checksums — never silently
+        restores an empty/partial dict."""
         from ray_tpu.util import storage
         local = storage.staging_dir(uri)
-        storage.download_dir(uri, local)
+        try:
+            storage.download_dir_committed(uri, local)
+        except storage.UncommittedError as e:
+            raise CheckpointError(
+                f"no restorable checkpoint at {uri!r}: {e}") from None
         return cls(local)
 
     def __repr__(self):

@@ -1,0 +1,443 @@
+"""Elastic fault-tolerant training: async sharded checkpointing + resume.
+
+A training job must survive losing a host without losing the run
+(ROADMAP item 4; Ray arXiv:1712.05889 makes recovery a property of the
+runtime, not the application). Two pieces:
+
+  * `AsyncCheckpointer` — every `every` steps, takes a DONATION-SAFE
+    device-side copy of the full TrainState (a jitted `jnp.copy` of
+    every leaf, dispatched asynchronously like any other step — the
+    training loop donates its state buffers into the next dispatch, so
+    the copy is the only thing that may outlive the step). A background
+    writer thread then moves each copy to host and commits it to disk,
+    so the device→host fetch rides under later steps' compute exactly
+    like `MetricsRing`'s lagged metric fetches (train/loop.py): no
+    training step ever blocks on a host sync. In-flight snapshots are
+    bounded (`max_in_flight`), so HBM/host memory stays flat no matter
+    how slow the filesystem is — when the bound is hit the *snapshot*
+    (not the step) waits for the writer.
+
+  * Atomic commit — shards, a pickled tree skeleton, and a manifest
+    carrying per-shard sha256 checksums + the PartitionSpec each leaf
+    was saved under are written into a temp dir; the manifest is
+    fsynced and the directory renamed into place LAST
+    (train/checkpoint.py `atomic_dir`). A writer killed at any point
+    leaves either a previous committed checkpoint or an ignorable temp
+    dir — never a readable half-checkpoint.
+
+  * `restore_resharded` — re-forms training state on a mesh that may
+    have a DIFFERENT device count: mesh axis names are stable across
+    scale changes (parallel/mesh.py keeps size-1 axes), so each leaf's
+    recorded PartitionSpec re-applies to the new mesh after
+    `sharding.valid_spec_for` re-validation (axes that vanished or no
+    longer divide degrade to replication). `TrainLoop.run(...,
+    start_step=k)` with a `fast_forward`ed data iterator then resumes
+    the trajectory bit-identically (same device count) from the
+    restored step.
+
+The chaos proof lives in tests/test_chaos.py: a trainer host is
+SIGKILLed mid-run, the job resumes from the last committed step — at
+the same or a smaller device count — and the post-resume loss
+trajectory matches an unkilled run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue
+import re
+import shutil
+import threading
+from typing import Any, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ray_tpu.parallel.sharding import (
+    spec_from_json,
+    spec_to_json,
+    valid_spec_for,
+)
+from ray_tpu.train.checkpoint import CheckpointError, atomic_dir
+
+MANIFEST = "manifest.json"
+_SKELETON = "skeleton.pkl"
+_FORMAT = "ray_tpu_ft_v1"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+# Host-fetch seam (same contract as train/loop.py:_device_get): the ONLY
+# place this module moves device values to the host. Tests monkeypatch it
+# to prove snapshotting adds no per-step sync on the training thread.
+_device_get = jax.device_get
+
+
+class _ShardRef:
+    """Placeholder leaf in the pickled tree skeleton: `index` names the
+    shard file holding the real array."""
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _leaf_spec(leaf) -> list:
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return [None] * getattr(leaf, "ndim", 0)
+    entries = spec_to_json(spec)
+    entries += [None] * (leaf.ndim - len(entries))
+    return entries
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends aren't np builtins
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _write_file(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_checkpoint(root: str, step: int, host_tree: Any,
+                     specs: list[list]) -> str:
+    """Commit one host-side TrainState snapshot under
+    `root/step_{step:08d}` atomically (temp dir -> fsynced manifest ->
+    rename). `specs` holds one JSON-ready PartitionSpec per flattened
+    leaf, in tree-flatten order."""
+    leaves, treedef = jax.tree_util.tree_flatten(host_tree)
+    if len(specs) != len(leaves):
+        raise ValueError(f"{len(specs)} specs for {len(leaves)} leaves")
+    skeleton = jax.tree_util.tree_unflatten(
+        treedef, [_ShardRef(i) for i in range(len(leaves))])
+    dest = os.path.join(root, f"step_{step:08d}")
+    with atomic_dir(dest) as tmp:
+        shards = []
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            data = np.ascontiguousarray(arr).tobytes()
+            name = f"shard_{i:05d}.bin"
+            _write_file(os.path.join(tmp, name), data)
+            shards.append({
+                "file": name,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "spec": specs[i],
+                "sha256": _sha256(data),
+            })
+        skel = pickle.dumps(skeleton, protocol=5)
+        _write_file(os.path.join(tmp, _SKELETON), skel)
+        manifest = json.dumps({
+            "format": _FORMAT,
+            "step": int(step),
+            "shards": shards,
+            "skeleton": {"file": _SKELETON, "sha256": _sha256(skel)},
+        }, indent=1).encode()
+        _write_file(os.path.join(tmp, MANIFEST), manifest)
+    return dest
+
+
+def load_manifest(path: str) -> dict:
+    """Read + sanity-check a committed checkpoint dir's manifest."""
+    mf = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mf):
+        raise CheckpointError(
+            f"{path!r} holds no committed checkpoint (no {MANIFEST} — "
+            f"a crashed writer's partial dir is never committed)")
+    try:
+        with open(mf, "rb") as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise CheckpointError(f"unreadable manifest in {path!r}: {e}") \
+            from None
+    if manifest.get("format") != _FORMAT:
+        raise CheckpointError(
+            f"{path!r}: unknown checkpoint format "
+            f"{manifest.get('format')!r}")
+    return manifest
+
+
+def validate_checkpoint(path: str) -> dict:
+    """Verify every shard (and the skeleton) against the manifest's
+    checksums. Returns the manifest; raises CheckpointError on any
+    mismatch or missing file."""
+    manifest = load_manifest(path)
+    entries = list(manifest["shards"])
+    entries.append(manifest["skeleton"])
+    for entry in entries:
+        full = os.path.join(path, entry["file"])
+        try:
+            with open(full, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"{path!r}: shard {entry['file']!r} listed in the "
+                f"manifest is missing") from None
+        if _sha256(data) != entry["sha256"]:
+            raise CheckpointError(
+                f"{path!r}: checksum mismatch on {entry['file']!r} "
+                f"(torn write or corruption)")
+    return manifest
+
+
+def committed_steps(root: str) -> list[tuple[int, str]]:
+    """(step, dir) for every COMMITTED checkpoint under `root`,
+    ascending. Temp/partial dirs (no manifest, unparseable) are
+    ignored. Accepts a local path or a storage URI."""
+    from ray_tpu.util import storage
+    if storage.is_uri(root):
+        steps = {}
+        for rel in storage.list_prefix(root):
+            head, _, tail = rel.partition("/")
+            m = _STEP_RE.match(head)
+            if m and tail == storage.COMMIT_FILE:
+                steps[int(m.group(1))] = storage.uri_join(root, head)
+        return sorted(steps.items())
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        full = os.path.join(root, name)
+        if m and os.path.isfile(os.path.join(full, MANIFEST)):
+            out.append((int(m.group(1)), full))
+    return sorted(out)
+
+
+def latest_checkpoint(root: str) -> str | None:
+    """Newest committed checkpoint dir (or URI) under `root`, else
+    None."""
+    steps = committed_steps(root)
+    return steps[-1][1] if steps else None
+
+
+def restore_resharded(source: str, mesh: Mesh, *, validate: bool = True
+                      ) -> tuple[Any, int]:
+    """Restore a committed checkpoint onto `mesh`, resharding every leaf
+    via its recorded PartitionSpec — `mesh` may have a different device
+    count than the mesh the checkpoint was written from (elastic
+    resume). Returns (state, step).
+
+    `source` is a committed checkpoint dir, a root holding step_* dirs
+    (the newest committed one is used), or a storage URI of either.
+    """
+    from ray_tpu.util import storage
+    if storage.is_uri(source):
+        uri = source
+        if not storage.is_committed(uri):
+            latest = latest_checkpoint(uri)
+            if latest is None:
+                raise CheckpointError(
+                    f"no committed checkpoint under {uri!r}")
+            uri = latest
+        local = storage.staging_dir(uri)
+        try:
+            storage.download_dir_committed(uri, local)
+        except storage.UncommittedError as e:
+            raise CheckpointError(str(e)) from None
+        source = local
+    if not os.path.isfile(os.path.join(source, MANIFEST)):
+        latest = latest_checkpoint(source)
+        if latest is None:
+            raise CheckpointError(
+                f"no committed checkpoint under {source!r}")
+        source = latest
+    manifest = validate_checkpoint(source) if validate \
+        else load_manifest(source)
+    with open(os.path.join(source, manifest["skeleton"]["file"]),
+              "rb") as f:
+        skeleton = pickle.load(f)
+    shards = manifest["shards"]
+
+    def materialize(ref: _ShardRef):
+        entry = shards[ref.index]
+        with open(os.path.join(source, entry["file"]), "rb") as f:
+            arr = np.frombuffer(f.read(), dtype=_np_dtype(entry["dtype"]))
+        arr = arr.reshape(entry["shape"])
+        spec = valid_spec_for(mesh, spec_from_json(entry["spec"]),
+                              arr.shape)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    state = jax.tree.map(
+        materialize, skeleton,
+        is_leaf=lambda x: isinstance(x, _ShardRef))
+    return state, int(manifest["step"])
+
+
+def fast_forward(host_iter: Iterable, n: int) -> Iterator:
+    """Skip the first `n` host batches — positions a deterministic data
+    stream at the restored step so the resumed trajectory replays the
+    exact batches the lost run would have seen."""
+    it = iter(host_iter)
+    for _ in range(int(n)):
+        next(it)
+    return it
+
+
+class AsyncCheckpointer:
+    """Asynchronous sharded checkpointer for TrainLoop (train/loop.py).
+
+    `maybe_snapshot(state, step)` is called once per dispatch; every
+    `every` steps it enqueues a device-side copy of the state (jitted
+    `jnp.copy` per leaf — donation-safe: the loop is free to donate the
+    original buffers into the next step) plus each leaf's PartitionSpec,
+    and returns immediately. A daemon writer thread fetches the copy to
+    host (`_device_get`, off the training thread) and commits it under
+    `root/step_{NNNNNNNN}` via `write_checkpoint`'s atomic temp-dir →
+    fsynced-manifest → rename protocol. With `uri=` set, each committed
+    dir is additionally mirrored through util/storage's commit-marker
+    upload.
+
+    At most `max_in_flight` snapshots exist between device and disk;
+    a slower filesystem back-pressures `maybe_snapshot` (counted in
+    `stalls`), never memory. `keep` bounds committed checkpoints on
+    disk, oldest pruned first. Writer errors surface on the training
+    thread at the next `maybe_snapshot`/`flush`.
+    """
+
+    def __init__(self, root: str, *, every: int = 100,
+                 max_in_flight: int = 2, keep: int = 2,
+                 uri: str | None = None):
+        from ray_tpu.util import storage
+        if storage.is_uri(root) and uri is None:
+            uri, root = root, storage.staging_dir(root)
+        self.root = root
+        self.uri = uri
+        self.every = max(1, int(every))
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.keep = max(1, int(keep))
+        os.makedirs(root, exist_ok=True)
+        self._copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+        self._queue: queue.Queue = queue.Queue(maxsize=self.max_in_flight)
+        self._error: BaseException | None = None
+        self._last_snap_step: int | None = None
+        self._closed = False
+        # observability counters
+        self.snapshots = 0      # device copies enqueued
+        self.commits = 0        # checkpoints committed to disk
+        self.stalls = 0         # times the in-flight bound back-pressured
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        daemon=True,
+                                        name="ft-checkpoint-writer")
+        self._writer.start()
+
+    # -- training-thread API ------------------------------------------------
+
+    def maybe_snapshot(self, state, step: int, *,
+                       force: bool = False) -> bool:
+        """Snapshot if `step` is `every` past the last snapshot (or
+        `force`). Never blocks on a device→host sync; blocks only when
+        `max_in_flight` snapshots are already pending (memory bound)."""
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        last = self._last_snap_step
+        if not force and last is not None and step - last < self.every:
+            return False
+        if not force and last is None and step < self.every:
+            return False
+        snap = self._copy(state)            # async device-side copy
+        specs = [_leaf_spec(l) for l in jax.tree_util.tree_leaves(snap)]
+        if self._queue.full():
+            self.stalls += 1
+        self._queue.put((int(step), snap, specs))
+        self._last_snap_step = int(step)
+        self.snapshots += 1
+        return True
+
+    def flush(self) -> None:
+        """Block until every enqueued snapshot is committed (the one
+        deliberate end-of-run sync, mirroring MetricsRing.drain)."""
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._writer.join()
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- writer thread ------------------------------------------------------
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                step, snap, specs = item
+                host = _device_get(snap)     # off the training thread
+                del snap
+                dest = write_checkpoint(self.root, step, host, specs)
+                self.commits += 1
+                if self.uri is not None:
+                    from ray_tpu.util import storage
+                    storage.upload_dir_committed(
+                        dest, storage.uri_join(
+                            self.uri, os.path.basename(dest)))
+                self._prune()
+            except BaseException as e:       # surfaced on train thread
+                self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _prune(self):
+        from ray_tpu.util import storage
+        steps = committed_steps(self.root)
+        excess = steps[:-self.keep] if len(steps) > self.keep else []
+        for step, path in excess:
+            shutil.rmtree(path, ignore_errors=True)
+            if self.uri is not None:
+                try:
+                    storage.delete(storage.uri_join(
+                        self.uri, os.path.basename(path)))
+                except Exception:
+                    pass
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointError(
+                f"async checkpoint writer failed: {err!r}") from err
+
+    # -- validation ---------------------------------------------------------
+
+    def check_invariants(self) -> None:
+        """Validator wired into tests (chaos suite + units): in-flight
+        bound respected, every committed checkpoint's shards match its
+        manifest checksums, steps strictly increasing, no swallowed
+        writer error."""
+        assert self._queue.qsize() <= self.max_in_flight, \
+            f"{self._queue.qsize()} in flight > bound {self.max_in_flight}"
+        steps = committed_steps(self.root)
+        assert len(steps) <= self.keep, \
+            f"{len(steps)} committed > keep={self.keep}"
+        last = None
+        for step, path in steps:
+            validate_checkpoint(path)       # raises on any mismatch
+            assert last is None or step > last, \
+                f"non-monotonic committed steps under {self.root!r}"
+            last = step
+        self._raise_pending()

@@ -75,7 +75,14 @@ class DevicePrefetcher:
 
     group=g stacks g host batches leaf-wise (leading [g, ...] axis)
     before placing — the input shape of a fused multi-step dispatch
-    (`TrainLoop(unroll=g)`). A trailing ragged group is dropped.
+    (`TrainLoop(unroll=g)`). A trailing ragged group is dropped and
+    counted in `skipped_ragged` (it would change the compiled dispatch
+    shape), so silently shortened epochs are observable.
+
+    A host-iterator exception is never masked as end-of-stream: batches
+    already transferred are still delivered in order, then the original
+    exception is re-raised (and keeps re-raising — a failed feed must
+    not look like a clean epoch boundary to a retrying consumer).
     """
 
     def __init__(self, host_iter: Iterable, place: Callable[[Any], Any],
@@ -85,13 +92,17 @@ class DevicePrefetcher:
         self._depth = max(1, int(depth))
         self._group = max(1, int(group))
         self._buf: collections.deque = collections.deque()
+        self._err: BaseException | None = None
+        self._exhausted = False
         self.issued = 0         # transfers dispatched (observability)
+        self.skipped_ragged = 0  # host batches dropped in a ragged tail
 
     def _next_host_batch(self):
         if self._group == 1:
             return next(self._host)
         parts = list(itertools.islice(self._host, self._group))
         if len(parts) < self._group:
+            self.skipped_ragged += len(parts)
             raise StopIteration
         return jax.tree.map(lambda *xs: np.stack(xs), *parts)
 
@@ -99,15 +110,20 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
-        while len(self._buf) < self._depth:
+        while (not self._exhausted and self._err is None
+                and len(self._buf) < self._depth):
             try:
                 self._buf.append(self._place(self._next_host_batch()))
                 self.issued += 1
             except StopIteration:
-                break
-        if not self._buf:
-            raise StopIteration
-        return self._buf.popleft()
+                self._exhausted = True
+            except Exception as e:
+                self._err = e
+        if self._buf:
+            return self._buf.popleft()
+        if self._err is not None:
+            raise self._err
+        raise StopIteration
 
 
 class MetricsRing:
@@ -159,6 +175,12 @@ class MetricsRing:
 
     def drain(self) -> list:
         self._sync(keep=0)
+        # Reset the cadence counters so a ring reused across runs starts
+        # the next run's interval from zero instead of inheriting stale
+        # push counts (which either fired a fetch on the first push or
+        # deferred one for a whole extra interval).
+        self._steps_pushed = 0
+        self._last_sync = 0
         return self.history
 
 
@@ -198,30 +220,49 @@ class TrainLoop:
 
     def __init__(self, step_fn: Callable, *, unroll: int = 1,
                  metrics_interval: int = 10, metrics_lag: int = 2,
-                 donate: bool = True):
+                 donate: bool = True, checkpointer=None):
         self.unroll = max(1, int(unroll))
         self.metrics_interval = metrics_interval
         self.metrics_lag = metrics_lag
         self._dispatch = (step_fn if self.unroll == 1
                           else fuse_steps(step_fn, self.unroll, donate))
         self.last_ring: MetricsRing | None = None
+        # Optional train/ft.AsyncCheckpointer (any object with
+        # maybe_snapshot(state, step) + flush()). Mutable attribute so a
+        # compiled loop can toggle checkpointing between runs without
+        # rebuilding (and re-tracing) the fused dispatch.
+        self.checkpointer = checkpointer
 
     def run(self, state, device_batches: Iterable,
-            num_steps: int | None = None):
-        """Drive steps until `num_steps` are dispatched (or the batch
-        iterator ends). `device_batches` yields one pytree per DISPATCH:
-        leaves [B, ...] for unroll=1, [unroll, B, ...] otherwise —
-        exactly what `DevicePrefetcher(group=unroll)` produces. Returns
-        (state, per-step host metrics list)."""
+            num_steps: int | None = None, *, start_step: int = 0):
+        """Drive steps until `num_steps` TOTAL steps are reached (or the
+        batch iterator ends). `device_batches` yields one pytree per
+        DISPATCH: leaves [B, ...] for unroll=1, [unroll, B, ...]
+        otherwise — exactly what `DevicePrefetcher(group=unroll)`
+        produces. Returns (state, per-step host metrics list).
+
+        start_step seeds the global step counter for elastic resume
+        (ft.restore_resharded): the caller fast-forwards the host
+        iterator past the first `start_step` batches and the loop picks
+        up checkpoint cadence from there, so `num_steps` keeps meaning
+        "train through step N" across kills and restarts."""
         ring = MetricsRing(self.metrics_interval, self.metrics_lag)
         self.last_ring = ring
-        done = 0
+        ckpt = self.checkpointer
+        done = int(start_step)
         for batch in device_batches:
             state, metrics = self._dispatch(state, batch)
             ring.push(metrics, count=self.unroll)
             done += self.unroll
+            # Snapshot BEFORE the next dispatch donates these buffers:
+            # maybe_snapshot's device-side copy is the donation-safety
+            # seam (ft.AsyncCheckpointer docstring).
+            if ckpt is not None:
+                ckpt.maybe_snapshot(state, done)
             if num_steps is not None and done >= num_steps:
                 break
+        if ckpt is not None:
+            ckpt.flush()
         return state, ring.drain()
 
 

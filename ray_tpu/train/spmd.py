@@ -200,20 +200,26 @@ def gpt_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
 
 def make_gpt_trainer(cfg, mesh: Mesh, rng=None,
                      optimizer: optax.GradientTransformation | None = None,
-                     rules: dict | None = None, accum: int = 1):
+                     rules: dict | None = None, accum: int = 1,
+                     init_state: bool = True):
     """One-call assembly: sharded state + jitted step + batch sharding.
 
     Returns (state, step_fn, batch_sharding_fn). batch_sharding_fn places a
     host batch {"inputs","targets"} [B,T] onto the mesh sharded
     (batch→data/fsdp, length→seq). accum=k makes the step accumulate
     gradients over k microbatches (see make_train_step).
+
+    init_state=False skips parameter/optimizer initialization and returns
+    state=None — the elastic-resume path (train/ft.restore_resharded)
+    already holds the state and shouldn't pay to materialize one it is
+    about to throw away.
     """
     from ray_tpu.models import gpt
 
     return _make_lm_trainer(
         lambda key: gpt.init_params(key, cfg), gpt.param_logical_axes(cfg),
         partial(gpt_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
-        rules, accum=accum)
+        rules, accum=accum, init_state=init_state)
 
 
 def moe_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
@@ -234,12 +240,15 @@ def moe_loss_fn(params, batch, cfg, mesh: Mesh | None = None):
 
 
 def _make_lm_trainer(init_fn, logical_axes, loss_fn, mesh: Mesh, rng,
-                     optimizer, rules, accum: int = 1):
+                     optimizer, rules, accum: int = 1,
+                     init_state: bool = True):
     """Shared assembly behind make_gpt_trainer / make_moe_trainer."""
     rng = jax.random.key(0) if rng is None else rng
     optimizer = optimizer or default_optimizer()
-    state, _ = create_sharded_state(
-        init_fn, logical_axes, mesh, rng, optimizer, rules)
+    state = None
+    if init_state:
+        state, _ = create_sharded_state(
+            init_fn, logical_axes, mesh, rng, optimizer, rules)
     step_fn = make_train_step(loss_fn, optimizer, mesh, accum=accum,
                               rules=rules)
 
@@ -310,7 +319,8 @@ def make_gpt_pipeline_trainer(cfg, mesh: Mesh, num_microbatches: int = 2,
 
 def make_moe_trainer(cfg, mesh: Mesh, rng=None,
                      optimizer: optax.GradientTransformation | None = None,
-                     rules: dict | None = None, accum: int = 1):
+                     rules: dict | None = None, accum: int = 1,
+                     init_state: bool = True):
     """MoE assembly: expert weights shard over the mesh's `expert` axis,
     so the dispatch/combine einsums lower to all-to-alls over ICI."""
     from ray_tpu.models import moe
@@ -318,7 +328,7 @@ def make_moe_trainer(cfg, mesh: Mesh, rng=None,
     return _make_lm_trainer(
         lambda key: moe.init_params(key, cfg), moe.param_logical_axes(cfg),
         partial(moe_loss_fn, cfg=cfg, mesh=mesh), mesh, rng, optimizer,
-        rules, accum=accum)
+        rules, accum=accum, init_state=init_state)
 
 
 def train_flops_per_token(cfg, seq_len: int) -> float:

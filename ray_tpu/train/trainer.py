@@ -160,7 +160,7 @@ class JaxTrainer:
             ckpt.to_directory(dest)
             uri = storage_mod.uri_join(storage, name)
             try:
-                storage_mod.upload_dir(dest, uri)
+                storage_mod.upload_dir_committed(dest, uri)
             except Exception:
                 # transient remote-storage failure must not kill the
                 # run: the local checkpoint is intact (same policy as

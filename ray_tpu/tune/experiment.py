@@ -70,8 +70,8 @@ class Trial:
             # (reference: syncer errors are logged, not fatal)
             from ray_tpu.util import storage
             try:
-                storage.upload_dir(path,
-                                   storage.uri_join(self.sync_uri, name))
+                storage.upload_dir_committed(
+                    path, storage.uri_join(self.sync_uri, name))
             except Exception:
                 import logging
                 logging.getLogger("ray_tpu.tune").exception(

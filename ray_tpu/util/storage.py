@@ -20,12 +20,24 @@ Built-in schemes:
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import shutil
 import threading
 from typing import Callable, Dict
 
 _MEM_ROOT = "/tmp/ray_tpu_memfs"
+
+# Commit marker for atomic-ish remote uploads: data objects are written
+# first, this checksummed manifest last. Readers treat its absence as
+# "no checkpoint here" — an interrupted upload can never be restored.
+COMMIT_FILE = ".ray_tpu_commit.json"
+
+
+class UncommittedError(RuntimeError):
+    """The URI holds no committed upload (nothing there, an interrupted
+    upload with no commit marker, or bytes failing the marker's
+    checksums)."""
 
 
 def is_uri(path: str | None) -> bool:
@@ -191,6 +203,72 @@ def upload_dir(local_dir: str, uri: str) -> None:
 def download_dir(uri: str, local_dir: str) -> None:
     b, path = get_backend(uri)
     b.download_dir(path, local_dir)
+
+
+def upload_dir_committed(local_dir: str, uri: str) -> None:
+    """Upload a directory with commit-marker semantics: every data file
+    first (checksummed as it streams), then one COMMIT_FILE manifest
+    LAST. A writer that dies mid-upload leaves objects but no marker, so
+    `download_dir_committed` / `Checkpoint.from_uri` refuse the
+    partial upload instead of restoring it."""
+    b, root = get_backend(uri)
+    entries = []
+    for walk_root, _dirs, files in os.walk(local_dir):
+        for name in sorted(files):
+            full = os.path.join(walk_root, name)
+            rel = os.path.relpath(full, local_dir)
+            if rel == COMMIT_FILE:
+                continue
+            with open(full, "rb") as f:
+                data = f.read()
+            b.write_bytes(root.rstrip("/") + "/" + rel, data)
+            entries.append({"path": rel,
+                            "sha256": hashlib.sha256(data).hexdigest(),
+                            "size": len(data)})
+    manifest = json.dumps({"files": sorted(entries,
+                                           key=lambda e: e["path"])},
+                          sort_keys=True).encode()
+    b.write_bytes(root.rstrip("/") + "/" + COMMIT_FILE, manifest)
+
+
+def download_dir_committed(uri: str, local_dir: str) -> None:
+    """Download a committed upload into a CLEAN `local_dir` (wiped
+    first, so stale staging files never mask what the backend holds).
+    Raises UncommittedError when there is no commit marker, a listed
+    object is missing, or bytes fail their recorded checksum."""
+    b, root = get_backend(uri)
+    try:
+        manifest = json.loads(
+            b.read_bytes(root.rstrip("/") + "/" + COMMIT_FILE))
+    except FileNotFoundError:
+        present = b.list_prefix(root)
+        detail = ("nothing uploaded" if not present else
+                  f"{len(present)} object(s) but no commit marker "
+                  f"(interrupted upload?)")
+        raise UncommittedError(f"{uri!r}: {detail}") from None
+    if os.path.isdir(local_dir):
+        shutil.rmtree(local_dir)
+    os.makedirs(local_dir, exist_ok=True)
+    for entry in manifest["files"]:
+        src = root.rstrip("/") + "/" + entry["path"]
+        try:
+            data = b.read_bytes(src)
+        except FileNotFoundError:
+            raise UncommittedError(
+                f"{uri!r}: committed file {entry['path']!r} is missing "
+                f"from the backend") from None
+        if hashlib.sha256(data).hexdigest() != entry["sha256"]:
+            raise UncommittedError(
+                f"{uri!r}: checksum mismatch on {entry['path']!r}")
+        dest = os.path.join(local_dir, entry["path"])
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "wb") as f:
+            f.write(data)
+
+
+def is_committed(uri: str) -> bool:
+    b, root = get_backend(uri)
+    return b.exists(root.rstrip("/") + "/" + COMMIT_FILE)
 
 
 def write_bytes(uri: str, data: bytes) -> None:

@@ -1,0 +1,129 @@
+"""Trainer child for the kill/resume chaos suite (tests/test_chaos.py).
+
+Run as __main__ in a fresh subprocess so a SIGKILL takes out a real
+trainer process (not a thread) and so the resumed run can pick its own
+device count. All configuration rides in env vars:
+
+  FT_ROOT     checkpoint root directory (required)
+  FT_OUT      where to write the result JSON
+              {"start": s, "steps": [...], "losses": [...]}
+  FT_MODE     "train" (default) | "resume"
+  FT_STEPS    total global steps to train through (default 12)
+  FT_EVERY    snapshot cadence; 0 disables checkpointing (default 0)
+  FT_UNROLL   steps fused per dispatch (default 2)
+  FT_DEVICES  CPU device count for this process (default 8)
+  FT_CRASH_AT SIGKILL self once the host feed reaches this batch index
+              AND at least one checkpoint has committed (default: never)
+
+The data stream is deterministic per global step index, so a resumed
+run that fast-forwards past the restored step replays exactly the
+batches the killed run would have consumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+BATCH = 8           # divisible across 8/4/2/1-device data sharding
+SEQ = 16
+VOCAB = 128
+
+
+def make_cfg():
+    from ray_tpu.models import gpt
+    return gpt.small(vocab_size=VOCAB, d_model=32, n_layers=1,
+                     n_heads=2, d_ff=64, max_seq_len=SEQ)
+
+
+def host_batches(start: int = 0):
+    """Deterministic stream: batch for global step i is a pure function
+    of i (rng seeded per step), so kill/resume replays identically."""
+    idx = start
+    while True:
+        rng = np.random.default_rng(1234 + idx)
+        toks = rng.integers(0, VOCAB, (BATCH, SEQ + 1), np.int32)
+        yield {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+        idx += 1
+
+
+def _killing_feed(inner, ckpt, crash_at: int):
+    """Pass batches through until the feed reaches `crash_at`, then wait
+    for the first committed checkpoint and SIGKILL the whole process —
+    the hard host loss the chaos test is about."""
+    for idx, batch in enumerate(inner):
+        if idx >= crash_at:
+            deadline = time.time() + 120
+            while ckpt.commits < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            os.kill(os.getpid(), signal.SIGKILL)
+        yield batch
+
+
+def main() -> None:
+    devices = int(os.environ.get("FT_DEVICES", "8"))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import ft, loop, spmd
+
+    root = os.environ["FT_ROOT"]
+    out = os.environ.get("FT_OUT")
+    mode = os.environ.get("FT_MODE", "train")
+    steps = int(os.environ.get("FT_STEPS", "12"))
+    every = int(os.environ.get("FT_EVERY", "0"))
+    unroll = int(os.environ.get("FT_UNROLL", "2"))
+    crash_at = int(os.environ.get("FT_CRASH_AT", "-1"))
+
+    import jax
+    cfg = make_cfg()
+    mesh = MeshSpec(data=-1).build(jax.devices())
+
+    if mode == "resume":
+        _, step_fn, _ = spmd.make_gpt_trainer(cfg, mesh, init_state=False)
+        state, start = ft.restore_resharded(root, mesh)
+        host = ft.fast_forward(host_batches(), start)
+    else:
+        state, step_fn, _ = spmd.make_gpt_trainer(cfg, mesh)
+        start, host = 0, host_batches()
+
+    ckpt = None
+    if every > 0:
+        ckpt = ft.AsyncCheckpointer(root, every=every, max_in_flight=2,
+                                    keep=2)
+    if crash_at >= 0:
+        assert ckpt is not None, "FT_CRASH_AT needs FT_EVERY > 0"
+        host = _killing_feed(host, ckpt, crash_at)
+
+    place = loop.make_placer(mesh, stacked=unroll > 1)
+    batches = loop.DevicePrefetcher(host, place, depth=2, group=unroll)
+    train = loop.TrainLoop(step_fn, unroll=unroll, metrics_interval=4,
+                           checkpointer=ckpt)
+    state, metrics = train.run(state, batches, num_steps=steps,
+                               start_step=start)
+
+    if ckpt is not None:
+        ckpt.check_invariants()
+        ckpt.close()
+    if out:
+        record = {
+            "start": int(start),
+            "steps": [int(m["step"]) for m in metrics],
+            "losses": [float(m["loss"]) for m in metrics],
+        }
+        with open(out, "w") as f:
+            json.dump(record, f)
+
+
+if __name__ == "__main__":
+    main()

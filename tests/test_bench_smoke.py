@@ -25,3 +25,5 @@ def test_bench_cpu_smoke(capsys, monkeypatch):
     assert rec["unit"] == "tokens/s"
     assert np.isfinite(rec["value"]) and rec["value"] > 0
     assert rec["vs_baseline"] == 0.0        # CPU mode reports no MFU ratio
+    # fault-tolerance cost is part of the published contract
+    assert np.isfinite(rec["checkpoint_overhead_pct"])

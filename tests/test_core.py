@@ -290,3 +290,58 @@ def test_independent_task_not_stalled_by_blocked_backlog(ray_session):
     assert dt < 2.0, f"independent task stalled {dt:.2f}s behind a " \
                      "blocked backlog"
     ray_tpu.get(blocked, timeout=120)
+
+
+def test_blocked_worker_does_not_pin_pool_cap():
+    """A worker blocked in get() has released its lease, so it must not
+    count against MAX_WORKERS_CAP. With a cap of 1, every level of a
+    nested-get chain needs a replacement worker while its parent sits
+    blocked — if blocked workers held their pool slot the leaf task
+    could never run (regression: push-based shuffle deadlocked once all
+    32 slots held reduce tasks blocked on their mergers)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    child = textwrap.dedent("""
+        import ray_tpu
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote
+        def leaf():
+            return 1
+
+        @ray_tpu.remote
+        def mid():
+            return ray_tpu.get(leaf.remote()) + 1
+
+        @ray_tpu.remote
+        def top():
+            return ray_tpu.get(mid.remote()) + 1
+
+        print("RESULT", ray_tpu.get(top.remote(), timeout=90))
+
+        # replacement workers spawned past the cap while their peers
+        # were blocked must retire once the pool goes idle again
+        import time
+        from ray_tpu._private import worker as worker_mod
+        node = worker_mod.get_client().node
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            n = sum(1 for w in node.workers.values()
+                    if w.kind == "generic" and w.alive)
+            if n <= 1:
+                break
+            time.sleep(0.5)
+        assert n <= 1, f"pool did not shrink back to cap: {n}"
+        print("RESULT2", ray_tpu.get(leaf.remote(), timeout=60))
+        ray_tpu.shutdown()
+    """)
+    env = dict(os.environ, RAY_TPU_MAX_WORKERS_CAP="1")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "RESULT 3" in r.stdout
+    assert "RESULT2 1" in r.stdout

@@ -180,3 +180,94 @@ def test_spill_to_uri():
                        timeout=180)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "SPILL-URI-OK" in r.stdout
+
+
+# -- commit-marker uploads (crash-safe URI checkpoints) ----------------------
+
+def _src_dir(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.bin").write_bytes(b"alpha" * 100)
+    (src / "sub" / "b.bin").write_bytes(b"beta" * 100)
+    return src
+
+
+def test_committed_upload_roundtrip(tmp_path):
+    root = _bucket()
+    storage.upload_dir_committed(str(_src_dir(tmp_path)), root)
+    assert storage.is_committed(root)
+    dest = tmp_path / "dest"
+    storage.download_dir_committed(root, str(dest))
+    assert (dest / "a.bin").read_bytes() == b"alpha" * 100
+    assert (dest / "sub" / "b.bin").read_bytes() == b"beta" * 100
+
+
+def test_from_uri_on_missing_prefix_raises():
+    from ray_tpu.train.checkpoint import CheckpointError
+    with pytest.raises(CheckpointError, match="no restorable checkpoint"):
+        Checkpoint.from_uri(storage.uri_join(_bucket(), "ckpt"))
+
+
+def test_markerless_upload_refused(tmp_path):
+    """Objects without a commit marker (a writer that died before the
+    marker write) must not restore as if they were a checkpoint."""
+    from ray_tpu.train.checkpoint import CheckpointError
+    root = _bucket()
+    storage.upload_dir(str(_src_dir(tmp_path)), root)   # no marker
+    assert not storage.is_committed(root)
+    with pytest.raises(storage.UncommittedError, match="no commit marker"):
+        storage.download_dir_committed(root, str(tmp_path / "dest"))
+    with pytest.raises(CheckpointError, match="no restorable checkpoint"):
+        Checkpoint.from_uri(root)
+
+
+def test_interrupted_committed_upload_refused(tmp_path, monkeypatch):
+    """Kill the uploader mid-stream: some objects land, the marker never
+    does, and restore refuses the partial prefix."""
+    root = _bucket()
+    backend, _ = storage.get_backend(root)
+    real = backend.write_bytes
+    calls = {"n": 0}
+
+    def dying(path, data):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise OSError("connection reset by peer")
+        real(path, data)
+
+    monkeypatch.setattr(backend, "write_bytes", dying)
+    with pytest.raises(OSError):
+        storage.upload_dir_committed(str(_src_dir(tmp_path)), root)
+    monkeypatch.undo()
+    assert storage.list_prefix(root)            # partial bytes DID land
+    assert not storage.is_committed(root)
+    with pytest.raises(storage.UncommittedError,
+                       match="no commit marker"):
+        storage.download_dir_committed(root, str(tmp_path / "dest"))
+
+
+def test_committed_download_checksum_mismatch(tmp_path):
+    root = _bucket()
+    storage.upload_dir_committed(str(_src_dir(tmp_path)), root)
+    storage.write_bytes(storage.uri_join(root, "a.bin"), b"tampered")
+    with pytest.raises(storage.UncommittedError,
+                       match="checksum mismatch"):
+        storage.download_dir_committed(root, str(tmp_path / "dest"))
+
+
+def test_checkpoint_to_directory_crash_safe(tmp_path):
+    """A to_directory that dies mid-write leaves NO destination dir (and
+    no temp litter); a later successful write fully replaces any previous
+    content."""
+    dest = tmp_path / "ck"
+    bad = Checkpoint.from_dict({"f": lambda: None})     # unpicklable
+    with pytest.raises(Exception):
+        bad.to_directory(str(dest))
+    assert not dest.exists()
+    assert not any(p.name.startswith(".ck.tmp") for p in tmp_path.iterdir())
+
+    Checkpoint.from_dict({"v": 1}).to_directory(str(dest))
+    assert Checkpoint.from_directory(str(dest)).to_dict()["v"] == 1
+    Checkpoint.from_dict({"w": 2}).to_directory(str(dest))
+    back = Checkpoint.from_directory(str(dest)).to_dict()
+    assert back["w"] == 2 and "v" not in back   # old content fully gone

@@ -248,3 +248,60 @@ def test_metrics_ring_unstacks_fused_dispatch():
     hist = ring.drain()
     assert [float(h["loss"]) for h in hist] == [0.0, 1.0, 2.0]
     assert ring.fetches == 1
+
+
+def test_prefetcher_surfaces_host_iterator_error():
+    """A failing host feed is never masked as a clean epoch end: already
+    transferred batches drain in order, then the ORIGINAL exception
+    raises — and keeps raising on every subsequent next()."""
+    mesh = MeshSpec(data=-1).build()
+    place = loop.make_placer(mesh)
+
+    def host():
+        for i in range(3):
+            yield {"x": np.full((8,), float(i), np.float32)}
+        raise OSError("data shard unreachable")
+
+    pf = loop.DevicePrefetcher(host(), place, depth=2)
+    seen = []
+    with pytest.raises(OSError, match="data shard unreachable"):
+        for b in pf:
+            seen.append(float(np.asarray(b["x"])[0]))
+    assert seen == [0.0, 1.0, 2.0]      # buffered batches not lost
+    with pytest.raises(OSError, match="data shard unreachable"):
+        next(pf)                        # persistent, not one-shot
+
+
+def test_prefetcher_skipped_ragged_counter():
+    mesh = MeshSpec(data=-1).build()
+    place = loop.make_placer(mesh, stacked=True)
+
+    def host(n):
+        for i in range(n):
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    pf = loop.DevicePrefetcher(host(7), place, depth=2, group=3)
+    assert len(list(pf)) == 2           # 7 batches -> 2 groups of 3
+    assert pf.skipped_ragged == 1       # the dropped tail is observable
+    pf = loop.DevicePrefetcher(host(6), place, depth=2, group=3)
+    assert len(list(pf)) == 2
+    assert pf.skipped_ragged == 0
+
+
+def test_metrics_ring_drain_resets_cadence():
+    """drain() resets the interval counters, so a ring reused across
+    back-to-back runs neither fires an early fetch nor defers one for an
+    extra interval (regression: _steps_pushed leaked across runs)."""
+    ring = loop.MetricsRing(interval=5, lag=0)
+    for i in range(3):
+        ring.push(jnp.asarray(float(i)))
+    assert [float(x) for x in ring.drain()] == [0.0, 1.0, 2.0]
+    base = ring.fetches
+    for i in range(4):                  # second run: 4 < interval pushes
+        ring.push(jnp.asarray(float(10 + i)))
+    assert ring.fetches == base         # no premature fetch
+    ring.push(jnp.asarray(14.0))        # 5th push of THIS run
+    assert ring.fetches == base + 1     # cadence restarted from zero
+    hist = ring.drain()
+    assert [float(x) for x in hist] == \
+        [0.0, 1.0, 2.0, 10.0, 11.0, 12.0, 13.0, 14.0]
