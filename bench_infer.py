@@ -36,6 +36,18 @@ Knobs (env vars, platform-tuned defaults in main()):
                                      exercises radix sharing
   RAY_TPU_INFER_BENCH_RAGGED         1 = ragged prompt lengths, drawn
                                      uniformly from [PROMPT/2, PROMPT]
+  RAY_TPU_INFER_BENCH_SPEC           "" (off) | "ngram" | "draft":
+                                     speculative decoding backend. When
+                                     set, prompts switch to a repeated-
+                                     motif workload (the case n-gram
+                                     lookahead exists for), a second
+                                     spec-enabled engine runs the same
+                                     traffic, and the JSON reports
+                                     acceptance_rate / tokens_per_step /
+                                     spec_decode_tok_s alongside the
+                                     unchanged baseline headline
+  RAY_TPU_INFER_BENCH_SPEC_K         speculated tokens per step (k)
+  RAY_TPU_INFER_BENCH_DRAFT_LAYERS   draft model depth for SPEC=draft
 
 Baseline: single-token decode is HBM-bandwidth-bound — every step
 streams the full parameter set plus the live KV prefix through the chip
@@ -118,43 +130,80 @@ def main():
     chunk = _env_int("RAY_TPU_INFER_BENCH_CHUNK", 0)
     shared_prefix = _env_int("RAY_TPU_INFER_BENCH_SHARED_PREFIX", 0)
     ragged = _env_int("RAY_TPU_INFER_BENCH_RAGGED", 0)
+    spec = os.environ.get("RAY_TPU_INFER_BENCH_SPEC", "")
+    spec_k = _env_int("RAY_TPU_INFER_BENCH_SPEC_K", 4)
+    draft_layers = _env_int("RAY_TPU_INFER_BENCH_DRAFT_LAYERS", 1)
+    if spec not in ("", "ngram", "draft"):
+        raise SystemExit("SPEC must be '', 'ngram' or 'draft'")
     if prompt_len + new_tokens > max_len:
         raise SystemExit("PROMPT + NEW must fit in MAX_LEN")
     if shared_prefix >= prompt_len:
         raise SystemExit("SHARED_PREFIX must be < PROMPT")
 
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
-    engine = InferenceEngine(params, cfg, slots=slots, max_len=max_len,
-                             block_size=block_size,
-                             prefill_chunk=chunk or None)
     rng = np.random.default_rng(0)
     system_prompt = rng.integers(0, cfg.vocab_size, shared_prefix)
 
-    def make_prompt():
-        p = prompt_len
-        if ragged:
-            p = int(rng.integers(max(prompt_len // 2, shared_prefix + 1),
-                                 prompt_len + 1))
-        suffix = rng.integers(0, cfg.vocab_size, p - shared_prefix)
-        return np.concatenate([system_prompt, suffix]).astype(np.int32)
+    if spec:
+        # Repeated-suffix workload: each prompt tiles a short motif, so
+        # the request's own history predicts its continuation — the
+        # regime n-gram lookahead (and cheap drafting) pays off in.
+        def make_prompt():
+            motif = rng.integers(0, cfg.vocab_size, 4)
+            reps = -(-prompt_len // motif.size)
+            return np.tile(motif, reps)[:prompt_len].astype(np.int32)
+    else:
+        def make_prompt():
+            p = prompt_len
+            if ragged:
+                p = int(rng.integers(
+                    max(prompt_len // 2, shared_prefix + 1),
+                    prompt_len + 1))
+            suffix = rng.integers(0, cfg.vocab_size, p - shared_prefix)
+            return np.concatenate([system_prompt, suffix]) \
+                .astype(np.int32)
 
-    def submit(n):
-        for _ in range(n):
-            engine.submit(make_prompt(), max_new_tokens=new_tokens)
+    def run_engine(extra_kwargs):
+        eng = InferenceEngine(params, cfg, slots=slots, max_len=max_len,
+                              block_size=block_size,
+                              prefill_chunk=chunk or None,
+                              **extra_kwargs)
+        # Warmup: compiles the prefill chunk buckets and the (single)
+        # decode/verify executables, then drops compile time from the
+        # accounting.
+        for _ in range(min(requests, slots)):
+            eng.submit(make_prompt(), max_new_tokens=new_tokens)
+        eng.run_until_idle()
+        eng.reset_stats()
+        for _ in range(requests):
+            eng.submit(make_prompt(), max_new_tokens=new_tokens)
+        eng.run_until_idle()
+        return eng.stats()
 
-    # Warmup: compiles the prefill chunk buckets and the (single)
-    # decode executable, then drops compile time from the accounting.
-    submit(min(requests, slots))
-    engine.run_until_idle()
-    engine.reset_stats()
-
-    submit(requests)
-    engine.run_until_idle()
-    s = engine.stats()
+    s = run_engine({})
     assert s["decode_traces"] == 1, "decode recompiled mid-bench"
+
+    spec_stats = None
+    if spec:
+        ekw = {"spec": spec, "spec_k": spec_k}
+        if spec == "draft":
+            import dataclasses
+            dcfg = dataclasses.replace(cfg, n_layers=draft_layers)
+            ekw["draft_cfg"] = dcfg
+            ekw["draft_params"] = gpt.init_params(
+                jax.random.PRNGKey(1), dcfg)
+        spec_stats = run_engine(ekw)
+        assert spec_stats["decode_traces"] <= 1, \
+            "decode recompiled mid-bench"
+        assert spec_stats["verify_traces"] == 1, \
+            "verify recompiled mid-bench"
 
     prefill_tok_s = s["prefill_tokens"] / max(s["prefill_time_s"], 1e-9)
     decode_tok_s = s["decode_tokens"] / max(s["decode_time_s"], 1e-9)
+    spec_decode_tok_s = (
+        spec_stats["decode_tokens"] / max(spec_stats["decode_time_s"],
+                                          1e-9)
+        if spec_stats else 0.0)
     mean_ctx = prompt_len + new_tokens / 2
     vs_baseline = (decode_tok_s / decode_roofline_tokens_per_sec(
         cfg, slots, mean_ctx, devices[0])) if on_tpu else 0.0
@@ -177,6 +226,15 @@ def main():
         "block_size": s["block_size"],
         "cache_blocks": s["cache_blocks"],
         "shared_prefix": shared_prefix,
+        # speculative decoding (zeros / 1.0-neutral when SPEC is off)
+        "spec": spec,
+        "spec_k": spec_k if spec else 0,
+        "acceptance_rate": round(
+            spec_stats["acceptance_rate"] if spec_stats else 0.0, 3),
+        "tokens_per_step": round(
+            spec_stats["tokens_per_step"] if spec_stats
+            else s["tokens_per_step"], 3),
+        "spec_decode_tok_s": round(spec_decode_tok_s, 1),
     }))
 
 

@@ -390,6 +390,16 @@ SERVE_STATS_TIMEOUT_S = define(
     "Timeout for the controller's replica stats fan-out each "
     "autoscaling tick.")
 
+SERVE_DRAIN_TIMEOUT_S = define(
+    "SERVE_DRAIN_TIMEOUT_S", float, 30.0,
+    "On scale-down, how long the controller waits for a victim "
+    "replica's in-flight requests and response streams to drain "
+    "before it is killed anyway.")
+
+SERVE_DRAIN_POLL_S = define(
+    "SERVE_DRAIN_POLL_S", float, 0.1,
+    "Poll period for the scale-down drain loop's replica stats checks.")
+
 SERVE_HTTP_HOST = define(
     "SERVE_HTTP_HOST", str, "127.0.0.1",
     "Default bind host for the Serve HTTP proxy.")

@@ -78,6 +78,7 @@ class StandardAutoscaler:
             if (nid in self.load_metrics.static_resources
                     and self.load_metrics.idle_seconds(nid) > idle_cutoff):
                 logger.info("terminating idle node %s (%s)", nid, ntype)
+                self.provider.drain_node(nid)
                 self.provider.terminate_node(nid)
                 self.load_metrics.remove_node(nid)
                 counts[ntype] = counts.get(ntype, 0) - 1
@@ -87,6 +88,7 @@ class StandardAutoscaler:
         excess = len(workers) - self.config["max_workers"]
         for nid in workers[:max(0, excess)]:
             logger.info("terminating excess node %s", nid)
+            self.provider.drain_node(nid)
             self.provider.terminate_node(nid)
             self.load_metrics.remove_node(nid)
 

@@ -43,4 +43,31 @@ class LoadMetrics:
         self.pending_gangs = [[dict(b) for b in g] for g in (gangs or [])]
 
     def idle_seconds(self, node_id: str) -> float:
-        return time.time() - self.last_used.get(node_id, time.time())
+        # setdefault, not get: a node we have never seen a report for
+        # starts its idle clock NOW and accrues from here — with a plain
+        # get() each call re-reads time.time() as the baseline, so such
+        # a node reads 0 forever and can never be idle-terminated.
+        last = self.last_used.setdefault(node_id, time.time())
+        return time.time() - last
+
+
+def replica_demands_from_engine_stats(
+        stats: List[dict], *,
+        target_queue_depth: float = 2.0,
+        resources_per_replica: dict | None = None) -> List[dict]:
+    """Translate serve-engine load stats into autoscaler demand entries.
+
+    Each stats dict is one `InferenceEngine.stats()` (as published
+    through `Replica.stats`); requests waiting behind a saturated
+    engine (`queue_depth`, plus any overflow of `pending` admissions)
+    become synthetic replica-shaped resource demands — one demand per
+    `target_queue_depth` queued requests, rounded up — suitable for
+    `LoadMetrics.set_demands`, closing the serve→autoscaler loop."""
+    res = dict(resources_per_replica or {"CPU": 1.0})
+    demands: List[dict] = []
+    tq = max(float(target_queue_depth), 1e-6)
+    for s in stats:
+        queued = float(s.get("queue_depth", 0) or 0)
+        n = int(-(-queued // tq))   # ceil
+        demands.extend(dict(res) for _ in range(n))
+    return demands

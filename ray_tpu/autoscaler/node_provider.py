@@ -35,6 +35,13 @@ class NodeProvider:
     def terminate_node(self, node_id: str) -> None:
         raise NotImplementedError
 
+    def drain_node(self, node_id: str) -> None:
+        """Advisory pre-termination hook: stop scheduling onto the node
+        and let in-flight work finish. The autoscaler calls this BEFORE
+        every `terminate_node` (reference: the GCS DrainNode RPC the
+        reference autoscaler issues ahead of instance teardown).
+        Default: no-op for providers with nothing to drain."""
+
     def is_running(self, node_id: str) -> bool:
         raise NotImplementedError
 
@@ -121,6 +128,9 @@ class FakeNodeProvider(NodeProvider):
         self.startup_delay_s = startup_delay_s
         self.created_log: list[tuple] = []   # (node_type, count)
         self.terminated_log: list[str] = []
+        # ordered verb log: ("drain"|"terminate", node_id) — tests
+        # assert drain happens strictly before terminate per node
+        self.event_log: list[tuple] = []
 
     def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
         with self._lock:
@@ -145,10 +155,15 @@ class FakeNodeProvider(NodeProvider):
                 self._nodes[nid] = {
                     "tags": dict(tags), "created_ts": time.time()}
 
+    def drain_node(self, node_id: str) -> None:
+        with self._lock:
+            self.event_log.append(("drain", node_id))
+
     def terminate_node(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
             self.terminated_log.append(node_id)
+            self.event_log.append(("terminate", node_id))
 
     def is_running(self, node_id: str) -> bool:
         with self._lock:

@@ -626,13 +626,20 @@ def decode_step_paged(params, tokens, cache, pos, tables,
     pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
     b = tokens.shape[0]
     nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    mb = tables.shape[1]
     nh, hd = cfg.n_heads, cfg.head_dim
     pos = pos.astype(jnp.int32)
     tables = tables.astype(jnp.int32)
-    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
-    widx = blk * bs + pos % bs                   # [B] flat write index
+    # Positions past the table's reach (speculative draft steps can run a
+    # few past max_len) must DROP, not clamp — a clamped index would land
+    # the write inside the slot's own last block and corrupt real data.
+    blk = jnp.take_along_axis(
+        tables, jnp.minimum(pos // bs, mb - 1)[:, None], axis=1)[:, 0]
+    widx = jnp.where(pos < mb * bs, blk * bs + pos % bs,
+                     nb * bs)                    # [B] flat write index
     x = params["embed"].astype(adt)[tokens]
-    x = x + params["pos_embed"].astype(adt)[pos]
+    x = x + params["pos_embed"].astype(adt)[
+        jnp.minimum(pos, cfg.max_seq_len - 1)]
 
     def body(x, layer):
         lp, kc, vc = layer                       # kc/vc [nb, bs, H, Dh]
@@ -645,9 +652,9 @@ def decode_step_paged(params, tokens, cache, pos, tables,
                        preferred_element_type=pet).astype(adt)
         q = q.reshape(b, nh, hd)
         kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
-            k.reshape(b, nh, hd).astype(kc.dtype))
+            k.reshape(b, nh, hd).astype(kc.dtype), mode="drop")
         vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
-            v.reshape(b, nh, hd).astype(vc.dtype))
+            v.reshape(b, nh, hd).astype(vc.dtype), mode="drop")
         kc = kf.reshape(nb, bs, nh, hd)
         vc = vf.reshape(nb, bs, nh, hd)
         att = paged_decode_attention(q, kc, vc, tables, pos,
@@ -670,6 +677,87 @@ def decode_step_paged(params, tokens, cache, pos, tables,
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["final_ln_scale"].astype(adt))
     logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(adt),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def verify_step_paged(params, tokens, cache, pos, tables,
+                      cfg: GPTConfig, mesh: Mesh | None = None):
+    """Batched W-token verify forward for speculative decoding: ``tokens
+    [B, W]`` — column 0 is each slot's current token, columns 1..W-1 a
+    speculated continuation — where row b's token j sits at logical
+    position ``pos[b] + j``. Every token's K/V is written to its
+    block/offset first, then all W tokens attend in one shot through
+    `ops.decode_attention.paged_verify_attention` (token j sees positions
+    ``<= pos[b] + j``, i.e. the real prefix plus drafts 0..j-1 — the same
+    numbers W sequential `decode_step_paged` calls would produce).
+    Returns ``(logits [B, W, vocab] f32, cache)``: logits[:, j] is the
+    target model's next-token distribution *after* accepting drafts
+    1..j, which is exactly what the engine's in-jit accept needs.
+
+    Rejected drafts need no device-side cleanup: their K/V sit at
+    positions > the rolled-back ``pos``, which the position mask hides
+    and which the next (sequential) writes overwrite before any read —
+    ``pos`` is the authoritative tail. Positions that run past the table
+    (tail of a near-max_len slot) drop their writes instead of clamping,
+    so a slot can never corrupt its own last block. Shapes are static
+    (B slots, fixed W), so the engine's verify jit compiles exactly
+    once."""
+    from ray_tpu.ops.decode_attention import paged_verify_attention
+    adt = cfg.activation_dtype()
+    pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
+    b, w = tokens.shape
+    nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+    mb = tables.shape[1]
+    nh, hd = cfg.n_heads, cfg.head_dim
+    pos = pos.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+    positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    blk = jnp.take_along_axis(tables, jnp.minimum(positions // bs,
+                                                  mb - 1), axis=1)
+    widx = jnp.where(positions < mb * bs,
+                     blk * bs + positions % bs,
+                     nb * bs).reshape(-1)         # [B*W] flat, drop OOB
+    x = params["embed"].astype(adt)[tokens]
+    x = x + params["pos_embed"].astype(adt)[
+        jnp.minimum(positions, cfg.max_seq_len - 1)]
+
+    def body(x, layer):
+        lp, kc, vc = layer                       # kc/vc [nb, bs, H, Dh]
+        h = _rms_norm(x, lp["ln1_scale"].astype(adt))
+        q = jnp.einsum("bwd,dh->bwh", h, lp["wq"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        k = jnp.einsum("bwd,dh->bwh", h, lp["wk"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        v = jnp.einsum("bwd,dh->bwh", h, lp["wv"].astype(adt),
+                       preferred_element_type=pet).astype(adt)
+        q = q.reshape(b, w, nh, hd)
+        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
+            k.reshape(b * w, nh, hd).astype(kc.dtype), mode="drop")
+        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
+            v.reshape(b * w, nh, hd).astype(vc.dtype), mode="drop")
+        kc = kf.reshape(nb, bs, nh, hd)
+        vc = vf.reshape(nb, bs, nh, hd)
+        att = paged_verify_attention(q, kc, vc, tables, pos,
+                                     impl=cfg.decode_attn_impl)
+        att = jnp.einsum("bwh,hd->bwd", att.reshape(b, w, nh * hd),
+                         lp["wo"].astype(adt),
+                         preferred_element_type=pet).astype(adt)
+        x = x + att
+        h = _rms_norm(x, lp["ln2_scale"].astype(adt))
+        up = jnp.einsum("bwd,df->bwf", h, lp["w_up"].astype(adt),
+                        preferred_element_type=pet).astype(adt)
+        gate = jnp.einsum("bwd,df->bwf", h, lp["w_gate"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        ff = jax.nn.silu(gate) * up
+        down = jnp.einsum("bwf,fd->bwd", ff, lp["w_down"].astype(adt),
+                          preferred_element_type=pet).astype(adt)
+        return x + down, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_ln_scale"].astype(adt))
+    logits = jnp.einsum("bwd,vd->bwv", x, params["embed"].astype(adt),
                         preferred_element_type=jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 

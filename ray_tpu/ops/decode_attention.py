@@ -334,6 +334,172 @@ def _paged_bhsd(q, k, v, tables, pos, *, sm_scale: float, n_heads: int,
     )(tables, pos, q, k, v)
 
 
+def reference_paged_verify_attention(q, k_pool, v_pool, tables, pos):
+    """Multi-query verify attention, gather-then-attend fallback.
+
+    q [B, W, H, D]: W query tokens per sequence, token i of row b sits at
+    logical position ``pos[b] + i`` and attends to cache positions
+    ``<= pos[b] + i`` (the caller writes all W tokens' K/V *before*
+    attending, so draft token i sees drafts 0..i-1 — in-cache causal).
+    k_pool, v_pool [n_blocks, bs, H, D]; tables [B, max_blocks] i32;
+    pos [B] i32. Returns [B, W, H, D] in q.dtype."""
+    k_seq = gather_kv_pages(k_pool, tables)
+    v_seq = gather_kv_pages(v_pool, tables)
+    b, s, h, d = k_seq.shape
+    w = q.shape[1]
+    scores = jnp.einsum("bwhd,bshd->bhws", q.astype(jnp.float32),
+                        k_seq.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    limit = pos.astype(jnp.int32)[:, None] + jnp.arange(w, dtype=jnp.int32)
+    live = jnp.arange(s, dtype=jnp.int32)[None, None, :] <= \
+        limit[:, :, None]                                # [B, W, S]
+    scores = jnp.where(live[:, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhws,bshd->bwhd", p, v_seq.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _paged_mq_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                     m_scr, l_scr, acc_scr, *, sm_scale: float,
+                     block_size: int, n_heads: int, w_real: int):
+    """`_paged_kernel` generalized to W query rows per (b, h): the online
+    softmax statistics become per-row vectors, the mask becomes the
+    staircase ``col <= pos + row``, and the runtime block skip widens to
+    the LAST query row's horizon (``pos + w_real - 1``)."""
+    ji = pl.program_id(1)
+
+    @pl.when(ji == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[pl.program_id(0) // n_heads]
+    k_start = ji * block_size
+
+    @pl.when(k_start <= pos + w_real - 1)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [Wp, D]
+        k = k_ref[0, 0].astype(jnp.float32)         # [bs, D]
+        s = jax.lax.dot_general(
+            q * sm_scale, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [Wp, bs]
+        col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # Padded q rows (>= w_real) reuse the last real row's mask so
+        # they keep >= 1 live column (l stays nonzero); their output is
+        # sliced away by the wrapper.
+        row = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0), w_real - 1)
+        s = jnp.where(col <= pos + row, s, NEG_INF)
+        m_prev = m_scr[:, :1]                       # [Wp, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                      # [Wp, bs]
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(
+            p, axis=1, keepdims=True)
+        m_scr[:, :1] = m_new
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [Wp, D]
+        acc_scr[:] = acc_scr[:] * corr + pv
+
+    @pl.when(ji == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_mq_bhsd(q, k, v, tables, pos, *, sm_scale: float,
+                   n_heads: int, w_real: int, interpret: bool):
+    """q [BH, Wp, D] (Wp = W padded to a sublane multiple); k, v
+    [n_blocks, H, bs, D] head-major pool; tables [B, max_blocks]; pos
+    [B] i32 -> [BH, Wp, D]. Same DMA schedule as `_paged_bhsd` — only
+    the q/o tile grows from one row to Wp."""
+    bh, wp, d = q.shape
+    mb = tables.shape[1]
+    bs = k.shape[2]
+    h = n_heads
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, mb),
+        in_specs=[
+            pl.BlockSpec((1, wp, d), lambda i, j, tbl, ps: (i, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, tbl, ps: (tbl[i // h, j],
+                                                i % h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda i, j, tbl, ps: (tbl[i // h, j],
+                                                i % h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, wp, d),
+                               lambda i, j, tbl, ps: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((wp, 128), jnp.float32),   # m (col 0 used)
+            pltpu.VMEM((wp, 128), jnp.float32),   # l
+            pltpu.VMEM((wp, d), jnp.float32),     # acc
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_mq_kernel, sm_scale=sm_scale,
+                          block_size=bs, n_heads=n_heads, w_real=w_real),
+        out_shape=jax.ShapeDtypeStruct((bh, wp, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, pos, q, k, v)
+
+
+def paged_verify_attention(q, k_pool, v_pool, tables, pos, *,
+                           impl: str = "auto"):
+    """Masked multi-query attention through the paged cache — the verify
+    half of speculative decoding. ``q [B, W, H, D]`` holds W query tokens
+    per sequence (current token + W-1 speculated continuations); token i
+    of row b sits at logical position ``pos[b] + i`` and attends to cache
+    positions ``<= pos[b] + i``. Pools/tables as in
+    `paged_decode_attention`. Returns ``[B, W, H, D]`` in q.dtype.
+
+    impl: "auto" (pallas on TPU-friendly shapes, else jax) | "pallas" |
+    "jax"; the paths share masking/accumulation math."""
+    if q.ndim != 4 or k_pool.ndim != 4 or tables.ndim != 2:
+        raise ValueError(
+            "paged_verify_attention wants q [B, W, H, D], pools "
+            f"[n_blocks, bs, H, D] and tables [B, max_blocks]; got "
+            f"{q.shape}, {k_pool.shape}, {tables.shape}")
+    b, w, h, d = q.shape
+    bs = k_pool.shape[1]
+    if impl == "auto":
+        impl = "pallas" if (jax.default_backend() == "tpu"
+                            and bs % 8 == 0) else "jax"
+    if impl == "jax":
+        return reference_paged_verify_attention(q, k_pool, v_pool,
+                                                tables, pos)
+    if impl != "pallas":
+        raise ValueError(
+            f"unknown paged_verify_attention impl {impl!r} "
+            "(expected 'auto' | 'pallas' | 'jax')")
+    if bs % 8 != 0:
+        raise ValueError(
+            f"block_size {bs} is not a multiple of 8; use impl='jax'")
+    interpret = jax.default_backend() != "tpu"
+    d_pad = _head_pad_target(d)
+    wp = max(8, ((w + 7) // 8) * 8)
+    kt = _pad_heads(k_pool, d_pad).transpose(0, 2, 1, 3)
+    vt = _pad_heads(v_pool, d_pad).transpose(0, 2, 1, 3)
+    qt = _pad_heads(q, d_pad).transpose(0, 2, 1, 3).reshape(
+        b * h, w, d_pad)
+    qt = jnp.pad(qt, ((0, 0), (0, wp - w), (0, 0)))
+    out = _paged_mq_bhsd(qt, kt, vt, tables.astype(jnp.int32),
+                         pos.astype(jnp.int32), sm_scale=d ** -0.5,
+                         n_heads=h, w_real=w, interpret=interpret)
+    return out.reshape(b, h, wp, d_pad)[:, :, :w, :d].transpose(
+        0, 2, 1, 3)
+
+
 def paged_decode_attention(q, k_pool, v_pool, tables, pos, *,
                            impl: str = "auto"):
     """Decode-step attention through a paged KV cache: ``q [B, H, D]``

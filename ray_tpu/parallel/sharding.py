@@ -177,6 +177,19 @@ def replicated(mesh: Mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+def engine_io_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Shardings for the inference engine's per-step host inputs
+    (current tokens, speculation windows, positions, block tables,
+    temperatures). All replicated: they are tiny int32/f32 vectors the
+    scheduler rebuilds every tick, and every shard of the paged pool
+    needs the full batch's tables — but routing them through explicit
+    device_put keeps each step's transfer off XLA's implicit-transfer
+    path and makes the engine's placement auditable."""
+    rep = NamedSharding(mesh, PartitionSpec())
+    return {name: rep
+            for name in ("tokens", "window", "pos", "tables", "temps")}
+
+
 # -- PartitionSpec (de)serialization for checkpoint manifests ---------------
 #
 # Mesh axis NAMES are stable across scale changes (MeshSpec keeps size-1

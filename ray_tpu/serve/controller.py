@@ -28,6 +28,8 @@ logger = logging.getLogger("ray_tpu.serve")
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 from ray_tpu._private.constants import (
     SERVE_DOWNSCALE_DELAY_S,
+    SERVE_DRAIN_POLL_S,
+    SERVE_DRAIN_TIMEOUT_S,
     SERVE_RECONCILE_PERIOD_S as _RECONCILE_PERIOD_S,
     SERVE_STATS_TIMEOUT_S,
 )
@@ -160,6 +162,31 @@ class ServeController:
             except _exc.RayTpuError:
                 pass
 
+    def _drain_replicas(self, replicas: list) -> None:
+        """Block until every victim reports zero in-flight requests AND
+        zero live response streams (or the drain deadline passes). Only
+        called after the shrunk replica set was published, so no new
+        work can arrive at a victim while it drains."""
+        deadline = time.time() + SERVE_DRAIN_TIMEOUT_S
+        remaining = list(replicas)
+        while remaining and time.time() < deadline:
+            busy = []
+            for r in remaining:
+                try:
+                    s = ray_tpu.get(r.stats.remote(),
+                                    timeout=SERVE_STATS_TIMEOUT_S)
+                    if s.get("inflight", 0) > 0 or \
+                            s.get("streams", 0) > 0:
+                        busy.append(r)
+                except _exc.RayTpuError:
+                    pass   # dead/unreachable — nothing left to drain
+            remaining = busy
+            if remaining:
+                time.sleep(SERVE_DRAIN_POLL_S)
+        if remaining:
+            logger.warning("%d replica(s) still busy at drain deadline",
+                           len(remaining))
+
     def _make_replica(self, st: _DeploymentState):
         from ray_tpu.serve.replica import Replica
         opts = dict(st.spec.get("ray_actor_options") or {})
@@ -222,13 +249,19 @@ class ServeController:
                 replica_stats = ray_tpu.get(
                     [r.stats.remote() for r in alive],
                     timeout=SERVE_STATS_TIMEOUT_S)
-                total_inflight = sum(s["inflight"] for s in replica_stats)
+                # Demand = requests being served + requests queued
+                # behind them (engine stats merged through
+                # Replica.stats expose `queue_depth`; plain callables
+                # contribute 0) — queue pressure scales up BEFORE
+                # latency collapses, not after.
+                demand = sum(s["inflight"] + s.get("queue_depth", 0)
+                             for s in replica_stats)
                 target_per = st.autoscaling.get(
                     "target_num_ongoing_requests_per_replica", 1.0)
                 desired = int(max(
                     st.autoscaling.get("min_replicas", 1),
                     min(st.autoscaling.get("max_replicas", 8),
-                        -(-total_inflight // max(target_per, 1e-6))
+                        -(-demand // max(target_per, 1e-6))
                         or st.autoscaling.get("min_replicas", 1))))
                 if desired >= len(alive):
                     st.target_num = desired
@@ -256,8 +289,18 @@ class ServeController:
             victims = alive[st.target_num:] if replica_stats is None \
                 else alive[:len(alive) - st.target_num]
             alive = [r for r in alive if r not in victims]
-            self._kill_replicas(victims)
             changed = True
+            # Publish the shrunk replica set BEFORE touching the
+            # victims: handles refresh off the bumped version and stop
+            # routing to them, then the drain loop waits for their
+            # in-flight requests and response streams to finish —
+            # scale-down never truncates a token stream.
+            with self._lock:
+                if self._deployments.get((st.app_name, st.name)) is st:
+                    st.replicas = list(alive)
+                    st.version += 1
+            self._drain_replicas(victims)
+            self._kill_replicas(victims)
 
         with self._lock:
             # a concurrent delete/redeploy moved this state aside: retire

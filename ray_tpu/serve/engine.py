@@ -29,6 +29,19 @@ thing that changes between steps is *data*, never shapes:
   compiled exactly once for the engine's lifetime (asserted in tests
   via the trace counter). Idle and mid-prefill rows decode garbage
   into the trash block; nobody reads it.
+- **speculative decoding** (``spec='ngram' | 'draft'``): each tick
+  proposes k tokens per slot — n-gram lookahead matches the request's
+  recent suffix against its own prompt+output history (zero model
+  cost), the draft backend runs a smaller GPT with its own paged pool
+  through one jitted k-step scan — then ONE batched verify forward
+  (`gpt.verify_step_paged`) scores the whole window and accepts/
+  corrects in-jit (greedy exact; temperature via the standard
+  rejection-sampling correction, exact for any proposal). Acceptance
+  emits up to k+1 tokens per KV-pool read. No device rollback is
+  needed on rejection: per-slot `pos` is authoritative, attention
+  masks past it, and sequential future writes overwrite stale K/V
+  before any read. Decode and verify each still compile exactly once
+  (`decode_traces` / `verify_traces`).
 
 Sampling (greedy + temperature) runs inside the jitted functions, as
 before. `step()` is the one scheduler tick (admit, chunk, decode);
@@ -302,6 +315,7 @@ class _Pending:
     max_new_tokens: int
     temperature: float
     eos_id: int | None
+    ts: float = 0.0               # submit time (queue-wait accounting)
 
 
 @dataclass
@@ -318,6 +332,14 @@ class _Slot:
     remaining: int = 0
     temperature: float = 0.0
     eos_id: int | None = None
+    submit_ts: float = 0.0
+    # speculative decoding state: the request's token history (prompt +
+    # emitted, n-gram lookahead's corpus) and, for the draft-model
+    # backend, this slot's blocks/table in the DRAFT pool.
+    history: list = field(default_factory=list)
+    draft_blocks: list = field(default_factory=list)
+    draft_table: np.ndarray | None = None
+    draft_filled: int = 0
 
     @property
     def active(self) -> bool:
@@ -344,6 +366,10 @@ class InferenceEngine:
                  cache_blocks: int | None = None,
                  prefill_chunk: int | None = None,
                  prefix_cache: bool = True,
+                 spec: str | None = None, spec_k: int = 4,
+                 ngram_max: int = 3, ngram_min: int = 1,
+                 draft_params=None, draft_cfg=None,
+                 draft_cache_blocks: int | None = None,
                  mesh=None, seed: int = 0):
         import jax
         import jax.numpy as jnp
@@ -379,11 +405,48 @@ class InferenceEngine:
                       if prefix_cache else None)
         self._base_key = jax.random.PRNGKey(seed)
 
+        # --- speculative decoding setup -------------------------------
+        if spec not in (None, "ngram", "draft"):
+            raise ValueError(f"unknown spec backend {spec!r}")
+        if spec is not None and spec_k < 1:
+            raise ValueError("spec_k must be >= 1")
+        self.spec = spec
+        self.spec_k = int(spec_k)
+        # Verify window: [current token, k speculated tokens].
+        self.spec_window = self.spec_k + 1
+        self.ngram_max, self.ngram_min = int(ngram_max), int(ngram_min)
+        self.draft_cfg, self.draft_params = draft_cfg, draft_params
+        if spec == "draft":
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "spec='draft' needs draft_params and draft_cfg")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft model must share the tokenizer")
+            self.draft_cache_blocks = (
+                self.cache_blocks if draft_cache_blocks is None
+                else draft_cache_blocks)
+            self.draft_cache = gpt.init_kv_pool(
+                draft_cfg, self.draft_cache_blocks + 1, block_size, mesh)
+            self._draft_alloc = BlockAllocator(self.draft_cache_blocks + 1)
+        else:
+            self.draft_cache_blocks = 0
+            self.draft_cache = None
+            self._draft_alloc = None
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import engine_io_shardings
+            self._io_sh = engine_io_shardings(mesh)
+        else:
+            self._io_sh = None
+
         # Compile-once accounting: the counters increment inside the
         # traced python functions, i.e. once per (re)trace. Tests pin
-        # decode_traces == 1 across a whole multi-request run.
+        # decode_traces == 1 (and verify_traces == 1 under speculation)
+        # across a whole multi-request run.
         self.prefill_traces = 0
         self.decode_traces = 0
+        self.verify_traces = 0
+        self.draft_traces = 0
+        self.draft_prefill_traces = 0
 
         def _sample(logits, temps, key, step):
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -410,12 +473,104 @@ class InferenceEngine:
                 params, tokens, cache, pos, tables, cfg, mesh)
             return _sample(logits, temps, key, step), cache
 
+        def _verify(params, cache, tokens, pos, tables, temps, key,
+                    step):
+            """One batched W-token forward + in-jit accept/correct.
+
+            `tokens[:, 0]` is each slot's current token, `tokens[:, 1:]`
+            its k speculated continuations. Returns ``(out [B, W],
+            accepted [B], cache)`` where `out[:, :accepted + 1]` are the
+            tokens to emit: the accepted drafts followed by one bonus
+            (all accepted) or corrected (first rejection) target token.
+            Rejected positions need NO device rollback — `pos` is
+            authoritative, attention masks past it, and sequential
+            future writes overwrite the stale K/V before any read.
+            """
+            self.verify_traces += 1
+            logits, cache = gpt.verify_step_paged(
+                params, tokens, cache, pos, tables, cfg, mesh)
+            b, w = tokens.shape
+            drafts = tokens[:, 1:]                       # [B, W-1]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            k = jax.random.fold_in(key, step)
+            safe = jnp.where(temps > 0, temps, 1.0)
+            logp = jax.nn.log_softmax(
+                logits / safe[:, None, None], axis=-1)   # [B, W, V]
+            # Accept draft j iff it matches greedy (temp 0) or w.p.
+            # p_target(draft) (rejection sampling with the draft as a
+            # point-mass proposal — exact for ANY proposal, so padded /
+            # garbage drafts stay distribution-correct).
+            p_draft = jnp.exp(jnp.take_along_axis(
+                logp[:, :-1], drafts[..., None], axis=-1)[..., 0])
+            u = jax.random.uniform(jax.random.fold_in(k, 1),
+                                   drafts.shape)
+            match = jnp.where((temps > 0)[:, None], u < p_draft,
+                              drafts == greedy[:, :-1])
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            accepted = jnp.sum(acc, axis=1)              # [B] in [0,W-1]
+            # Residual for the first rejected position: target dist with
+            # the rejected draft masked out. Col W-1 (the bonus token
+            # when everything is accepted) is sampled unmasked.
+            res = logp.at[jnp.arange(b)[:, None],
+                          jnp.arange(w - 1)[None, :], drafts].set(-1e30)
+            corr = jax.random.categorical(
+                jax.random.fold_in(k, 2), res, axis=-1).astype(jnp.int32)
+            corr = jnp.where((temps > 0)[:, None], corr, greedy)
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros_like(drafts[:, :1])], axis=1)
+            cols = jnp.arange(w)[None, :]
+            out = jnp.where(cols < accepted[:, None], drafts_pad, corr)
+            return out, accepted, cache
+
         # Cache donation: the [L, n_blocks, bs, H, D] pool is by far the
         # engine's biggest array; donating it lets XLA alias input to
         # output so every step updates the pool in place in HBM.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(2,))
         self._decode_fn = jax.jit(_decode, donate_argnums=(1,))
         self._copy_fn = jax.jit(gpt.copy_block, donate_argnums=(0,))
+        self._verify_fn = (jax.jit(_verify, donate_argnums=(1,))
+                           if spec is not None else None)
+
+        if spec == "draft":
+            W = self.spec_window
+
+            def _propose(dparams, dcache, tokens, pos, tables, temps,
+                         key, step):
+                """W draft decode steps as one jitted scan: consume
+                c_0..c_{W-1}, write their K/V at pos..pos+W-1, sample
+                c_1..c_W; the first W-1 samples are the proposal (the
+                last scan step exists only to write d_{k}'s K/V so the
+                draft cache stays lockstep with the target's)."""
+                self.draft_traces += 1
+                k = jax.random.fold_in(jax.random.fold_in(key, step), 3)
+
+                def body(carry, i):
+                    tok, cache = carry
+                    logits, cache = gpt.decode_step_paged(
+                        dparams, tok, cache, pos + i, tables,
+                        draft_cfg, mesh)
+                    nxt = _sample(logits, temps, k, i)
+                    return (nxt, cache), nxt
+
+                (_, dcache), outs = jax.lax.scan(
+                    body, (tokens, dcache),
+                    jnp.arange(W, dtype=jnp.int32))
+                return outs[:-1].T, dcache               # [B, W-1]
+
+            def _draft_prefill(dparams, tokens, dcache, table, start,
+                               length):
+                self.draft_prefill_traces += 1
+                _, dcache = gpt.prefill_paged(
+                    dparams, tokens, dcache, draft_cfg, mesh,
+                    block_table=table, start=start, length=length)
+                return dcache
+
+            self._propose_fn = jax.jit(_propose, donate_argnums=(1,))
+            self._draft_prefill_fn = jax.jit(_draft_prefill,
+                                             donate_argnums=(2,))
+        else:
+            self._propose_fn = None
+            self._draft_prefill_fn = None
 
         self._slots = [_Slot() for _ in range(slots)]
         self._pending: collections.deque[_Pending] = collections.deque()
@@ -441,6 +596,13 @@ class InferenceEngine:
         self._evicted_blocks = 0
         self._cancelled = 0
         self._max_admission_stall = 0.0
+        # Windowed / speculative accounting (all reset_stats-covered).
+        self._tok_window = collections.deque(maxlen=512)  # (dt, tokens)
+        self._queue_waits = collections.deque(maxlen=512)  # submit->tok1
+        self._decode_slot_steps = 0   # sum of decoding-slot count/step
+        self._spec_steps = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     # ------------------------------------------------------------------
     # request side
@@ -473,12 +635,19 @@ class InferenceEngine:
                 f"request footprint "
                 f"{self._blocks_for(prompt.size, max_new_tokens)} blocks "
                 f"exceeds cache blocks {self.cache_blocks}")
+        if self._draft_alloc is not None and \
+                self._blocks_for(prompt.size, max_new_tokens) > \
+                self.draft_cache_blocks:
+            raise ValueError(
+                f"request footprint exceeds draft cache blocks "
+                f"{self.draft_cache_blocks}")
         with self._lock:
             rid = self._rid
             self._rid += 1
             self._out[rid] = collections.deque()
             self._pending.append(_Pending(rid, prompt, max_new_tokens,
-                                          temperature, eos_id))
+                                          temperature, eos_id,
+                                          time.perf_counter()))
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -551,6 +720,8 @@ class InferenceEngine:
         s = self._slots[slot_idx]
         for b in s.blocks:
             self._alloc.decref(b)
+        for b in s.draft_blocks:
+            self._draft_alloc.decref(b)
         self._slots[slot_idx] = _Slot()
 
     def _try_admit(self, slot_idx: int, req: _Pending) -> bool:
@@ -561,6 +732,12 @@ class InferenceEngine:
         bs = self.block_size
         p = req.prompt.size
         total = self._blocks_for(p, req.max_new_tokens)
+        # The draft pool has no prefix sharing or eviction — the full
+        # footprint must be free up front, checked before any main-pool
+        # work so failure needs no rollback.
+        if self._draft_alloc is not None and \
+                self._draft_alloc.free < total:
+            return False
         blocks, matched = ([], 0)
         if self._tree is not None:
             blocks, matched = self._tree.match(req.prompt)
@@ -603,6 +780,14 @@ class InferenceEngine:
         self._admit_seq += 1
         s.temperature, s.eos_id = req.temperature, req.eos_id
         s.remaining = req.max_new_tokens
+        s.submit_ts = req.ts
+        s.history = req.prompt.tolist() if self.spec == "ngram" else []
+        if self._draft_alloc is not None:
+            dblocks = [self._draft_alloc.alloc() for _ in range(total)]
+            dtable = np.zeros((self.max_blocks,), np.int32)
+            dtable[:len(dblocks)] = dblocks
+            s.draft_blocks, s.draft_table = dblocks, dtable
+            s.draft_filled = 0
         self._prefix_hit_tokens += matched
         self._prompt_tokens += p
         return True
@@ -646,22 +831,48 @@ class InferenceEngine:
     def _run_prefill_chunk(self, slot_idx: int):
         jnp = self._jax.numpy
         s = self._slots[slot_idx]
-        clen = min(self.prefill_chunk, s.prompt.size - s.filled)
-        cap = self._chunk_bucket_for(clen)
-        toks = np.zeros((1, cap), np.int32)
-        toks[0, :clen] = s.prompt[s.filled:s.filled + clen]
-        t0 = time.perf_counter()
-        tok, self.cache = self._prefill_fn(
-            self.params, jnp.asarray(toks), self.cache,
-            jnp.asarray(s.table), np.int32(s.filled), np.int32(clen),
-            np.float32(s.temperature), self._base_key,
-            np.int32(self._decode_steps))
-        tok = int(tok)    # device sync, so the timing is honest
-        self._prefill_time += time.perf_counter() - t0
-        self._prefill_tokens += clen
-        self._prefill_chunks += 1
-        s.filled += clen
         if s.filled < s.prompt.size:
+            clen = min(self.prefill_chunk, s.prompt.size - s.filled)
+            cap = self._chunk_bucket_for(clen)
+            toks = np.zeros((1, cap), np.int32)
+            toks[0, :clen] = s.prompt[s.filled:s.filled + clen]
+            t0 = time.perf_counter()
+            tok, self.cache = self._prefill_fn(
+                self.params, jnp.asarray(toks), self.cache,
+                jnp.asarray(s.table), np.int32(s.filled),
+                np.int32(clen), np.float32(s.temperature),
+                self._base_key, np.int32(self._decode_steps))
+            tok = int(tok)    # device sync, so the timing is honest
+            self._prefill_time += time.perf_counter() - t0
+            self._prefill_tokens += clen
+            self._prefill_chunks += 1
+            s.filled += clen
+            if s.filled >= s.prompt.size:
+                # Park the first generated token until the draft cache
+                # (if any) catches up and the slot joins decode.
+                s.token = tok
+        # Draft-model backend: the draft pool has no prefix sharing, so
+        # it absorbs the FULL prompt through its own chunk loop — one
+        # draft chunk per tick, alongside the main chunk. No host sync:
+        # device dataflow orders these writes before the first propose.
+        if self._draft_alloc is not None and \
+                s.draft_filled < s.prompt.size:
+            dclen = min(self.prefill_chunk,
+                        s.prompt.size - s.draft_filled)
+            dcap = self._chunk_bucket_for(dclen)
+            dtoks = np.zeros((1, dcap), np.int32)
+            dtoks[0, :dclen] = s.prompt[
+                s.draft_filled:s.draft_filled + dclen]
+            t0 = time.perf_counter()
+            self.draft_cache = self._draft_prefill_fn(
+                self.draft_params, jnp.asarray(dtoks), self.draft_cache,
+                jnp.asarray(s.draft_table), np.int32(s.draft_filled),
+                np.int32(dclen))
+            self._prefill_time += time.perf_counter() - t0
+            s.draft_filled += dclen
+        if s.filled < s.prompt.size or (
+                self._draft_alloc is not None
+                and s.draft_filled < s.prompt.size):
             return
         # Prefill complete: publish the prompt's full blocks to the
         # radix tree (decode writes only past them, so they are
@@ -669,9 +880,10 @@ class InferenceEngine:
         if self._tree is not None and s.prompt.size >= self.block_size:
             self._tree.insert(s.prompt, s.blocks)
         s.phase = "decode"
-        s.token, s.pos = tok, s.prompt.size
+        s.pos = s.prompt.size
         s.remaining -= 1
-        self._emit(s, slot_idx, tok)
+        self._queue_waits.append(time.perf_counter() - s.submit_ts)
+        self._emit(s, slot_idx, s.token)
 
     def _prefill_tick(self, had_decoders: bool) -> bool:
         """Run prefill chunks: at most ONE while anything is decoding
@@ -693,6 +905,8 @@ class InferenceEngine:
         """Route one generated token; retire the slot (releasing its
         blocks) when finished."""
         self._out[s.rid].append(tok)
+        if self.spec == "ngram":
+            s.history.append(tok)
         hit_eos = s.eos_id is not None and tok == s.eos_id
         # pos of the *next* token; it must still fit in the cache row.
         if s.remaining <= 0 or hit_eos or s.pos + 1 >= self.max_len:
@@ -721,40 +935,147 @@ class InferenceEngine:
                         if s.phase == "decode"]
             if not decoding:   # idle, or every admission finished early
                 return admitted or chunked
-            jnp = self._jax.numpy
-            # Rows not decoding (idle or mid-prefill) point at the trash
-            # block with pos 0: their garbage write collides harmlessly
-            # there and their sampled token is never read.
-            zeros = np.zeros((self.max_blocks,), np.int32)
-            tokens = np.array(
-                [s.token if s.phase == "decode" else 0
-                 for s in self._slots], np.int32)
-            pos = np.array(
-                [s.pos if s.phase == "decode" else 0
-                 for s in self._slots], np.int32)
-            tables = np.stack(
-                [s.table if s.phase == "decode" else zeros
-                 for s in self._slots])
-            temps = np.array([s.temperature for s in self._slots],
-                             np.float32)
-            t0 = time.perf_counter()
-            nxt, self.cache = self._decode_fn(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(pos), jnp.asarray(tables),
-                jnp.asarray(temps), self._base_key,
-                np.int32(self._decode_steps))
-            nxt = np.asarray(nxt)    # device sync
-            dt = time.perf_counter() - t0
-            self._step_times.append(dt)
-            self._decode_time += dt
-            self._decode_steps += 1
-            self._decode_tokens += len(decoding)
-            for i in decoding:
-                s = self._slots[i]
-                s.token, s.pos = int(nxt[i]), s.pos + 1
-                s.remaining -= 1
-                self._emit(s, i, s.token)
+            if self.spec is not None:
+                self._spec_tick(decoding)
+            else:
+                self._decode_tick(decoding)
             return True
+
+    def _dev(self, name: str, arr):
+        """Host array -> device, through the replicated per-step input
+        shardings when the engine runs on a mesh."""
+        if self._io_sh is None:
+            return self._jax.numpy.asarray(arr)
+        return self._jax.device_put(arr, self._io_sh[name])
+
+    def _batch_arrays(self):
+        """Per-slot decode inputs. Rows not decoding (idle or
+        mid-prefill) point at the trash block with pos 0: their garbage
+        write collides harmlessly there and their sampled token is
+        never read."""
+        zeros = np.zeros((self.max_blocks,), np.int32)
+        tokens = np.array(
+            [s.token if s.phase == "decode" else 0
+             for s in self._slots], np.int32)
+        pos = np.array(
+            [s.pos if s.phase == "decode" else 0
+             for s in self._slots], np.int32)
+        tables = np.stack(
+            [s.table if s.phase == "decode" else zeros
+             for s in self._slots])
+        temps = np.array([s.temperature for s in self._slots],
+                         np.float32)
+        return tokens, pos, tables, temps
+
+    def _decode_tick(self, decoding: list):
+        tokens, pos, tables, temps = self._batch_arrays()
+        t0 = time.perf_counter()
+        nxt, self.cache = self._decode_fn(
+            self.params, self.cache, self._dev("tokens", tokens),
+            self._dev("pos", pos), self._dev("tables", tables),
+            self._dev("temps", temps), self._base_key,
+            np.int32(self._decode_steps))
+        nxt = np.asarray(nxt)    # device sync
+        dt = time.perf_counter() - t0
+        self._step_times.append(dt)
+        self._decode_time += dt
+        self._decode_steps += 1
+        self._decode_tokens += len(decoding)
+        self._decode_slot_steps += len(decoding)
+        self._tok_window.append((dt, len(decoding)))
+        for i in decoding:
+            s = self._slots[i]
+            s.token, s.pos = int(nxt[i]), s.pos + 1
+            s.remaining -= 1
+            self._emit(s, i, s.token)
+
+    def _ngram_propose(self, s: _Slot) -> list | None:
+        """Prompt-lookup proposal: find the longest n-gram (ngram_max
+        down to ngram_min) whose latest earlier occurrence in the
+        request's own prompt+output history matches the current suffix,
+        and propose the up-to-k tokens that followed it."""
+        h, n_hist = s.history, len(s.history)
+        for n in range(min(self.ngram_max, n_hist - 1),
+                       self.ngram_min - 1, -1):
+            suf = h[-n:]
+            for i in range(n_hist - n - 1, -1, -1):
+                if h[i:i + n] == suf:
+                    return h[i + n:i + n + self.spec_k]
+        return None
+
+    def _spec_tick(self, decoding: list):
+        """One speculative device step: propose (n-gram host lookup or
+        one jitted draft-model scan), verify the whole window in ONE
+        batched target forward, emit `accepted + 1` tokens per slot.
+        Falls back to the plain decode step when nothing is worth
+        speculating on, so both paths stay compiled-exactly-once."""
+        W = self.spec_window
+        # Slots one token from retiring can't use speculation (and, for
+        # the draft backend, retire before their stale draft cache
+        # could ever be consulted again).
+        worth = [i for i in decoding
+                 if self._slots[i].remaining >= 2]
+        proposals: dict[int, list] = {}
+        tokens, pos, tables, temps = self._batch_arrays()
+        t0 = time.perf_counter()
+        if self.spec == "ngram":
+            for i in worth:
+                prop = self._ngram_propose(self._slots[i])
+                if prop is not None:
+                    proposals[i] = prop
+            if not proposals:
+                self._decode_tick(decoding)
+                return
+            # Junk default (repeat the current token) for rows without
+            # a proposal; any accidental accepts are still exact.
+            drafts = np.repeat(tokens[:, None], W - 1, axis=1)
+            for i, prop in proposals.items():
+                drafts[i, :] = (prop + [prop[-1]] * (W - 1))[:W - 1]
+        else:
+            if not worth:
+                self._decode_tick(decoding)
+                return
+            zeros = np.zeros((self.max_blocks,), np.int32)
+            dtables = np.stack(
+                [s.draft_table if s.phase == "decode" else zeros
+                 for s in self._slots])
+            dj, self.draft_cache = self._propose_fn(
+                self.draft_params, self.draft_cache,
+                self._dev("tokens", tokens), self._dev("pos", pos),
+                self._dev("tables", dtables), self._dev("temps", temps),
+                self._base_key, np.int32(self._decode_steps))
+            drafts = np.asarray(dj)
+            for i in worth:
+                proposals[i] = drafts[i].tolist()
+        window = np.concatenate([tokens[:, None], drafts], axis=1)
+        out, acc, self.cache = self._verify_fn(
+            self.params, self.cache, self._dev("window", window),
+            self._dev("pos", pos), self._dev("tables", tables),
+            self._dev("temps", temps), self._base_key,
+            np.int32(self._decode_steps))
+        out, acc = np.asarray(out), np.asarray(acc)   # device sync
+        dt = time.perf_counter() - t0
+        self._step_times.append(dt)
+        self._decode_time += dt
+        self._decode_steps += 1
+        self._spec_steps += 1
+        self._decode_slot_steps += len(decoding)
+        emitted = 0
+        for i in decoding:
+            s = self._slots[i]
+            if i in proposals:
+                self._spec_proposed += W - 1
+                self._spec_accepted += int(acc[i])
+            for j in range(int(acc[i]) + 1):
+                if self._slots[i] is not s:
+                    break   # slot retired mid-window (eos/len/budget)
+                tok = int(out[i, j])
+                s.token, s.pos = tok, s.pos + 1
+                s.remaining -= 1
+                self._decode_tokens += 1
+                emitted += 1
+                self._emit(s, i, tok)
+        self._tok_window.append((dt, emitted))
 
     def run_until_idle(self):
         """Drive the scheduler until every submitted request finished."""
@@ -784,6 +1105,15 @@ class InferenceEngine:
             assert self._alloc.refcount(b) == holds[b], \
                 f"block {b}: refcount {self._alloc.refcount(b)} != " \
                 f"{holds[b]} holders"
+        if self._draft_alloc is not None:
+            self._draft_alloc.check()
+            dholds = collections.Counter()
+            for s in self._slots:
+                dholds.update(s.draft_blocks)
+            for b in range(1, self._draft_alloc.n_blocks):
+                assert self._draft_alloc.refcount(b) == dholds[b], \
+                    f"draft block {b}: refcount " \
+                    f"{self._draft_alloc.refcount(b)} != {dholds[b]}"
 
     def reset_stats(self):
         """Zero the throughput/latency accounting (NOT the trace
@@ -801,18 +1131,32 @@ class InferenceEngine:
             self._step_times.clear()
             self._occupancy.clear()
             self._block_util.clear()
+            self._tok_window.clear()
+            self._queue_waits.clear()
+            self._decode_slot_steps = 0
+            self._spec_steps = 0
+            self._spec_proposed = self._spec_accepted = 0
 
     def stats(self) -> dict:
         with self._lock:
             times = sorted(self._step_times)
             occ = list(self._occupancy)
             util = list(self._block_util)
+            waits = sorted(self._queue_waits)
+            win_t = sum(dt for dt, _ in self._tok_window)
+            win_toks = sum(n for _, n in self._tok_window)
 
             def pct(p):
                 if not times:
                     return 0.0
                 return times[min(len(times) - 1,
                                  int(p / 100 * len(times)))] * 1e3
+
+            def wpct(p):
+                if not waits:
+                    return 0.0
+                return waits[min(len(waits) - 1,
+                                 int(p / 100 * len(waits)))] * 1e3
             return {
                 "slots": self.num_slots,
                 "active": sum(s.active for s in self._slots),
@@ -845,6 +1189,24 @@ class InferenceEngine:
                 "evicted_blocks": self._evicted_blocks,
                 "cancelled": self._cancelled,
                 "max_admission_stall_ms": self._max_admission_stall * 1e3,
+                # load stats the autoscaler consumes
+                "queue_depth": len(self._pending),
+                "decode_tok_s": (win_toks / win_t) if win_t > 0 else 0.0,
+                "queue_wait_ms_p50": wpct(50),
+                "queue_wait_ms_p99": wpct(99),
+                # speculative decoding
+                "spec": self.spec or "",
+                "spec_k": self.spec_k if self.spec else 0,
+                "verify_traces": self.verify_traces,
+                "draft_traces": self.draft_traces,
+                "draft_prefill_traces": self.draft_prefill_traces,
+                "spec_steps": self._spec_steps,
+                "acceptance_rate": (
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else 0.0),
+                "tokens_per_step": (
+                    self._decode_tokens / self._decode_slot_steps
+                    if self._decode_slot_steps else 0.0),
             }
 
 
@@ -870,9 +1232,17 @@ class InferenceReplica:
         from ray_tpu.models import gpt
         cfg = gpt.small(**(cfg_kwargs or {}))
         params = gpt.init_params(jax.random.PRNGKey(seed), cfg)
+        ek = dict(engine_kwargs or {})
+        # spec='draft' convenience: build the draft model here from the
+        # target's config kwargs (params never ride pickled init args).
+        if ek.get("spec") == "draft" and "draft_params" not in ek:
+            dl = ek.pop("draft_layers", 1)
+            dcfg = gpt.small(**{**(cfg_kwargs or {}), "n_layers": dl})
+            ek["draft_cfg"] = dcfg
+            ek["draft_params"] = gpt.init_params(
+                jax.random.PRNGKey(seed + 1), dcfg)
         self.engine = InferenceEngine(
-            params, cfg, slots=slots, max_len=max_len,
-            **(engine_kwargs or {}))
+            params, cfg, slots=slots, max_len=max_len, **ek)
 
     def __call__(self, prompt, max_new_tokens: int = 8,
                  temperature: float = 0.0):

@@ -220,10 +220,27 @@ class Replica:
 
     def stats(self) -> dict:
         """Autoscaling signal (reference: autoscaling_metrics.py pulls
-        per-replica queue lengths)."""
+        per-replica queue lengths). If the user callable exposes its own
+        `stats()` (e.g. `InferenceReplica` surfacing the engine's
+        `queue_depth` / `decode_tok_s` / queue-wait percentiles), those
+        fields are merged in — the replica-level counters win on
+        collision. `streams` counts still-registered response streams,
+        which the controller's scale-down drain waits on alongside
+        `inflight`."""
         with self._lock:
-            return {"inflight": self._inflight, "total": self._total,
-                    "uptime_s": time.time() - self._started}
+            out = {"inflight": self._inflight, "total": self._total,
+                   "streams": len(self._streams),
+                   "uptime_s": time.time() - self._started}
+        fn = getattr(self.callable, "stats", None)
+        if callable(fn) and not self._is_function:
+            try:
+                user = fn()
+                if isinstance(user, dict):
+                    for k, v in user.items():
+                        out.setdefault(k, v)
+            except Exception:
+                pass
+        return out
 
     def prepare_shutdown(self) -> bool:
         """Graceful-teardown hook called by the controller before kill:

@@ -148,6 +148,76 @@ def test_gang_demand_launches_slice():
     assert scaler.infeasible_gangs == []
 
 
+# -- serve-stats-driven demand + drain ordering ------------------------------
+
+def test_scale_up_from_engine_stats():
+    """Queue pressure published by InferenceEngine.stats() becomes
+    replica demand: 5 queued requests at target depth 2 -> 3 synthetic
+    replica demands -> the scaler launches capacity for them."""
+    from ray_tpu.autoscaler.load_metrics import (
+        replica_demands_from_engine_stats,
+    )
+    stats = [{"queue_depth": 5, "decode_tok_s": 120.0},
+             {"queue_depth": 0, "decode_tok_s": 300.0}]
+    demands = replica_demands_from_engine_stats(
+        stats, target_queue_depth=2.0,
+        resources_per_replica={"CPU": 4.0})
+    assert demands == [{"CPU": 4.0}] * 3    # ceil(5/2); idle engine: 0
+
+    scaler, provider, lm = make()
+    lm.set_demands(demands)
+    scaler.update()
+    assert provider.created_log == [("cpu", 2)]   # 12 CPUs -> 2 nodes
+
+
+def test_engine_stats_demand_empty_when_drained():
+    from ray_tpu.autoscaler.load_metrics import (
+        replica_demands_from_engine_stats,
+    )
+    assert replica_demands_from_engine_stats(
+        [{"queue_depth": 0}, {}]) == []
+
+
+def test_drain_precedes_terminate():
+    """Every terminate_node must be preceded by a drain_node for the
+    same node, in both the idle-reap and excess-workers paths."""
+    scaler, provider, lm = make()
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "cpu"}, 2)
+    for nid in provider.non_terminated_nodes({TAG_NODE_KIND: "worker"}):
+        lm.update_node(nid, {"CPU": 8}, {"CPU": 8}, busy=False)
+    time.sleep(0.12)
+    scaler.update()                          # idle path reaps both
+    assert provider.non_terminated_nodes({TAG_NODE_KIND: "worker"}) == []
+    drained = [n for v, n in provider.event_log if v == "drain"]
+    for verb_nid in [(v, n) for v, n in provider.event_log
+                     if v == "terminate"]:
+        nid = verb_nid[1]
+        assert provider.event_log.index(("drain", nid)) < \
+            provider.event_log.index(("terminate", nid))
+    assert sorted(drained) == sorted(provider.terminated_log)
+
+    # excess path (max_workers shrank under the live count)
+    provider2 = FakeNodeProvider()
+    scaler2, provider2, lm2 = make({"max_workers": 0}, provider2)
+    provider2.create_node({}, {TAG_NODE_KIND: "worker",
+                               TAG_NODE_TYPE: "cpu"}, 1)
+    scaler2.update()
+    assert provider2.event_log[0][0] == "drain"
+    assert provider2.event_log[1][0] == "terminate"
+    assert provider2.event_log[0][1] == provider2.event_log[1][1]
+
+
+def test_idle_seconds_for_never_reported_node():
+    """A node that never sent a resource report must still accrue
+    idleness (from first query), else it can never be idle-reaped."""
+    lm = LoadMetrics()
+    first = lm.idle_seconds("ghost-node")
+    assert first >= 0.0
+    time.sleep(0.05)
+    assert lm.idle_seconds("ghost-node") >= 0.05   # clock is anchored
+
+
 # ---------------------------------------------------------------------------
 # Closed loop e2e: demand flows head -> LoadMetrics -> StandardAutoscaler ->
 # LocalDaemonNodeProvider -> REAL HostDaemon processes (reference:

@@ -485,6 +485,60 @@ class TestCancel:
         got = [eng._out[keep].popleft() for _ in range(6)]
         assert got == rollout_reference(params, p, cfg, 6)
 
+    def test_cancel_mid_spec_frees_draft_blocks(self, setup):
+        """Cancel during a mid-flight speculative run (draft backend)
+        must free BOTH pools' blocks and roll the slot back cleanly."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, spec="draft", spec_k=3,
+                          draft_params=params, draft_cfg=cfg)
+        keep = eng.submit(list(range(20, 29)), max_new_tokens=12)
+        kill = eng.submit(list(range(1, 8)), max_new_tokens=12)
+        it = eng.tokens_for(keep)
+        for _ in range(3):       # both slots are decoding speculatively
+            next(it)
+        assert eng._draft_alloc.used > 0
+        assert eng.cancel(kill)
+        eng.check_invariants()   # covers the draft allocator too
+        rest = list(it)
+        assert len(rest) == 12 - 3
+        eng.run_until_idle()
+        eng.check_invariants()
+        assert eng._draft_alloc.used == 0
+        assert eng.stats()["blocks_in_use"] == 0 or \
+            eng.stats()["cached_prefix_blocks"] > 0
+
+    def test_abandoned_stream_mid_spec(self, setup):
+        """Generator abandonment mid-speculation releases draft blocks
+        (the spec-path extension of the abandoned-stream regression)."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, prefix_cache=False, spec="draft",
+                          spec_k=2, draft_params=params, draft_cfg=cfg)
+        rid = eng.submit(list(range(1, 9)), max_new_tokens=20)
+        it = eng.tokens_for(rid)
+        next(it)
+        assert eng._draft_alloc.used > 0
+        it.close()
+        eng.check_invariants()
+        s = eng.stats()
+        assert s["active"] == 0 and s["blocks_in_use"] == 0
+        assert eng._draft_alloc.used == 0 and s["cancelled"] == 1
+
+    def test_cancel_mid_spec_ngram(self, setup):
+        """Cancel mid-speculation on the n-gram backend: no draft pool
+        involved, slot and main blocks roll back cleanly."""
+        cfg, params = setup
+        motif = [3, 7, 11, 13]
+        eng = make_engine(cfg, params, prefix_cache=False, spec="ngram",
+                          spec_k=4)
+        rid = eng.submit(motif * 3, max_new_tokens=16)
+        it = eng.tokens_for(rid)
+        for _ in range(2):
+            next(it)
+        it.close()
+        eng.check_invariants()
+        s = eng.stats()
+        assert s["active"] == 0 and s["blocks_in_use"] == 0
+
 
 # ---------------------------------------------------------------------------
 # engine: eviction under pressure
