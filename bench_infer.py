@@ -22,6 +22,12 @@ extras ride alongside:
   max_admission_stall_ms   the longest a decode step waited on that
                            tick's admission work (chunked prefill is
                            supposed to bound this to one chunk)
+  weight_swap_ms           in-place weight hot-swap latency: the
+                           update_params call to the first post-swap
+                           token, with the trace counters asserted
+                           unchanged (no recompile)
+  rollout_tok_s            rl.EngineSampler trajectory-generation rate
+                           through the warm engine (tokens/s)
 
 Knobs (env vars, platform-tuned defaults in main()):
   RAY_TPU_INFER_BENCH_SLOTS          resident decode slots (cache batch)
@@ -178,10 +184,35 @@ def main():
         for _ in range(requests):
             eng.submit(make_prompt(), max_new_tokens=new_tokens)
         eng.run_until_idle()
-        return eng.stats()
+        return eng, eng.stats()
 
-    s = run_engine({})
+    eng, s = run_engine({})
     assert s["decode_traces"] == 1, "decode recompiled mid-bench"
+
+    # --- RL flywheel probe: in-place weight hot-swap + engine rollout --
+    # Reuses the warm baseline engine: update_params must not retrigger
+    # any compilation (trace counters pinned), weight_swap_ms runs from
+    # the update_params call to the first post-swap token, and
+    # rollout_tok_s is the EngineSampler's trajectory-generation rate.
+    from ray_tpu.rl.sampler import EngineSampler
+    sampler = EngineSampler(eng, max_new_tokens=new_tokens,
+                            temperature=1.0)
+    probe = [make_prompt() for _ in range(min(requests, slots))]
+    # First swap warms the donated-copy executable (one compile, ever);
+    # the second is the steady-state measurement.
+    eng.update_params(gpt.init_params(jax.random.PRNGKey(2), cfg))
+    sampler.rollout(probe)
+    traces_before = (eng.decode_traces, eng.prefill_traces,
+                     eng.swap_traces)
+    eng.update_params(gpt.init_params(jax.random.PRNGKey(3), cfg))
+    sampler.rollout(probe)
+    assert (eng.decode_traces, eng.prefill_traces,
+            eng.swap_traces) == traces_before, \
+        "weight hot-swap retriggered compilation"
+    swap_stats = eng.stats()
+    assert swap_stats["swaps"] == 2 and swap_stats["params_version"] == 2
+    weight_swap_ms = swap_stats["weight_swap_ms"]
+    rollout_tok_s = sampler.last_rollout_tok_s
 
     spec_stats = None
     if spec:
@@ -192,7 +223,7 @@ def main():
             ekw["draft_cfg"] = dcfg
             ekw["draft_params"] = gpt.init_params(
                 jax.random.PRNGKey(1), dcfg)
-        spec_stats = run_engine(ekw)
+        _, spec_stats = run_engine(ekw)
         assert spec_stats["decode_traces"] <= 1, \
             "decode recompiled mid-bench"
         assert spec_stats["verify_traces"] == 1, \
@@ -235,6 +266,9 @@ def main():
             spec_stats["tokens_per_step"] if spec_stats
             else s["tokens_per_step"], 3),
         "spec_decode_tok_s": round(spec_decode_tok_s, 1),
+        # RL flywheel probe
+        "weight_swap_ms": round(weight_swap_ms, 3),
+        "rollout_tok_s": round(rollout_tok_s, 1),
     }))
 
 

@@ -307,6 +307,38 @@ def loss_fn(params, batch, cfg: GPTConfig, mesh: Mesh | None = None):
     return -jnp.mean(ll)
 
 
+def completion_logprobs(params, tokens, start, width, cfg: GPTConfig,
+                        mesh: Mesh | None = None):
+    """Per-token natural log-likelihoods of a completion region — the
+    DIFFERENTIABLE counterpart of the inference engine's emitted
+    ``TokenEvent.logprob`` (one full forward instead of the KV-cache
+    path; same f32 log_softmax math, so the two agree to f32 tolerance).
+
+    tokens [B, T] int32: full padded sequences (prompt + completion).
+    start [B] int32: index of each row's first completion token (>= 1).
+    width (static int): completion window; returns [B, width] f32 where
+    out[b, j] = log p(tokens[b, start[b]+j] | tokens[b, :start[b]+j]).
+    Positions past a row's real sequence are scored against padding —
+    the caller masks them (ragged lengths stay static-shaped).
+    Gradients flow to params; RL losses build ratios/REINFORCE terms on
+    top of this.
+    """
+    logits = forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    t = tokens.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    # Absolute position of completion token j, clipped into range so
+    # padded tails index safely (caller masks them out).
+    idx = jnp.clip(start[:, None]
+                   + jnp.arange(width, dtype=jnp.int32)[None, :],
+                   1, t - 1)                                  # [B, W]
+    rows = jnp.take_along_axis(
+        logp, (idx - 1)[..., None], axis=1)                   # [B, W, V]
+    toks = jnp.take_along_axis(tokens, idx, axis=1)           # [B, W]
+    return jnp.take_along_axis(rows, toks[..., None],
+                               axis=-1)[..., 0]
+
+
 # ---------------------------------------------------------------------------
 # autoregressive inference: KV cache, prefill, single-token decode
 # ---------------------------------------------------------------------------

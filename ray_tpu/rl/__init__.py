@@ -1,0 +1,36 @@
+"""ray_tpu.rl — the training↔serving RL flywheel.
+
+The trainer (`ray_tpu.train.loop.TrainLoop`) and the sampler
+(`ray_tpu.serve.engine.InferenceEngine`) finally meet: the engine's
+paged-KV continuous-batching path generates the tokens policies train
+on, and trained weights hot-swap back into the live engine with no
+recompile and no restart (`InferenceEngine.update_params`). Podracer's
+Anakin/Sebulba split (2104.06272) maps onto the pair — the engine is
+the colocated "actor" half — and MindSpeed RL (2507.19017) is the
+blueprint for the in-place weight sync between them.
+
+- `EngineSampler` / `TokenEnvRunner` (`sampler.py`): engine-backed
+  rollouts returning SampleBatch trajectories with per-token logprobs
+  and `params_version` staleness tags; registers the "engine"
+  generation backend with `rllib.rollout.make_env_runner`.
+- `FlywheelLoop` (`flywheel.py`): colocated trainer↔generator driver —
+  TrainLoop steps a PPO/REINFORCE-on-sequences objective on engine
+  rollouts and publishes each update into the live engine (and any
+  remote `InferenceReplica`s) through TrainLoop's `publisher` hook.
+"""
+
+__all__ = ["EngineSampler", "TokenEnvRunner", "FlywheelLoop",
+           "motif_reward"]
+
+# jax loads lazily (PEP 562), same idiom as ray_tpu.serve.
+_LAZY = {"EngineSampler": "ray_tpu.rl.sampler",
+         "TokenEnvRunner": "ray_tpu.rl.sampler",
+         "FlywheelLoop": "ray_tpu.rl.flywheel",
+         "motif_reward": "ray_tpu.rl.flywheel"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,212 @@
+"""FlywheelLoop — colocated trainer↔generator RL driver.
+
+One machine-resident cycle per iteration (Anakin-style colocation,
+2104.06272: both halves share the same devices, no weight shipping over
+a network):
+
+    TrainLoop step (PPO-on-sequences)  ──publisher──▶  engine.update_params
+           ▲                                               │ (in-place
+           │ trajectories                                  │  donated swap,
+           │ (tokens + behavior logprobs                   ▼  no recompile)
+           │  + params_version tags)                 InferenceEngine
+           └────────────────────────  EngineSampler ◀──────┘
+
+Generation for iteration N+1 runs AFTER iteration N's weights publish
+(the batch iterator is lazy and `TrainLoop.publisher` fires between
+dispatches), so rollouts are on-policy up to the engine's in-flight
+sequences — whose tokens carry older `params_version` tags the learner
+can mask or importance-correct with. The objective is a clipped
+surrogate (PPO) on whole sampled sequences: the ratio is
+exp(logp_new − behavior_logp) with behavior logprobs taken from the
+engine's emitted `TokenEvent`s, the advantage is the sequence reward
+minus an EMA baseline, and setting `clip=None` recovers plain
+REINFORCE-with-baseline. `models.gpt.completion_logprobs` provides the
+differentiable recompute of exactly the quantity the engine emitted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rl.sampler import (EngineSampler, MASK, PARAMS_VERSION,
+                                START, TOKENS)
+
+
+def motif_reward(motif: int):
+    """Reward = fraction of completion tokens equal to `motif` — the
+    smallest objective that proves the loop closes (the e2e test drives
+    it up from the random-init ~1/vocab base rate)."""
+    motif = int(motif)
+
+    def reward(prompt, completion):
+        comp = np.asarray(completion)
+        return float((comp == motif).mean()) if comp.size else 0.0
+    return reward
+
+
+class FlywheelLoop:
+    """Drives train→publish→generate→learn on one model.
+
+    cfg/params: a `models.gpt` config and (optionally) initial params.
+    prompt_fn(rng) -> token-id sequence; reward_fn(prompt, completion)
+    -> float. The engine is built internally from `engine_kwargs`
+    (slots/max_len/block_size/spec/...) on its OWN copy of the initial
+    weights — `update_params` donates the engine's buffers, so it must
+    not share them with the trainer — or pass a live `engine`.
+
+    `publish_to` takes extra targets every publish also reaches: objects
+    with `.update_params(params)` (engines, `InferenceReplica`s) are
+    called directly; serve `DeploymentHandle`s go through the
+    `handle.update_params.remote(host_params)` method sugar — the serve
+    path to remote replicas.
+
+    `run(iterations)` returns `(state, per-step host metrics)`;
+    `self.history` holds one host-side record per iteration
+    (reward_mean, rollout_tok_s, staleness = engine version minus the
+    oldest tag in the batch)."""
+
+    def __init__(self, cfg, prompt_fn, reward_fn, *, params=None,
+                 seed: int = 0, engine=None, engine_kwargs=None,
+                 mesh=None, optimizer=None, lr: float = 1e-2,
+                 clip: float | None = 0.2, baseline_decay: float = 0.8,
+                 prompts_per_iter: int = 4, max_new_tokens: int = 6,
+                 temperature: float = 1.0, pad_to: int | None = None,
+                 publish_every: int = 1, publish_to=()):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.serve.engine import InferenceEngine
+        from ray_tpu.train.loop import TrainLoop
+        from ray_tpu.train.spmd import TrainState
+        self._jax, self._jnp = jax, jnp
+        self.cfg = cfg
+        self.prompt_fn, self.reward_fn = prompt_fn, reward_fn
+        self.prompts_per_iter = int(prompts_per_iter)
+        self.publish_every = max(1, int(publish_every))
+        self._publish_targets = list(publish_to)
+        self._rng = np.random.default_rng(seed)
+        self._baseline: float | None = None
+        self._decay = float(baseline_decay)
+        self.history: list[dict] = []
+        self.published_version = 0
+
+        if params is None:
+            params = gpt_init(cfg, seed)
+        if engine is None:
+            engine = InferenceEngine(
+                jax.tree.map(jnp.copy, params), cfg, mesh=mesh,
+                **(engine_kwargs or {}))
+        self.engine = engine
+        self.sampler = EngineSampler(
+            engine, max_new_tokens=max_new_tokens,
+            temperature=temperature, pad_to=pad_to)
+        W = int(max_new_tokens)
+        optimizer = optimizer if optimizer is not None else optax.adam(lr)
+
+        from ray_tpu.models import gpt
+
+        def loss_fn(p, batch):
+            lp = gpt.completion_logprobs(
+                p, batch["tokens"], batch["start"], W, cfg, mesh)
+            ratio = jnp.exp(lp - batch["behavior_logp"])
+            adv = batch["advantage"][:, None]
+            if clip is None:
+                surr = lp * adv        # REINFORCE-with-baseline
+            else:
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+            m = batch["mask"]
+            denom = jnp.maximum(m.sum(), 1.0)
+            return -(surr * m).sum() / denom, (lp, ratio, m, denom)
+
+        def step_fn(state, batch):
+            grad = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, (lp, ratio, m, denom)), grads = grad(
+                state.params, batch)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss,
+                "mean_logprob": (lp * m).sum() / denom,
+                "mean_ratio": (ratio * m).sum() / denom,
+            }
+            return (TrainState(new_params, opt_state, state.step + 1),
+                    metrics)
+
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self.state = TrainState(params, optimizer.init(params),
+                                jnp.zeros((), jnp.int32))
+        self.loop = TrainLoop(self._step, publisher=self._publish)
+
+    # -- publish side ---------------------------------------------------
+
+    def _publish(self, state, step: int):
+        if step % self.publish_every:
+            return
+        self.published_version = self.engine.update_params(state.params)
+        host = None
+        for t in self._publish_targets:
+            up = getattr(t, "update_params", None)
+            if up is None:
+                continue
+            if hasattr(up, "remote"):   # serve DeploymentHandle sugar
+                if host is None:
+                    host = self._jax.tree.map(np.asarray, state.params)
+                up.remote(host)
+            else:
+                up(state.params)
+
+    # -- generate side --------------------------------------------------
+
+    def _collect(self):
+        """One engine rollout -> device batch for the jitted step, plus
+        the host-side history record."""
+        jnp = self._jnp
+        prompts = [self.prompt_fn(self._rng)
+                   for _ in range(self.prompts_per_iter)]
+        batch = self.sampler.rollout(prompts, self.reward_fn)
+        r = batch[sb.REWARDS]
+        mean_r = float(r.mean())
+        if self._baseline is None:
+            self._baseline = mean_r
+        adv = (r - self._baseline).astype(np.float32)
+        self._baseline = (self._decay * self._baseline
+                          + (1.0 - self._decay) * mean_r)
+        live = batch[MASK] > 0
+        oldest = (int(batch[PARAMS_VERSION][live].min())
+                  if live.any() else self.engine.params_version)
+        self.history.append({
+            "reward_mean": mean_r,
+            "baseline": self._baseline,
+            "staleness": self.engine.params_version - oldest,
+            "engine_version": self.engine.params_version,
+            "rollout_tok_s": self.sampler.last_rollout_tok_s,
+        })
+        return {
+            "tokens": jnp.asarray(batch[TOKENS]),
+            "start": jnp.asarray(batch[START]),
+            "behavior_logp": jnp.asarray(batch[sb.ACTION_LOGP]),
+            "mask": jnp.asarray(batch[MASK]),
+            "advantage": jnp.asarray(adv),
+        }
+
+    # -- drive ----------------------------------------------------------
+
+    def run(self, iterations: int):
+        """Alternate generate/train/publish for `iterations` cycles
+        through `TrainLoop.run` (generation rides the lazy batch
+        iterator, publication the `publisher` hook). Returns
+        (final TrainState, per-step host metrics)."""
+        it = (self._collect() for _ in range(int(iterations)))
+        self.state, metrics = self.loop.run(self.state, it,
+                                            num_steps=int(iterations))
+        return self.state, metrics
+
+
+def gpt_init(cfg, seed: int):
+    import jax
+    from ray_tpu.models import gpt
+    return gpt.init_params(jax.random.PRNGKey(seed), cfg)

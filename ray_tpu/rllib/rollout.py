@@ -144,6 +144,52 @@ class VectorEnvRunner:
                          "episodes_this_iter": 0}
 
 
+# ---------------------------------------------------------------------------
+# pluggable generation backends
+# ---------------------------------------------------------------------------
+# Token-level "envs" (RLHF prompts, best-of-n eval) want the serving
+# engine's paged-KV path as their sampler, while gym envs keep the eager
+# loop below. A backend is a factory
+#   factory(env, module, rollout_length, *, seed, **backend_kwargs)
+#     -> runner with sample(params) -> (SampleBatch, last_value)
+#        and pop_episode_stats() -> dict
+# i.e. the PythonEnvRunner contract. `ray_tpu.rl.sampler` registers
+# "engine" (EngineSampler) on import; make_env_runner lazy-imports it so
+# rllib never pays for the serving stack unless asked.
+
+_GENERATION_BACKENDS: dict = {}
+
+
+def register_generation_backend(name: str, factory) -> None:
+    """Register a rollout generation backend under `name` (overwrites —
+    tests swap in fakes)."""
+    _GENERATION_BACKENDS[name] = factory
+
+
+def make_env_runner(env, module, rollout_length: int, *, seed: int = 0,
+                    obs_connectors=None, action_connectors=None,
+                    backend: str | None = None,
+                    backend_kwargs: dict | None = None):
+    """Build a rollout runner. backend=None (the default) is EXACTLY the
+    historical PythonEnvRunner construction — a regression test pins the
+    default path unchanged. Named backends come from the registry."""
+    if backend is None:
+        return PythonEnvRunner(env, module, rollout_length, seed=seed,
+                               obs_connectors=obs_connectors,
+                               action_connectors=action_connectors)
+    if backend not in _GENERATION_BACKENDS and backend == "engine":
+        from ray_tpu.rl import sampler as _sampler  # noqa: F401
+        # import side effect registers "engine"
+    try:
+        factory = _GENERATION_BACKENDS[backend]
+    except KeyError:
+        raise ValueError(
+            f"unknown generation backend {backend!r} "
+            f"(registered: {sorted(_GENERATION_BACKENDS)})") from None
+    return factory(env, module, rollout_length, seed=seed,
+                   **(backend_kwargs or {}))
+
+
 class PythonEnvRunner:
     """Eager sampler for gym-API Python envs (reset/step methods).
 

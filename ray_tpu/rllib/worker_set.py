@@ -27,7 +27,9 @@ class RolloutWorker:
 
     def __init__(self, env_creator: Callable, module_creator: Callable,
                  rollout_length: int, worker_index: int, seed: int,
-                 connectors: dict | None = None, num_envs: int = 1):
+                 connectors: dict | None = None, num_envs: int = 1,
+                 generation_backend: str | None = None,
+                 backend_kwargs: dict | None = None):
         env = env_creator(worker_index)
         from ray_tpu.rllib.env.jax_env import EagerJaxEnv, is_jax_env
         from ray_tpu.rllib.rollout import VectorEnvRunner
@@ -52,7 +54,16 @@ class RolloutWorker:
         self.module = (module_creator(env, worker_index=worker_index)
                        if takes_index else module_creator(env))
         connectors = connectors or {}
-        if vectorize:
+        if generation_backend is not None:
+            # pluggable backend (e.g. "engine" -> rl.EngineSampler for
+            # token-level envs); gym envs below keep the eager loop.
+            from ray_tpu.rllib.rollout import make_env_runner
+            self.runner = make_env_runner(
+                env, self.module, rollout_length,
+                seed=seed + worker_index,
+                backend=generation_backend,
+                backend_kwargs=backend_kwargs)
+        elif vectorize:
             # compiled [T, B] unroll; connectors don't apply in-graph
             self.runner = VectorEnvRunner(
                 env, self.module, rollout_length, num_envs,
@@ -103,12 +114,15 @@ class WorkerSet:
                  module_creator: Callable, rollout_length: int,
                  seed: int = 0, num_cpus_per_worker: float = 1.0,
                  max_restarts: int = 2, connectors: dict | None = None,
-                 num_envs_per_worker: int = 1):
+                 num_envs_per_worker: int = 1,
+                 generation_backend: str | None = None,
+                 backend_kwargs: dict | None = None):
         self.num_workers = num_workers
         self._make = lambda i: ray_tpu.remote(
             num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
                 env_creator, module_creator, rollout_length, i, seed,
-                connectors, num_envs_per_worker)
+                connectors, num_envs_per_worker, generation_backend,
+                backend_kwargs)
         self._workers: List = [self._make(i) for i in range(num_workers)]
         self._restarts = [0] * num_workers
         self.max_restarts = max_restarts

@@ -43,6 +43,20 @@ thing that changes between steps is *data*, never shapes:
   before any read. Decode and verify each still compile exactly once
   (`decode_traces` / `verify_traces`).
 
+- **RL flywheel hooks** (`ray_tpu.rl`): every emitted token is a
+  `TokenEvent` — an ``int`` subclass carrying the target model's
+  per-token log-probability and the ``params_version`` it was sampled
+  under — and `update_params()` hot-swaps new weights into the live
+  engine between ticks with NO recompile and NO restart: the new
+  pytree (validated leaf-for-leaf against the old one) is copied
+  in-place into the old params' donated device buffers, the radix
+  prefix cache is flushed (its K/V was computed under the old
+  weights), and the version tag bumps so learners can bound staleness
+  and apply importance correction. Mid-flight sequences keep decoding
+  over their already-written K/V — the standard in-place-sync
+  tradeoff (MindSpeed RL, 2507.19017) — which the per-token version
+  tags make visible to the learner.
+
 Sampling (greedy + temperature) runs inside the jitted functions, as
 before. `step()` is the one scheduler tick (admit, chunk, decode);
 `submit()` / `tokens_for()` / `cancel()` are the request-side API. A
@@ -58,6 +72,41 @@ import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+
+class TokenEvent(int):
+    """A generated token id that is also an ``int``, carrying the RL
+    metadata the flywheel needs:
+
+    - ``logprob``: the TARGET model's natural (temperature-1)
+      log-likelihood of this token given its prefix,
+      ``log_softmax(logits)[token]`` in f32 — i.e. log pi(a|s) for the
+      learner, regardless of the sampling temperature or whether the
+      token came off the plain decode, prefill, or speculative verify
+      path. Matches a full-forward recompute to f32 tolerance.
+    - ``params_version``: the engine's weight version
+      (`InferenceEngine.update_params` bumps it) the token was computed
+      under, so learners can bound staleness / importance-correct.
+
+    Subclassing ``int`` keeps every existing consumer working unchanged
+    (equality with plain ints, json/pickle, serve streaming)."""
+
+    def __new__(cls, token: int, logprob: float = 0.0,
+                params_version: int = 0):
+        ev = super().__new__(cls, token)
+        ev.logprob = float(logprob)
+        ev.params_version = int(params_version)
+        return ev
+
+    def __reduce__(self):
+        # int subclasses need an explicit recipe for the metadata to
+        # survive pickling (object-store / serve transit).
+        return (TokenEvent, (int(self), self.logprob,
+                             self.params_version))
+
+    def __repr__(self):
+        return (f"TokenEvent({int(self)}, logprob={self.logprob:.4f}, "
+                f"params_version={self.params_version})")
 
 
 def _default_buckets(max_len: int) -> tuple[int, ...]:
@@ -297,6 +346,25 @@ class RadixTree:
         kept."""
         return self.evict(self.n_blocks() or 1)
 
+    def flush(self) -> int:
+        """Drop the WHOLE tree unconditionally — every node, including
+        ones whose blocks live requests still reference (the tree's own
+        reference is released; the requests keep theirs, so their blocks
+        stay alive until the slot retires). Used on weight hot-swap:
+        cached prefix K/V was computed under the old params and must not
+        be shared into post-swap admissions. Returns blocks whose LAST
+        reference was the tree's (i.e. blocks actually freed)."""
+        freed = 0
+        for nd in self._nodes():
+            if nd is self.root:
+                continue
+            for b in nd.blocks:
+                self.alloc.decref(b)
+                if self.alloc.refcount(b) == 0:
+                    freed += 1
+        self.root = _RadixNode((), [], None)
+        return freed
+
     def n_blocks(self) -> int:
         return sum(len(nd.blocks) for nd in self._nodes())
 
@@ -328,7 +396,12 @@ class _Slot:
     table: np.ndarray | None = None   # [max_blocks] int32 (0 = trash)
     order: int = 0                # admission sequence (chunk FIFO)
     token: int = 0                # token the next decode consumes
+    token_logp: float = 0.0       # its logprob (parked through prefill)
+    token_ver: int = 0            # params_version it was computed under
     pos: int = 0                  # its position in the logical sequence
+    version: int = 0              # params_version at admission (a slot
+    # admitted under old weights must not publish its prefix blocks to
+    # the radix tree after a swap — its K/V would be stale)
     remaining: int = 0
     temperature: float = 0.0
     eos_id: int | None = None
@@ -449,13 +522,19 @@ class InferenceEngine:
         self.draft_prefill_traces = 0
 
         def _sample(logits, temps, key, step):
+            """Sample one token per row; also return the model's NATURAL
+            (temperature-1) f32 log-likelihood of the sampled token —
+            the per-token logprob the RL flywheel trains against."""
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             k = jax.random.fold_in(key, step)
             safe = jnp.where(temps > 0, temps, 1.0)
             sampled = jax.random.categorical(
                 k, logits.astype(jnp.float32) / safe[:, None]
             ).astype(jnp.int32)
-            return jnp.where(temps > 0, sampled, greedy)
+            tok = jnp.where(temps > 0, sampled, greedy)
+            nat = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = jnp.take_along_axis(nat, tok[:, None], axis=-1)[:, 0]
+            return tok, logp
 
         def _prefill(params, tokens, cache, table, start, length, temp,
                      key, step):
@@ -463,15 +542,16 @@ class InferenceEngine:
             logits, cache = gpt.prefill_paged(
                 params, tokens, cache, cfg, mesh, block_table=table,
                 start=start, length=length)
-            tok = _sample(logits, temp[None], key, step)[0]
-            return tok, cache
+            tok, logp = _sample(logits, temp[None], key, step)
+            return tok[0], logp[0], cache
 
         def _decode(params, cache, tokens, pos, tables, temps, key,
                     step):
             self.decode_traces += 1
             logits, cache = gpt.decode_step_paged(
                 params, tokens, cache, pos, tables, cfg, mesh)
-            return _sample(logits, temps, key, step), cache
+            tok, logp = _sample(logits, temps, key, step)
+            return tok, logp, cache
 
         def _verify(params, cache, tokens, pos, tables, temps, key,
                     step):
@@ -520,7 +600,15 @@ class InferenceEngine:
                 [drafts, jnp.zeros_like(drafts[:, :1])], axis=1)
             cols = jnp.arange(w)[None, :]
             out = jnp.where(cols < accepted[:, None], drafts_pad, corr)
-            return out, accepted, cache
+            # Natural (temperature-1) logprob of each emitted token:
+            # logits[:, j] is the next-token distribution after the
+            # prefix extended by out[:, :j], so column j's emitted token
+            # scores against column j's untempered log-softmax — same
+            # contract as the plain decode path.
+            nat = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            out_lp = jnp.take_along_axis(
+                nat, out[..., None], axis=-1)[..., 0]
+            return out, out_lp, accepted, cache
 
         # Cache donation: the [L, n_blocks, bs, H, D] pool is by far the
         # engine's biggest array; donating it lets XLA alias input to
@@ -549,7 +637,7 @@ class InferenceEngine:
                     logits, cache = gpt.decode_step_paged(
                         dparams, tok, cache, pos + i, tables,
                         draft_cfg, mesh)
-                    nxt = _sample(logits, temps, k, i)
+                    nxt, _ = _sample(logits, temps, k, i)
                     return (nxt, cache), nxt
 
                 (_, dcache), outs = jax.lax.scan(
@@ -603,6 +691,27 @@ class InferenceEngine:
         self._spec_steps = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+
+        # --- RL flywheel: in-place donated weight hot-swap ------------
+        # update_params() copies a new pytree INTO the old params'
+        # device buffers (donation lets XLA alias input->output leaf by
+        # leaf), so the arrays the jitted decode/verify closures see
+        # keep their shapes, dtypes, shardings — and, critically, their
+        # identity as far as compiled executables are concerned: no
+        # retrace, no recompile, no restart. The source pytree is NOT
+        # donated — the trainer keeps its own state alive.
+        self._params_version = 0
+        self._swaps = 0
+        self._swap_pending_ts: float | None = None
+        self._last_swap_ms = 0.0
+        self.swap_traces = 0   # traces once per distinct treedef
+                               # (target and draft trees each once)
+
+        def _swap(old, new):
+            self.swap_traces += 1
+            return jax.tree.map(jnp.copy, new)
+
+        self._swap_fn = jax.jit(_swap, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     # request side
@@ -674,7 +783,10 @@ class InferenceEngine:
             return hit
 
     def tokens_for(self, rid: int):
-        """Generator of generated token ids for one request. Pumps the
+        """Generator of generated tokens for one request — each yielded
+        value is a `TokenEvent`: an ``int`` (token id) that also carries
+        ``.logprob`` (natural log pi(token|prefix) under the weights it
+        was sampled with) and ``.params_version``. Pumps the
         shared engine: each next() ticks `step()` (under the lock) until
         this request has output, so N concurrent consumers collectively
         drive one continuously-batched device loop. Abandoning the
@@ -705,6 +817,86 @@ class InferenceEngine:
     def generate(self, prompt, **kw) -> list[int]:
         """Blocking convenience: submit + drain one request."""
         return list(self.tokens_for(self.submit(prompt, **kw)))
+
+    # ------------------------------------------------------------------
+    # weight hot-swap (RL flywheel)
+    # ------------------------------------------------------------------
+
+    def _swap_tree(self, old, new, what: str):
+        """Validate leaf-for-leaf compatibility, place `new` on the old
+        leaves' shardings, and copy it into the old buffers (donated).
+        Returns the swapped pytree (living in the OLD device memory)."""
+        jax = self._jax
+        old_leaves, old_def = jax.tree.flatten(old)
+        new_leaves, new_def = jax.tree.flatten(new)
+        if old_def != new_def:
+            raise ValueError(
+                f"update_params: {what} pytree structure changed "
+                f"({new_def} != {old_def})")
+        for o, n in zip(old_leaves, new_leaves):
+            if tuple(o.shape) != tuple(n.shape) or o.dtype != n.dtype:
+                raise ValueError(
+                    f"update_params: {what} leaf mismatch "
+                    f"{n.shape}/{n.dtype} != {o.shape}/{o.dtype} — "
+                    f"hot-swap requires identical shapes and dtypes")
+        placed = jax.tree.unflatten(old_def, [
+            jax.device_put(n, o.sharding) if hasattr(o, "sharding")
+            else jax.numpy.asarray(n)
+            for o, n in zip(old_leaves, new_leaves)])
+        return self._swap_fn(old, placed)
+
+    def update_params(self, new_params, *, draft_params=None) -> int:
+        """Hot-swap model weights into the live engine between ticks.
+
+        `new_params` must match the current params pytree leaf-for-leaf
+        in structure, shape, and dtype (optimizer steps preserve this by
+        construction). The swap is an in-place donated device copy into
+        the OLD buffers, so nothing the compiled decode / verify / prefill
+        executables depend on changes: trace counters stay untouched —
+        asserted in tests — and in-flight requests are not restarted.
+        `draft_params` optionally swaps the speculative draft model the
+        same way.
+
+        Consequences the caller should know:
+
+        - The engine owns its buffers: the params object passed at
+          construction (or returned by a previous swap) is invalidated
+          by donation. `new_params` itself is NOT donated — a trainer
+          can keep training on the same state it published.
+        - The radix prefix cache is flushed: cached K/V was computed
+          under the old weights and must not be shared into post-swap
+          admissions. In-flight sequences keep their already-written
+          K/V and finish on mixed old/new-weight context — the standard
+          in-place-sync staleness tradeoff (MindSpeed RL, 2507.19017) —
+          which the per-token `params_version` tags make visible so
+          learners can bound staleness or importance-correct.
+        - `params_version` increments and stamps every subsequently
+          computed token (`TokenEvent.params_version`); `stats()`
+          reports it alongside the `swaps` counter and `weight_swap_ms`
+          (update_params call to first post-swap token).
+
+        Returns the new `params_version`."""
+        with self._lock:
+            t0 = time.perf_counter()
+            self.params = self._swap_tree(self.params, new_params,
+                                          "params")
+            if draft_params is not None:
+                if self.draft_params is None:
+                    raise ValueError(
+                        "update_params: draft_params given but the "
+                        "engine has no draft model")
+                self.draft_params = self._swap_tree(
+                    self.draft_params, draft_params, "draft_params")
+            if self._tree is not None:
+                self._tree.flush()
+            self._params_version += 1
+            self._swaps += 1
+            self._swap_pending_ts = t0
+            return self._params_version
+
+    @property
+    def params_version(self) -> int:
+        return self._params_version
 
     # ------------------------------------------------------------------
     # scheduler
@@ -781,6 +973,7 @@ class InferenceEngine:
         s.temperature, s.eos_id = req.temperature, req.eos_id
         s.remaining = req.max_new_tokens
         s.submit_ts = req.ts
+        s.version = self._params_version
         s.history = req.prompt.tolist() if self.spec == "ngram" else []
         if self._draft_alloc is not None:
             dblocks = [self._draft_alloc.alloc() for _ in range(total)]
@@ -837,7 +1030,7 @@ class InferenceEngine:
             toks = np.zeros((1, cap), np.int32)
             toks[0, :clen] = s.prompt[s.filled:s.filled + clen]
             t0 = time.perf_counter()
-            tok, self.cache = self._prefill_fn(
+            tok, lp, self.cache = self._prefill_fn(
                 self.params, jnp.asarray(toks), self.cache,
                 jnp.asarray(s.table), np.int32(s.filled),
                 np.int32(clen), np.float32(s.temperature),
@@ -848,9 +1041,12 @@ class InferenceEngine:
             self._prefill_chunks += 1
             s.filled += clen
             if s.filled >= s.prompt.size:
-                # Park the first generated token until the draft cache
-                # (if any) catches up and the slot joins decode.
+                # Park the first generated token (with its logprob and
+                # compute-time version) until the draft cache (if any)
+                # catches up and the slot joins decode.
                 s.token = tok
+                s.token_logp = float(lp)
+                s.token_ver = self._params_version
         # Draft-model backend: the draft pool has no prefix sharing, so
         # it absorbs the FULL prompt through its own chunk loop — one
         # draft chunk per tick, alongside the main chunk. No host sync:
@@ -876,14 +1072,17 @@ class InferenceEngine:
             return
         # Prefill complete: publish the prompt's full blocks to the
         # radix tree (decode writes only past them, so they are
-        # immutable), then join the decode batch.
-        if self._tree is not None and s.prompt.size >= self.block_size:
+        # immutable), then join the decode batch. A slot admitted under
+        # an older params_version spanned a hot-swap mid-prefill — its
+        # K/V mixes weight versions and must NOT enter the prefix cache.
+        if self._tree is not None and s.prompt.size >= self.block_size \
+                and s.version == self._params_version:
             self._tree.insert(s.prompt, s.blocks)
         s.phase = "decode"
         s.pos = s.prompt.size
         s.remaining -= 1
         self._queue_waits.append(time.perf_counter() - s.submit_ts)
-        self._emit(s, slot_idx, s.token)
+        self._emit(s, slot_idx, s.token, s.token_logp, s.token_ver)
 
     def _prefill_tick(self, had_decoders: bool) -> bool:
         """Run prefill chunks: at most ONE while anything is decoding
@@ -901,10 +1100,20 @@ class InferenceEngine:
             if had_decoders:
                 return did
 
-    def _emit(self, s: _Slot, slot_idx: int, tok: int):
-        """Route one generated token; retire the slot (releasing its
+    def _emit(self, s: _Slot, slot_idx: int, tok: int,
+              logp: float = 0.0, ver: int | None = None):
+        """Route one generated token (as a `TokenEvent` carrying its
+        logprob and params_version); retire the slot (releasing its
         blocks) when finished."""
-        self._out[s.rid].append(tok)
+        ev = TokenEvent(tok, logp,
+                        self._params_version if ver is None else ver)
+        if self._swap_pending_ts is not None:
+            # First token computed after a hot-swap closes the
+            # weight_swap_ms measurement window.
+            self._last_swap_ms = (time.perf_counter()
+                                  - self._swap_pending_ts) * 1e3
+            self._swap_pending_ts = None
+        self._out[s.rid].append(ev)
         if self.spec == "ngram":
             s.history.append(tok)
         hit_eos = s.eos_id is not None and tok == s.eos_id
@@ -970,12 +1179,13 @@ class InferenceEngine:
     def _decode_tick(self, decoding: list):
         tokens, pos, tables, temps = self._batch_arrays()
         t0 = time.perf_counter()
-        nxt, self.cache = self._decode_fn(
+        nxt, lps, self.cache = self._decode_fn(
             self.params, self.cache, self._dev("tokens", tokens),
             self._dev("pos", pos), self._dev("tables", tables),
             self._dev("temps", temps), self._base_key,
             np.int32(self._decode_steps))
         nxt = np.asarray(nxt)    # device sync
+        lps = np.asarray(lps)
         dt = time.perf_counter() - t0
         self._step_times.append(dt)
         self._decode_time += dt
@@ -987,7 +1197,7 @@ class InferenceEngine:
             s = self._slots[i]
             s.token, s.pos = int(nxt[i]), s.pos + 1
             s.remaining -= 1
-            self._emit(s, i, s.token)
+            self._emit(s, i, s.token, float(lps[i]))
 
     def _ngram_propose(self, s: _Slot) -> list | None:
         """Prompt-lookup proposal: find the longest n-gram (ngram_max
@@ -1048,12 +1258,13 @@ class InferenceEngine:
             for i in worth:
                 proposals[i] = drafts[i].tolist()
         window = np.concatenate([tokens[:, None], drafts], axis=1)
-        out, acc, self.cache = self._verify_fn(
+        out, out_lp, acc, self.cache = self._verify_fn(
             self.params, self.cache, self._dev("window", window),
             self._dev("pos", pos), self._dev("tables", tables),
             self._dev("temps", temps), self._base_key,
             np.int32(self._decode_steps))
         out, acc = np.asarray(out), np.asarray(acc)   # device sync
+        out_lp = np.asarray(out_lp)
         dt = time.perf_counter() - t0
         self._step_times.append(dt)
         self._decode_time += dt
@@ -1074,7 +1285,7 @@ class InferenceEngine:
                 s.remaining -= 1
                 self._decode_tokens += 1
                 emitted += 1
-                self._emit(s, i, tok)
+                self._emit(s, i, tok, float(out_lp[i, j]))
         self._tok_window.append((dt, emitted))
 
     def run_until_idle(self):
@@ -1116,9 +1327,13 @@ class InferenceEngine:
                     f"{self._draft_alloc.refcount(b)} != {dholds[b]}"
 
     def reset_stats(self):
-        """Zero the throughput/latency accounting (NOT the trace
-        counters or the cache itself) — benches call this after warmup
-        so compile time stays out of the timed region."""
+        """Zero the throughput/latency accounting — benches call this
+        after warmup so compile time stays out of the timed region.
+        NOT reset: the trace counters (`*_traces`, `swap_traces`), the
+        cache itself, and `params_version` — version is identity, not a
+        rate; a learner correlating trajectory tags against
+        `stats()["params_version"]` must not see it rewind. The windowed
+        `swaps` counter and `weight_swap_ms` DO reset."""
         with self._lock:
             self._decode_steps = 0
             self._prefill_tokens = self._decode_tokens = 0
@@ -1136,8 +1351,68 @@ class InferenceEngine:
             self._decode_slot_steps = 0
             self._spec_steps = 0
             self._spec_proposed = self._spec_accepted = 0
+            self._swaps = 0
+            self._last_swap_ms = 0.0
 
     def stats(self) -> dict:
+        """The engine's one stats contract — this dict feeds the serve
+        autoscaler (`autoscaler.load_metrics.
+        replica_demands_from_engine_stats`), `bench_infer.py`'s JSON,
+        and the RL flywheel's staleness accounting. Keys:
+
+        Scheduler/throughput:
+          ``slots`` / ``active`` / ``pending`` — slot capacity, occupied
+          slots, queued (unadmitted) requests.
+          ``decode_steps`` — device decode/verify ticks since reset.
+          ``prefill_tokens`` / ``decode_tokens`` — tokens absorbed /
+          emitted since reset; ``prefill_time_s`` / ``decode_time_s``
+          the device time attributed to each.
+          ``prefill_chunks`` — chunked-admission device calls.
+          ``slot_occupancy`` — mean fraction of slots active per tick.
+          ``p50_token_latency_ms`` / ``p99_token_latency_ms`` — decode
+          step-time percentiles over a 512-tick window.
+
+        Compile-once accounting (NEVER reset — identity, not rate):
+          ``prefill_traces`` / ``decode_traces`` / ``verify_traces`` /
+          ``draft_traces`` / ``draft_prefill_traces`` — python traces of
+          each jitted path; tests pin decode/verify to 1 per lifetime.
+          ``swap_traces`` — traces of the hot-swap copy fn (once per
+          distinct pytree: target and draft each trace once, ever).
+
+        Paged cache:
+          ``block_size`` / ``cache_blocks`` / ``blocks_in_use`` /
+          ``blocks_free`` — pool geometry and live allocation.
+          ``cached_prefix_blocks`` — blocks the radix tree holds.
+          ``cache_block_utilization`` — mean pool utilization per tick.
+          ``prefix_hit_rate`` / ``prefix_hit_tokens`` — prompt tokens
+          admitted by cache reference instead of prefill.
+          ``cow_copies`` — mid-block copy-on-write splits.
+          ``evicted_blocks`` — blocks LRU-evicted under pressure.
+          ``cancelled`` — requests cancelled/abandoned.
+          ``max_admission_stall_ms`` — worst single-tick admission work
+          while anything was decoding.
+
+        Autoscaler load signals:
+          ``queue_depth`` — unadmitted requests (demand ~ inflight +
+          queue_depth); ``decode_tok_s`` — windowed emission rate;
+          ``queue_wait_ms_p50`` / ``queue_wait_ms_p99`` — submit to
+          first token.
+
+        Speculative decoding:
+          ``spec`` / ``spec_k`` — backend ('' when off) and window.
+          ``spec_steps`` — verify ticks; ``acceptance_rate`` — accepted
+          / proposed drafts; ``tokens_per_step`` — emitted tokens per
+          decoding-slot-step (1.0 when spec is off).
+
+        RL flywheel:
+          ``params_version`` — monotonically increasing weight version;
+          bumped by `update_params`, stamped on every `TokenEvent`,
+          survives `reset_stats`.
+          ``swaps`` — hot-swaps since reset.
+          ``weight_swap_ms`` — last measured update_params-call to
+          first-post-swap-token latency (0.0 until a post-swap token
+          lands).
+        """
         with self._lock:
             times = sorted(self._step_times)
             occ = list(self._occupancy)
@@ -1207,6 +1482,11 @@ class InferenceEngine:
                 "tokens_per_step": (
                     self._decode_tokens / self._decode_slot_steps
                     if self._decode_slot_steps else 0.0),
+                # RL flywheel
+                "params_version": self._params_version,
+                "swaps": self._swaps,
+                "weight_swap_ms": self._last_swap_ms,
+                "swap_traces": self.swap_traces,
             }
 
 
@@ -1252,6 +1532,13 @@ class InferenceReplica:
 
     def cancel(self, rid: int) -> bool:
         return self.engine.cancel(rid)
+
+    def update_params(self, new_params, *, draft_params=None) -> int:
+        """Hot-swap weights into this replica's live engine (the serve
+        path the flywheel publishes through); returns the new
+        params_version."""
+        return self.engine.update_params(new_params,
+                                         draft_params=draft_params)
 
     def stats(self) -> dict:
         return self.engine.stats()

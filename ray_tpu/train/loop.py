@@ -220,7 +220,8 @@ class TrainLoop:
 
     def __init__(self, step_fn: Callable, *, unroll: int = 1,
                  metrics_interval: int = 10, metrics_lag: int = 2,
-                 donate: bool = True, checkpointer=None):
+                 donate: bool = True, checkpointer=None,
+                 publisher: Callable | None = None):
         self.unroll = max(1, int(unroll))
         self.metrics_interval = metrics_interval
         self.metrics_lag = metrics_lag
@@ -232,6 +233,15 @@ class TrainLoop:
         # compiled loop can toggle checkpointing between runs without
         # rebuilding (and re-tracing) the fused dispatch.
         self.checkpointer = checkpointer
+        # Optional weight publisher `publisher(state, step)` — the RL
+        # flywheel's seam (rl.FlywheelLoop wires it to
+        # InferenceEngine.update_params). Called at the same
+        # donation-safety point as the checkpointer: after a dispatch
+        # returns and BEFORE the next dispatch donates the state's
+        # buffers, so a publisher that device-copies (update_params
+        # does) never races the training step. Mutable for the same
+        # reason as `checkpointer`.
+        self.publisher = publisher
 
     def run(self, state, device_batches: Iterable,
             num_steps: int | None = None, *, start_step: int = 0):
@@ -254,11 +264,14 @@ class TrainLoop:
             state, metrics = self._dispatch(state, batch)
             ring.push(metrics, count=self.unroll)
             done += self.unroll
-            # Snapshot BEFORE the next dispatch donates these buffers:
-            # maybe_snapshot's device-side copy is the donation-safety
-            # seam (ft.AsyncCheckpointer docstring).
+            # Snapshot/publish BEFORE the next dispatch donates these
+            # buffers: both hooks device-copy what they keep, which is
+            # the donation-safety seam (ft.AsyncCheckpointer docstring;
+            # engine.update_params copies into its own buffers).
             if ckpt is not None:
                 ckpt.maybe_snapshot(state, done)
+            if self.publisher is not None:
+                self.publisher(state, done)
             if num_steps is not None and done >= num_steps:
                 break
         if ckpt is not None:

@@ -43,6 +43,11 @@ def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     assert rec["acceptance_rate"] == 0.0
     assert rec["tokens_per_step"] == 1.0
     assert rec["spec_decode_tok_s"] == 0.0
+    # RL-flywheel fields: the warm in-place weight swap (bench_infer
+    # itself asserts the swap didn't retrace) and engine rollout rate
+    assert np.isfinite(rec["weight_swap_ms"]) and rec["weight_swap_ms"] > 0
+    assert rec["weight_swap_ms"] < 1000.0     # warm swap, not a compile
+    assert rec["rollout_tok_s"] > 0.0
 
 
 def test_bench_infer_spec_ngram_smoke(capsys, monkeypatch):

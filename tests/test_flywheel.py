@@ -1,0 +1,467 @@
+"""RL flywheel tests: TokenEvent metadata on every emitted token,
+in-place donated weight hot-swap (post-swap greedy outputs bitwise-match
+a fresh engine built on the new params — incl. shared-prefix/COW and
+spec-decode on — with the trace counters pinned unchanged), logprob
+parity between the engine's KV-cache paths and a full-forward recompute
+(`gpt.completion_logprobs`, f32 1e-4), staleness tagging
+(`params_version` on every trajectory token), the pluggable generation
+backend (default PythonEnvRunner path byte-identical to before),
+TrainLoop's publisher hook, and the end-to-end flywheel: a tiny GPT
+trained on engine-generated rollouts with mid-stream hot-swaps, zero
+recompiles, and a measurably rising reward. Runs under
+JAX_PLATFORMS=cpu (conftest forces it)."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt
+from ray_tpu.rl.flywheel import FlywheelLoop, motif_reward
+from ray_tpu.rl.sampler import (MASK, PARAMS_VERSION, START, TOKENS,
+                                EngineSampler, TokenEnvRunner)
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.rollout import PythonEnvRunner, make_env_runner
+from ray_tpu.serve.engine import InferenceEngine, TokenEvent
+from ray_tpu.train.loop import TrainLoop
+
+
+def tiny_cfg(**kw):
+    return gpt.GPTConfig(**{**dict(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype="float32"), **kw})
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    params2 = gpt.init_params(jax.random.PRNGKey(1), cfg)
+    return cfg, params, params2
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("block_size", 8)
+    # The engine donates its param buffers on update_params, so it gets
+    # its own copy — the module-scoped fixture params stay valid.
+    return InferenceEngine(jax.tree.map(jnp.copy, params), cfg, **kw)
+
+
+def ints(events):
+    return [int(t) for t in events]
+
+
+# ---------------------------------------------------------------------------
+# TokenEvent
+# ---------------------------------------------------------------------------
+
+class TestTokenEvent:
+    def test_is_an_int(self):
+        ev = TokenEvent(7, -1.5, 3)
+        assert ev == 7 and ev + 1 == 8 and isinstance(ev, int)
+        assert ev.logprob == -1.5 and ev.params_version == 3
+        assert [ev] == [7]            # list equality, as old tests use
+
+    def test_pickle_keeps_metadata(self):
+        ev = pickle.loads(pickle.dumps(TokenEvent(9, -0.25, 2)))
+        assert ev == 9 and ev.logprob == -0.25 and ev.params_version == 2
+
+    def test_defaults(self):
+        ev = TokenEvent(4)
+        assert ev.logprob == 0.0 and ev.params_version == 0
+
+
+# ---------------------------------------------------------------------------
+# weight hot-swap
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_swap_matches_fresh_engine_greedy(self, setup):
+        cfg, params, params2 = setup
+        eng = make_engine(cfg, params)
+        prompts = [[1, 2, 3, 4], [5, 6, 7, 8, 9]]
+        for p in prompts:
+            eng.generate(p, max_new_tokens=6)
+        assert eng.decode_traces == 1
+        traces = (eng.decode_traces, eng.prefill_traces)
+        v = eng.update_params(jax.tree.map(jnp.copy, params2))
+        assert v == 1
+        swapped = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        # no recompile: same executables, same trace counters
+        assert (eng.decode_traces, eng.prefill_traces) == traces
+        fresh = make_engine(cfg, params2)
+        for got, p in zip(swapped, prompts):
+            want = fresh.generate(p, max_new_tokens=6)
+            assert ints(got) == ints(want)
+            np.testing.assert_allclose(
+                [t.logprob for t in got], [t.logprob for t in want],
+                atol=1e-5)
+        st = eng.stats()
+        assert st["params_version"] == 1 and st["swaps"] == 1
+        assert st["weight_swap_ms"] > 0.0
+        assert all(t.params_version == 1 for t in swapped[0])
+
+    def test_swap_with_shared_prefix_cow(self, setup):
+        """Prefix-cache state must not leak across a swap: requests
+        sharing a radix-cached prefix (with a mid-block COW split)
+        re-prefill after the flush and still match a fresh engine."""
+        cfg, params, params2 = setup
+        eng = make_engine(cfg, params, slots=2)
+        shared = list(range(1, 13))       # 12 tokens: 1.5 blocks -> COW
+        a, b = shared + [20, 21], shared + [30, 31]
+        eng.generate(a, max_new_tokens=4)
+        eng.generate(b, max_new_tokens=4)   # COW hit on the shared part
+        assert eng.stats()["prefix_hit_tokens"] > 0
+        eng.update_params(jax.tree.map(jnp.copy, params2))
+        assert eng.stats()["cached_prefix_blocks"] == 0  # flushed
+        fresh = make_engine(cfg, params2, slots=2)
+        for p in (a, b, a):   # third run re-shares post-swap prefixes
+            assert ints(eng.generate(p, max_new_tokens=4)) == \
+                ints(fresh.generate(p, max_new_tokens=4))
+        eng.check_invariants()
+
+    def test_swap_with_spec_decode_on(self, setup):
+        cfg, params, params2 = setup
+        rng = np.random.default_rng(0)
+        motif = rng.integers(1, cfg.vocab_size, 4)
+        prompt = np.tile(motif, 4).astype(np.int32)
+        eng = make_engine(cfg, params, spec="ngram", spec_k=3)
+        eng.generate(prompt, max_new_tokens=8)
+        assert eng.verify_traces == 1
+        traces = (eng.decode_traces, eng.verify_traces,
+                  eng.prefill_traces)
+        eng.update_params(jax.tree.map(jnp.copy, params2))
+        got = eng.generate(prompt, max_new_tokens=8)
+        assert (eng.decode_traces, eng.verify_traces,
+                eng.prefill_traces) == traces
+        fresh = make_engine(cfg, params2, spec="ngram", spec_k=3)
+        assert ints(got) == ints(fresh.generate(prompt,
+                                                max_new_tokens=8))
+
+    def test_swap_draft_params(self, setup):
+        cfg, params, params2 = setup
+        dcfg = tiny_cfg(n_layers=1)
+        d1 = gpt.init_params(jax.random.PRNGKey(7), dcfg)
+        d2 = gpt.init_params(jax.random.PRNGKey(8), dcfg)
+        eng = make_engine(cfg, params, spec="draft", spec_k=2,
+                          draft_cfg=dcfg,
+                          draft_params=jax.tree.map(jnp.copy, d1))
+        prompt = [1, 2, 3, 4, 5, 6]
+        eng.generate(prompt, max_new_tokens=6)
+        traces = (eng.decode_traces, eng.verify_traces,
+                  eng.draft_traces)
+        eng.update_params(jax.tree.map(jnp.copy, params2),
+                          draft_params=jax.tree.map(jnp.copy, d2))
+        got = eng.generate(prompt, max_new_tokens=6)
+        assert (eng.decode_traces, eng.verify_traces,
+                eng.draft_traces) == traces
+        fresh = make_engine(cfg, params2, spec="draft", spec_k=2,
+                            draft_cfg=dcfg, draft_params=d2)
+        assert ints(got) == ints(fresh.generate(prompt,
+                                                max_new_tokens=6))
+
+    def test_swap_validation(self, setup):
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params)
+        bad_shape = jax.tree.map(lambda a: a, params)
+        bad_shape = dict(bad_shape)
+        bad_shape["embed"] = jnp.zeros((3, 3), jnp.float32)
+        with pytest.raises(ValueError, match="leaf mismatch"):
+            eng.update_params(bad_shape)
+        with pytest.raises(ValueError, match="structure"):
+            eng.update_params({"nope": jnp.zeros(())})
+        with pytest.raises(ValueError, match="no draft model"):
+            eng.update_params(
+                jax.tree.map(jnp.copy, params),
+                draft_params=jax.tree.map(jnp.copy, params))
+        assert eng.stats()["swaps"] == 0     # failed swaps don't count
+
+    def test_mid_prefill_swap_keeps_mixed_kv_out_of_tree(self, setup):
+        """A prompt whose chunked prefill spans a swap computed K/V
+        under BOTH weight versions — it must finish (tagged with the
+        new version) but never publish its blocks to the prefix cache."""
+        cfg, params, params2 = setup
+        eng = make_engine(cfg, params, prefill_chunk=8)
+        # park a decoding sequence so the scheduler runs ONE prefill
+        # chunk per tick for the next admission
+        eng.submit([1, 2, 3], max_new_tokens=8)
+        eng.step()
+        assert any(s.phase == "decode" for s in eng._slots)
+        rid = eng.submit(np.arange(1, 25, dtype=np.int32),
+                         max_new_tokens=3)
+        eng.step()                      # admit + first chunk only
+        eng.update_params(jax.tree.map(jnp.copy, params2))
+        eng.run_until_idle()
+        out = list(eng._out[rid])
+        assert len(out) == 3
+        # final prefill chunk + decodes all ran post-swap -> tagged 1
+        assert all(t.params_version == 1 for t in out)
+        assert eng._tree.n_blocks() == 0    # mixed-KV prefix not cached
+        eng.check_invariants()
+
+    def test_params_version_survives_reset_stats(self, setup):
+        cfg, params, params2 = setup
+        eng = make_engine(cfg, params)
+        eng.generate([1, 2, 3], max_new_tokens=2)
+        eng.update_params(jax.tree.map(jnp.copy, params2))
+        eng.generate([1, 2, 3], max_new_tokens=2)
+        assert eng.stats()["swaps"] == 1
+        eng.reset_stats()
+        st = eng.stats()
+        assert st["params_version"] == 1      # identity: never rewinds
+        assert st["swaps"] == 0 and st["weight_swap_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# logprob parity: engine KV-cache paths vs full-forward recompute
+# ---------------------------------------------------------------------------
+
+def recompute_logprobs(params, cfg, prompt, completion):
+    full = np.concatenate([np.asarray(prompt, np.int32),
+                           np.asarray(completion, np.int32)])[None]
+    lp = gpt.completion_logprobs(params, jnp.asarray(full),
+                                 jnp.asarray([len(prompt)], jnp.int32),
+                                 len(completion), cfg)
+    return np.asarray(lp)[0]
+
+
+class TestLogprobParity:
+    @pytest.mark.parametrize("temperature", [0.0, 0.7])
+    def test_decode_path(self, setup, temperature):
+        """Emitted logprobs are the NATURAL log pi regardless of the
+        sampling temperature, matching a full forward to f32 1e-4."""
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params)
+        prompt = [3, 1, 4, 1, 5]
+        out = eng.generate(prompt, max_new_tokens=6,
+                           temperature=temperature)
+        want = recompute_logprobs(params, cfg, prompt, ints(out))
+        np.testing.assert_allclose([t.logprob for t in out], want,
+                                   atol=1e-4)
+
+    def test_spec_verify_path(self, setup):
+        cfg, params, _ = setup
+        rng = np.random.default_rng(3)
+        motif = rng.integers(1, cfg.vocab_size, 3)
+        prompt = np.tile(motif, 4).astype(np.int32)
+        eng = make_engine(cfg, params, spec="ngram", spec_k=3)
+        out = eng.generate(prompt, max_new_tokens=8)
+        assert eng.stats()["spec_steps"] > 0   # speculation really ran
+        want = recompute_logprobs(params, cfg, prompt, ints(out))
+        np.testing.assert_allclose([t.logprob for t in out], want,
+                                   atol=1e-4)
+
+    def test_chunked_prefill_first_token(self, setup):
+        """The first generated token's logprob comes off the prefill
+        path (parked through chunking) — same contract."""
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params, prefill_chunk=8)
+        prompt = list(range(1, 20))
+        out = eng.generate(prompt, max_new_tokens=4)
+        want = recompute_logprobs(params, cfg, prompt, ints(out))
+        np.testing.assert_allclose([t.logprob for t in out], want,
+                                   atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# EngineSampler / trajectory batches
+# ---------------------------------------------------------------------------
+
+class _TokenEnv:
+    """Token-level env for the runner contract: fixed prompt family +
+    motif-fraction reward."""
+    eos_id = None
+
+    def __init__(self, motif=7):
+        self._reward = motif_reward(motif)
+
+    def make_prompt(self, rng):
+        return [1, 2, int(rng.integers(3, 9))]
+
+    def reward(self, prompt, completion):
+        return self._reward(prompt, completion)
+
+
+class TestEngineSampler:
+    def test_batch_contract(self, setup):
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params, slots=4)
+        sampler = EngineSampler(eng, max_new_tokens=5, temperature=1.0,
+                                pad_to=16)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        batch = sampler.rollout(prompts, motif_reward(7))
+        B, W = len(prompts), 5
+        assert batch[TOKENS].shape == (B, 16)
+        assert batch[sb.ACTIONS].shape == (B, W)
+        assert batch[sb.ACTION_LOGP].shape == (B, W)
+        assert batch[MASK].sum() == B * W          # no eos: full width
+        for b, p in enumerate(prompts):
+            assert batch[START][b] == len(p)
+            assert list(batch[TOKENS][b, :len(p)]) == p
+            np.testing.assert_array_equal(
+                batch[TOKENS][b, len(p):len(p) + W],
+                batch[sb.ACTIONS][b])
+        assert batch[sb.DONES].all()
+        assert (batch[sb.ACTION_LOGP][batch[MASK] > 0] < 0).all()
+        assert sampler.last_rollout_tok_s > 0
+
+    def test_staleness_tags_on_every_trajectory(self, setup):
+        cfg, params, params2 = setup
+        eng = make_engine(cfg, params, slots=2)
+        sampler = EngineSampler(eng, max_new_tokens=3, pad_to=16)
+        b0 = sampler.rollout([[1, 2, 3], [4, 5, 6]])
+        assert (b0[PARAMS_VERSION][b0[MASK] > 0] == 0).all()
+        eng.update_params(jax.tree.map(jnp.copy, params2))
+        b1 = sampler.rollout([[1, 2, 3], [4, 5, 6]])
+        assert (b1[PARAMS_VERSION][b1[MASK] > 0] == 1).all()
+
+    def test_engine_backend_runner(self, setup):
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params, slots=2)
+        runner = make_env_runner(
+            _TokenEnv(), module=None, rollout_length=3, seed=0,
+            backend="engine",
+            backend_kwargs=dict(engine=eng, max_new_tokens=4,
+                                pad_to=16, publish=False))
+        assert isinstance(runner, TokenEnvRunner)
+        batch, last_v = runner.sample(None)
+        assert len(batch) == 3 and last_v.shape == (3,)
+        stats = runner.pop_episode_stats()
+        assert stats["episodes_this_iter"] == 3
+        assert np.isfinite(stats["episode_reward_mean"])
+
+    def test_unknown_backend_raises(self):
+        with pytest.raises(ValueError, match="unknown generation"):
+            make_env_runner(object(), None, 1, backend="nope")
+
+
+# ---------------------------------------------------------------------------
+# default rollout path regression (pluggable backend satellite)
+# ---------------------------------------------------------------------------
+
+class _CountEnv:
+    """Deterministic 4-step-episode gym-style env."""
+
+    def __init__(self):
+        self._t = 0
+
+    def reset(self):
+        self._t = 0
+        return np.zeros(2, np.float32)
+
+    def step(self, action):
+        self._t += 1
+        obs = np.full(2, self._t, np.float32)
+        return obs, float(self._t), self._t % 4 == 0, {}
+
+
+class _TinyModule:
+    def compute_actions(self, params, obs, key):
+        a = jnp.sum(obs, axis=-1).astype(jnp.int32) % 3
+        logp = -jnp.ones(obs.shape[0])
+        v = jnp.sum(obs, axis=-1)
+        return a, logp, v
+
+
+def test_default_path_byte_identical():
+    """make_env_runner(backend=None) IS the historical PythonEnvRunner
+    construction — same class, same seeds, byte-identical batches."""
+    mod = _TinyModule()
+    direct = PythonEnvRunner(_CountEnv(), mod, 6, seed=3)
+    via = make_env_runner(_CountEnv(), mod, 6, seed=3)
+    assert type(via) is PythonEnvRunner
+    b_direct, v_direct = direct.sample({})
+    b_via, v_via = via.sample({})
+    assert set(b_direct.keys()) == set(b_via.keys())
+    for k in b_direct:
+        np.testing.assert_array_equal(b_direct[k], b_via[k])
+    assert v_direct == v_via
+    assert direct.pop_episode_stats() == via.pop_episode_stats()
+
+
+# ---------------------------------------------------------------------------
+# TrainLoop publisher hook
+# ---------------------------------------------------------------------------
+
+def test_trainloop_publisher_hook():
+    calls = []
+
+    def step(state, batch):
+        return state + 1, {"step": state}
+
+    loop = TrainLoop(jax.jit(step),
+                     publisher=lambda st, n: calls.append((int(st), n)))
+    state, _ = loop.run(jnp.int32(0), iter([jnp.int32(0)] * 4),
+                        num_steps=4)
+    # called after every dispatch with the POST-step state + step count
+    assert calls == [(1, 1), (2, 2), (3, 3), (4, 4)]
+    assert int(state) == 4
+    loop.publisher = None                     # mutable, like checkpointer
+    state, _ = loop.run(state, iter([jnp.int32(0)] * 2), num_steps=99)
+    assert calls[-1] == (4, 4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end flywheel
+# ---------------------------------------------------------------------------
+
+def _flywheel(iterations, **kw):
+    cfg = tiny_cfg(vocab_size=32)
+    kw.setdefault("engine_kwargs", dict(
+        slots=4, max_len=32, prefill_buckets=(8,), block_size=8))
+    fly = FlywheelLoop(
+        cfg, lambda rng: [1, 2, int(rng.integers(3, 9))],
+        motif_reward(7), lr=5e-2, prompts_per_iter=8, max_new_tokens=5,
+        temperature=1.0, pad_to=16, seed=0, **kw)
+    state, metrics = fly.run(iterations)
+    return fly, state, metrics
+
+
+def test_flywheel_e2e_smoke():
+    """Tier-1 acceptance: engine-generated rollouts train the policy,
+    weights hot-swap mid-stream with ZERO recompiles, post-swap greedy
+    tokens bitwise-match a fresh engine on the final params, and the
+    reward measurably rises."""
+    replica_like = make_engine(
+        tiny_cfg(vocab_size=32),
+        gpt.init_params(jax.random.PRNGKey(0), tiny_cfg(vocab_size=32)))
+    fly, state, metrics = _flywheel(12, publish_to=[replica_like])
+    # zero recompiles across 12 hot-swaps
+    assert fly.engine.decode_traces == 1
+    assert fly.engine.stats()["swaps"] == 12
+    assert fly.engine.params_version == 12
+    assert replica_like.params_version == 12      # publish fan-out
+    # the objective measurably improves
+    rw = [h["reward_mean"] for h in fly.history]
+    assert np.mean(rw[-4:]) > np.mean(rw[:4]) + 0.15, rw
+    # staleness is tagged and bounded (colocated loop: fully on-policy)
+    assert all(h["staleness"] >= 0 for h in fly.history)
+    assert len(metrics) == 12 and np.isfinite(metrics[-1]["loss"])
+    # post-swap greedy bitwise-matches a fresh engine on the new params
+    fresh = InferenceEngine(
+        jax.tree.map(jnp.copy, state.params), fly.cfg,
+        slots=4, max_len=32, prefill_buckets=(8,), block_size=8)
+    for prompt in ([1, 2, 3], [1, 2, 8]):
+        got = fly.engine.generate(prompt, max_new_tokens=5)
+        want = fresh.generate(prompt, max_new_tokens=5)
+        assert ints(got) == ints(want)
+        np.testing.assert_allclose([t.logprob for t in got],
+                                   [t.logprob for t in want], atol=1e-5)
+    assert fly.engine.decode_traces == 1          # still exactly once
+
+
+@pytest.mark.slow
+def test_flywheel_e2e_full():
+    """Longer run drives the motif reward to (near-)saturation, and the
+    REINFORCE (clip=None) objective also learns."""
+    fly, _, _ = _flywheel(40)
+    rw = [h["reward_mean"] for h in fly.history]
+    assert np.mean(rw[-5:]) > 0.8, rw
+    fly2, _, _ = _flywheel(30, clip=None)
+    rw2 = [h["reward_mean"] for h in fly2.history]
+    assert np.mean(rw2[-5:]) > np.mean(rw2[:5]) + 0.2, rw2
