@@ -1,7 +1,11 @@
 """Flagship benchmark: GPT train throughput, streaming fresh host batches
 through the overlapped training loop (ray_tpu/train/loop.py).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+"checkpoint_overhead_pct", "mfu", "step_breakdown" (host step-time
+shares from TrainLoop.last_breakdown: prefetch / dispatch / metrics /
+checkpoint / publish) and "retraces_unexpected" (retrace-sentinel
+violations of the fused dispatch's compile-once pin — must be 0).
 
 Methodology (changed in PR 2): earlier rounds re-dispatched one jitted
 step per Python iteration on a single pre-sharded device batch, so the
@@ -135,8 +139,11 @@ def main():
     place = loop.make_placer(mesh, stacked=unroll > 1)
     batches = loop.DevicePrefetcher(host_batches(), place,
                                     depth=prefetch, group=unroll)
+    tokens_per_step = batch_size * cfg.max_seq_len
+    flops_tok = spmd.train_flops_per_token(cfg, cfg.max_seq_len)
     train = loop.TrainLoop(step_fn, unroll=unroll,
-                           metrics_interval=interval)
+                           metrics_interval=interval,
+                           flops_per_step=flops_tok * tokens_per_step)
 
     # Warmup compiles the fused dispatch and fills the prefetch ring;
     # drain() inside run() blocks until the device finishes, so the
@@ -178,10 +185,16 @@ def main():
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
     checkpoint_overhead_pct = (dt_ckpt - dt) / dt * 100.0
+    # Step-time breakdown from the checkpoint region — the run where all
+    # the host activities the loop is supposed to hide (data feed,
+    # metrics plumbing, checkpoint snapshots) are actually live.
+    bd = train.last_breakdown
+    step_breakdown = {
+        k: round(bd.get(f"{k}_share", 0.0), 4)
+        for k in ("prefetch", "dispatch", "metrics", "checkpoint",
+                  "publish")}
 
-    tokens_per_step = batch_size * cfg.max_seq_len
     tok_s = tokens_per_step * steps / dt
-    flops_tok = spmd.train_flops_per_token(cfg, cfg.max_seq_len)
     mfu = tok_s * flops_tok / (peak_flops(devices[0]) * len(devices))
     vs_baseline = mfu / _BASELINE_MFU if on_tpu else 0.0
 
@@ -191,6 +204,9 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(vs_baseline, 3),
         "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
+        "mfu": round(mfu, 4),
+        "step_breakdown": step_breakdown,
+        "retraces_unexpected": train.sentinel.retraces_unexpected,
     }))
 
 

@@ -28,6 +28,15 @@ extras ride alongside:
                            unchanged (no recompile)
   rollout_tok_s            rl.EngineSampler trajectory-generation rate
                            through the warm engine (tokens/s)
+  ttft_ms_p50 / _p99       submit-to-first-token percentiles over the
+                           timed region (the flight recorder's TTFT)
+  retraces_unexpected      retrace-sentinel violations of the pinned
+                           compile-once paths (must be 0 in a bench)
+  trace_overhead_pct       flight-recorder cost: wall-time delta of the
+                           same workload with per-request tracing
+                           sampled at 1.0 vs 0.0. Only measured when
+                           RAY_TPU_INFER_BENCH_TRACE_OVERHEAD=1 (it
+                           doubles the run); 0.0 otherwise
 
 Knobs (env vars, platform-tuned defaults in main()):
   RAY_TPU_INFER_BENCH_SLOTS          resident decode slots (cache batch)
@@ -68,6 +77,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import jax
 import numpy as np
@@ -183,11 +193,25 @@ def main():
         eng.reset_stats()
         for _ in range(requests):
             eng.submit(make_prompt(), max_new_tokens=new_tokens)
+        # Wall time of the timed region (not just attributed device
+        # time): the flight recorder's per-token work happens between
+        # device calls, so only wall time can see its overhead.
+        t0 = time.perf_counter()
         eng.run_until_idle()
-        return eng, eng.stats()
+        wall = time.perf_counter() - t0
+        return eng, eng.stats(), wall
 
-    eng, s = run_engine({})
+    eng, s, _ = run_engine({})
     assert s["decode_traces"] == 1, "decode recompiled mid-bench"
+    assert s["retraces_unexpected"] == 0, "retrace sentinel tripped"
+
+    # --- flight-recorder overhead probe (opt-in: doubles the run) ------
+    trace_overhead_pct = 0.0
+    if _env_int("RAY_TPU_INFER_BENCH_TRACE_OVERHEAD", 0):
+        _, _, wall_on = run_engine({"telemetry_sample": 1.0})
+        _, _, wall_off = run_engine({"telemetry_sample": 0.0})
+        trace_overhead_pct = ((wall_on - wall_off)
+                              / max(wall_off, 1e-9) * 100.0)
 
     # --- RL flywheel probe: in-place weight hot-swap + engine rollout --
     # Reuses the warm baseline engine: update_params must not retrigger
@@ -223,7 +247,7 @@ def main():
             ekw["draft_cfg"] = dcfg
             ekw["draft_params"] = gpt.init_params(
                 jax.random.PRNGKey(1), dcfg)
-        _, spec_stats = run_engine(ekw)
+        _, spec_stats, _ = run_engine(ekw)
         assert spec_stats["decode_traces"] <= 1, \
             "decode recompiled mid-bench"
         assert spec_stats["verify_traces"] == 1, \
@@ -269,6 +293,11 @@ def main():
         # RL flywheel probe
         "weight_swap_ms": round(weight_swap_ms, 3),
         "rollout_tok_s": round(rollout_tok_s, 1),
+        # telemetry plane
+        "ttft_ms_p50": round(s["ttft_ms_p50"], 3),
+        "ttft_ms_p99": round(s["ttft_ms_p99"], 3),
+        "retraces_unexpected": s["retraces_unexpected"],
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
     }))
 
 

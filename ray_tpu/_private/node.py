@@ -1248,7 +1248,14 @@ class NodeServer:
         if method == "summarize_tasks":
             return self.task_events.summary()
         if method == "timeline":
-            return self.task_events.chrome_trace()
+            # ONE merged chrome://tracing view: task events (cat="task")
+            # interleaved with the driver-side telemetry plane — per-
+            # request engine flight-recorder spans (cat="request") and
+            # application tracing spans (cat="span"). All three use
+            # epoch-µs timestamps, so they line up on the same axis.
+            from ray_tpu.util import telemetry as _telemetry
+            return (self.task_events.chrome_trace()
+                    + _telemetry.chrome_trace_events())
         if method == "list_actors":
             with self.lock:
                 return [{

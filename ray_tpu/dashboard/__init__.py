@@ -15,7 +15,11 @@ API those frontends consume — the part tooling depends on:
   GET /api/logs                     log sources (head + every node)
   GET /api/logs/<source>?lines=N    tail of one process's output
   GET /metrics                      Prometheus text exposition
-  GET /api/timeline                 chrome://tracing events
+  GET /api/timeline                 chrome://tracing events (task events
+                                    merged with engine request spans and
+                                    application tracing spans)
+  GET /api/telemetry                flight-recorder / retrace-sentinel /
+                                    tracing health summary
 
 Runs as a daemon thread in the driver process (the driver embeds the
 node, so handlers read NodeServer state through the same control verbs the
@@ -106,6 +110,9 @@ class _Handler(BaseHTTPRequestHandler):
                     200, type(self).control("dashboard_snapshot"))
             if path == "/api/timeline":
                 return self._send(200, type(self).control("timeline"))
+            if path == "/api/telemetry":
+                from ray_tpu.util import telemetry as _telemetry
+                return self._send(200, _telemetry.summary())
             if path == "/api/jobs":
                 return self._send(200, type(self).control("job_list"))
             if path == "/api/serve/applications":

@@ -140,6 +140,13 @@ class FlywheelLoop:
         self.state = TrainState(params, optimizer.init(params),
                                 jnp.zeros((), jnp.int32))
         self.loop = TrainLoop(self._step, publisher=self._publish)
+        # Flywheel-staleness series on /metrics: the telemetry bridge
+        # republishes stats() (last iteration's history record) at every
+        # scrape. Weakref registration — nothing pins this loop alive.
+        from ray_tpu.util import telemetry as _telemetry
+        self.name = _telemetry.next_name("flywheel")
+        _telemetry.register_stats_source(self.name, self,
+                                         kind="flywheel")
 
     # -- publish side ---------------------------------------------------
 
@@ -191,6 +198,24 @@ class FlywheelLoop:
             "behavior_logp": jnp.asarray(batch[sb.ACTION_LOGP]),
             "mask": jnp.asarray(batch[MASK]),
             "advantage": jnp.asarray(adv),
+        }
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> dict:
+        """Host-side flywheel health: iteration count plus the last
+        history record (reward, baseline, staleness, engine version,
+        rollout rate) — what the telemetry bridge tags as flywheel_*."""
+        last = self.history[-1] if self.history else {}
+        return {
+            "iterations": len(self.history),
+            "published_version": self.published_version,
+            "reward_mean": last.get("reward_mean", 0.0),
+            "baseline": last.get("baseline", 0.0),
+            "staleness": last.get("staleness", 0),
+            "engine_version": last.get(
+                "engine_version", self.engine.params_version),
+            "rollout_tok_s": last.get("rollout_tok_s", 0.0),
         }
 
     # -- drive ----------------------------------------------------------

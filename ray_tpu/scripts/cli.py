@@ -12,6 +12,7 @@ session's control socket (or --session DIR).
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import sys
 import time
@@ -86,8 +87,13 @@ def cmd_timeline(args):
     events = _attach(args).control("timeline")
     with open(args.output, "w") as f:
         json.dump(events, f)
-    print(f"wrote {len(events)} events to {args.output} "
-          "(open in chrome://tracing or ui.perfetto.dev)")
+    # The merged view carries task events, engine request spans, and
+    # application tracing spans — break the count down by category so a
+    # dump with zero request spans (telemetry sampled off?) is obvious.
+    cats = collections.Counter(e.get("cat", "?") for e in events)
+    by_cat = ", ".join(f"{k}={v}" for k, v in sorted(cats.items()))
+    print(f"wrote {len(events)} events ({by_cat or 'empty'}) to "
+          f"{args.output} (open in chrome://tracing or ui.perfetto.dev)")
 
 
 def cmd_stack(args):

@@ -443,7 +443,8 @@ class InferenceEngine:
                  ngram_max: int = 3, ngram_min: int = 1,
                  draft_params=None, draft_cfg=None,
                  draft_cache_blocks: int | None = None,
-                 mesh=None, seed: int = 0):
+                 mesh=None, seed: int = 0,
+                 telemetry_sample: float | None = None):
         import jax
         import jax.numpy as jnp
         from ray_tpu.models import gpt
@@ -713,6 +714,39 @@ class InferenceEngine:
 
         self._swap_fn = jax.jit(_swap, donate_argnums=(0,))
 
+        # --- flight recorder + retrace sentinel (util.telemetry) ------
+        # Per-request lifecycle tracing (sampled; telemetry_sample
+        # overrides RAY_TPU_TELEMETRY_SAMPLE) and the runtime watcher
+        # that enforces the compile-once contract the tests above pin.
+        # Shape-pinned paths carry hard caps from construction; the
+        # bucket-dependent prefill paths join on arm_retrace_sentinel().
+        from ray_tpu.util import telemetry as _telemetry
+        self.name = _telemetry.next_name("engine")
+        self._recorder = _telemetry.FlightRecorder(
+            self.name, sample=telemetry_sample)
+        self._sentinel = _telemetry.RetraceSentinel(self.name)
+        self._sentinel.watch("decode", lambda: self.decode_traces, cap=1)
+        self._sentinel.watch("swap", lambda: self.swap_traces,
+                             cap=2 if spec == "draft" else 1)
+        if spec is not None:
+            self._sentinel.watch("verify", lambda: self.verify_traces,
+                                 cap=1)
+        if spec == "draft":
+            self._sentinel.watch("draft", lambda: self.draft_traces,
+                                 cap=1)
+            self._sentinel.watch("draft_prefill",
+                                 lambda: self.draft_prefill_traces)
+        self._sentinel.watch("prefill", lambda: self.prefill_traces)
+        _telemetry.register_stats_source(self.name, self, kind="engine")
+
+    def arm_retrace_sentinel(self):
+        """Declare shape warmup over: every watched compile path —
+        including the bucket-dependent prefill ones — is baselined at
+        its current trace count, and ANY further trace increments
+        `retraces_unexpected` and WARNs. The hard-capped paths (decode,
+        verify, swap) are watched from construction regardless."""
+        self._sentinel.arm()
+
     # ------------------------------------------------------------------
     # request side
     # ------------------------------------------------------------------
@@ -757,6 +791,7 @@ class InferenceEngine:
             self._pending.append(_Pending(rid, prompt, max_new_tokens,
                                           temperature, eos_id,
                                           time.perf_counter()))
+            self._recorder.on_submit(rid, prompt.size)
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -780,6 +815,7 @@ class InferenceEngine:
             self._done.discard(rid)
             if hit:
                 self._cancelled += 1
+                self._recorder.on_finish(rid, "cancelled")
             return hit
 
     def tokens_for(self, rid: int):
@@ -983,6 +1019,7 @@ class InferenceEngine:
             s.draft_filled = 0
         self._prefix_hit_tokens += matched
         self._prompt_tokens += p
+        self._recorder.on_admit(req.rid, matched, partial)
         return True
 
     def _admit_pending(self) -> bool:
@@ -1036,7 +1073,9 @@ class InferenceEngine:
                 np.int32(clen), np.float32(s.temperature),
                 self._base_key, np.int32(self._decode_steps))
             tok = int(tok)    # device sync, so the timing is honest
-            self._prefill_time += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._prefill_time += dt
+            self._recorder.on_prefill_chunk(s.rid, clen, cap, dt)
             self._prefill_tokens += clen
             self._prefill_chunks += 1
             s.filled += clen
@@ -1081,7 +1120,9 @@ class InferenceEngine:
         s.phase = "decode"
         s.pos = s.prompt.size
         s.remaining -= 1
-        self._queue_waits.append(time.perf_counter() - s.submit_ts)
+        wait = time.perf_counter() - s.submit_ts
+        self._queue_waits.append(wait)
+        self._recorder.on_first_token(s.rid, wait)
         self._emit(s, slot_idx, s.token, s.token_logp, s.token_ver)
 
     def _prefill_tick(self, had_decoders: bool) -> bool:
@@ -1113,7 +1154,9 @@ class InferenceEngine:
             self._last_swap_ms = (time.perf_counter()
                                   - self._swap_pending_ts) * 1e3
             self._swap_pending_ts = None
+            self._recorder.on_swap_crossing(s.rid)
         self._out[s.rid].append(ev)
+        self._recorder.on_token(s.rid)
         if self.spec == "ngram":
             s.history.append(tok)
         hit_eos = s.eos_id is not None and tok == s.eos_id
@@ -1121,6 +1164,7 @@ class InferenceEngine:
         if s.remaining <= 0 or hit_eos or s.pos + 1 >= self.max_len:
             self._done.add(s.rid)
             self._release(slot_idx)
+            self._recorder.on_finish(s.rid, "finished")
 
     def step(self) -> bool:
         """One scheduler tick: admit pending requests into free slots,
@@ -1143,11 +1187,13 @@ class InferenceEngine:
             decoding = [i for i, s in enumerate(self._slots)
                         if s.phase == "decode"]
             if not decoding:   # idle, or every admission finished early
+                self._sentinel.check()
                 return admitted or chunked
             if self.spec is not None:
                 self._spec_tick(decoding)
             else:
                 self._decode_tick(decoding)
+            self._sentinel.check()
             return True
 
     def _dev(self, name: str, arr):
@@ -1398,6 +1444,16 @@ class InferenceEngine:
           ``queue_wait_ms_p50`` / ``queue_wait_ms_p99`` — submit to
           first token.
 
+        Telemetry (util.telemetry flight recorder + retrace sentinel):
+          ``ttft_ms_p50`` / ``ttft_ms_p99`` — time-to-first-token
+          percentiles, the canonical latency names over the same
+          submit-to-first-token window as queue_wait_ms_* (which stay
+          for the autoscaler contract).
+          ``retraces_unexpected`` — traces of pinned compile-once paths
+          beyond their allowance (NEVER reset; nonzero means a
+          compile-once guarantee broke at runtime — each violation also
+          logs one WARN).
+
         Speculative decoding:
           ``spec`` / ``spec_k`` — backend ('' when off) and window.
           ``spec_steps`` — verify ticks; ``acceptance_rate`` — accepted
@@ -1414,6 +1470,7 @@ class InferenceEngine:
           lands).
         """
         with self._lock:
+            self._sentinel.check()   # surface retraces since last tick
             times = sorted(self._step_times)
             occ = list(self._occupancy)
             util = list(self._block_util)
@@ -1469,6 +1526,10 @@ class InferenceEngine:
                 "decode_tok_s": (win_toks / win_t) if win_t > 0 else 0.0,
                 "queue_wait_ms_p50": wpct(50),
                 "queue_wait_ms_p99": wpct(99),
+                # telemetry
+                "ttft_ms_p50": wpct(50),
+                "ttft_ms_p99": wpct(99),
+                "retraces_unexpected": self._sentinel.retraces_unexpected,
                 # speculative decoding
                 "spec": self.spec or "",
                 "spec_k": self.spec_k if self.spec else 0,

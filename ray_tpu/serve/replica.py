@@ -69,6 +69,15 @@ class Replica:
             max_workers=max(1, int(
                 serialized_init.get("max_concurrent_queries", 8))),
             thread_name_prefix=f"replica-{self.deployment_name}")
+        # Telemetry bridge: this replica's stats() (its own counters
+        # merged over the user callable's — engine stats for
+        # InferenceReplica deployments) become replica_* series on
+        # /metrics, tagged by a per-replica source id. Worker-resident
+        # replicas reach the driver scrape via the metrics flusher.
+        from ray_tpu.util import telemetry as _telemetry
+        self._telemetry_name = _telemetry.register_stats_source(
+            _telemetry.next_name(f"replica:{self.deployment_name}#"),
+            self, kind="replica")
 
     def ready(self) -> bool:
         return True

@@ -64,6 +64,7 @@ from ray_tpu.parallel.sharding import (
     valid_spec_for,
 )
 from ray_tpu.train.checkpoint import CheckpointError, atomic_dir
+from ray_tpu.util import tracing as _tracing
 
 MANIFEST = "manifest.json"
 _SKELETON = "skeleton.pkl"
@@ -330,6 +331,10 @@ class AsyncCheckpointer:
         self.snapshots = 0      # device copies enqueued
         self.commits = 0        # checkpoints committed to disk
         self.stalls = 0         # times the in-flight bound back-pressured
+        # When tracing is on, the writer thread's spans should nest
+        # under whatever span was active when the checkpointer was
+        # built (threads don't inherit the submitter's span otherwise).
+        self._trace_ctx = _tracing.capture_context()
         self._writer = threading.Thread(target=self._writer_loop,
                                         daemon=True,
                                         name="ft-checkpoint-writer")
@@ -382,22 +387,25 @@ class AsyncCheckpointer:
     # -- writer thread ------------------------------------------------------
 
     def _writer_loop(self):
+        _tracing.attach_context(self._trace_ctx)
         while True:
             item = self._queue.get()
             try:
                 if item is None:
                     return
                 step, snap, specs = item
-                host = _device_get(snap)     # off the training thread
-                del snap
-                dest = write_checkpoint(self.root, step, host, specs)
-                self.commits += 1
-                if self.uri is not None:
-                    from ray_tpu.util import storage
-                    storage.upload_dir_committed(
-                        dest, storage.uri_join(
-                            self.uri, os.path.basename(dest)))
-                self._prune()
+                with _tracing.span("ft.checkpoint_commit",
+                                   {"step": step, "root": self.root}):
+                    host = _device_get(snap)  # off the training thread
+                    del snap
+                    dest = write_checkpoint(self.root, step, host, specs)
+                    self.commits += 1
+                    if self.uri is not None:
+                        from ray_tpu.util import storage
+                        storage.upload_dir_committed(
+                            dest, storage.uri_join(
+                                self.uri, os.path.basename(dest)))
+                    self._prune()
             except BaseException as e:       # surfaced on train thread
                 self._error = e
             finally:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import time
 from typing import Any, Callable, Iterable, Iterator
 
 import jax
@@ -36,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ray_tpu.parallel.sharding import logical_to_spec
+from ray_tpu.util import telemetry as _telemetry
 
 # Host-fetch seam: the ONLY place this module moves device values to the
 # host. Tests monkeypatch it to assert the no-per-step-sync property.
@@ -185,7 +187,8 @@ class MetricsRing:
 
 
 def fuse_steps(step_fn: Callable, unroll: int,
-               donate: bool = True) -> Callable:
+               donate: bool = True,
+               on_trace: Callable[[], None] | None = None) -> Callable:
     """One jitted dispatch running `unroll` chained steps via lax.scan.
 
     step_fn: (state, batch) -> (state, metrics); jitted is fine (the
@@ -193,12 +196,18 @@ def fuse_steps(step_fn: Callable, unroll: int,
     batch leaves stacked [unroll, ...] and returns metrics stacked the
     same way. State is donated across the dispatch, so param/opt
     buffers update in place exactly as in the single-step path.
+
+    on_trace (if given) is called once per python trace of the fused
+    dispatch — the compile-once counter seam the retrace sentinel
+    watches, same idiom as the engine's `decode_traces`.
     """
     unroll = int(unroll)
     if unroll < 1:
         raise ValueError(f"unroll must be >= 1, got {unroll}")
 
     def multi(state, stacked):
+        if on_trace is not None:
+            on_trace()
         return jax.lax.scan(step_fn, state, stacked)
 
     kwargs = {"donate_argnums": (0,)} if donate else {}
@@ -221,13 +230,37 @@ class TrainLoop:
     def __init__(self, step_fn: Callable, *, unroll: int = 1,
                  metrics_interval: int = 10, metrics_lag: int = 2,
                  donate: bool = True, checkpointer=None,
-                 publisher: Callable | None = None):
+                 publisher: Callable | None = None,
+                 flops_per_step: float | None = None):
         self.unroll = max(1, int(unroll))
         self.metrics_interval = metrics_interval
         self.metrics_lag = metrics_lag
+        # Compile-once accounting for the fused dispatch (engine idiom:
+        # the counter increments inside the traced fn, once per trace).
+        # For unroll=1 the dispatch is the caller's step_fn — its jit
+        # cache isn't ours to instrument, so the watch is unroll>1 only.
+        self.dispatch_traces = 0
+
+        def _count_trace():
+            self.dispatch_traces += 1
+
         self._dispatch = (step_fn if self.unroll == 1
-                          else fuse_steps(step_fn, self.unroll, donate))
+                          else fuse_steps(step_fn, self.unroll, donate,
+                                          on_trace=_count_trace))
         self.last_ring: MetricsRing | None = None
+        # Step-time breakdown of the last run (host-side perf_counter
+        # timers only — no device syncs beyond the ones already there),
+        # MFU/goodput derived from it, and the retrace sentinel.
+        self.last_breakdown: dict = {}
+        self.flops_per_step = flops_per_step
+        self.last_mfu = 0.0
+        self.last_goodput = 0.0
+        self.name = _telemetry.next_name("train")
+        self.sentinel = _telemetry.RetraceSentinel(self.name)
+        if self.unroll > 1:
+            self.sentinel.watch("dispatch",
+                                lambda: self.dispatch_traces, cap=1)
+        _telemetry.register_stats_source(self.name, self, kind="train")
         # Optional train/ft.AsyncCheckpointer (any object with
         # maybe_snapshot(state, step) + flush()). Mutable attribute so a
         # compiled loop can toggle checkpointing between runs without
@@ -260,9 +293,28 @@ class TrainLoop:
         self.last_ring = ring
         ckpt = self.checkpointer
         done = int(start_step)
-        for batch in device_batches:
+        # Host-side step-time breakdown: perf_counter around each host
+        # activity of the loop. These time where the HOST thread waits
+        # (the overlap design's whole point is keeping these small) and
+        # add no device syncs — the no-host-sync tests monkeypatch
+        # `_device_get` and still see only the ring's lagged fetches.
+        pc = time.perf_counter
+        prefetch_s = dispatch_s = metrics_s = 0.0
+        checkpoint_s = publish_s = 0.0
+        t_run = pc()
+        it = iter(device_batches)
+        while True:
+            t0 = pc()
+            try:
+                batch = next(it)
+            except StopIteration:
+                prefetch_s += pc() - t0
+                break
+            t1 = pc()
             state, metrics = self._dispatch(state, batch)
+            t2 = pc()
             ring.push(metrics, count=self.unroll)
+            t3 = pc()
             done += self.unroll
             # Snapshot/publish BEFORE the next dispatch donates these
             # buffers: both hooks device-copy what they keep, which is
@@ -270,13 +322,65 @@ class TrainLoop:
             # engine.update_params copies into its own buffers).
             if ckpt is not None:
                 ckpt.maybe_snapshot(state, done)
+            t4 = pc()
             if self.publisher is not None:
                 self.publisher(state, done)
+            t5 = pc()
+            prefetch_s += t1 - t0
+            dispatch_s += t2 - t1
+            metrics_s += t3 - t2
+            checkpoint_s += t4 - t3
+            publish_s += t5 - t4
+            if self.unroll > 1:
+                self.sentinel.check()
             if num_steps is not None and done >= num_steps:
                 break
         if ckpt is not None:
+            t0 = pc()
             ckpt.flush()
-        return state, ring.drain()
+            checkpoint_s += pc() - t0
+        t0 = pc()
+        out = ring.drain()
+        metrics_s += pc() - t0
+        total_s = pc() - t_run
+        steps_run = done - int(start_step)
+        denom = max(total_s, 1e-12)
+        self.last_breakdown = {
+            "steps": steps_run,
+            "total_s": total_s,
+            "prefetch_s": prefetch_s,
+            "dispatch_s": dispatch_s,
+            "metrics_s": metrics_s,
+            "checkpoint_s": checkpoint_s,
+            "publish_s": publish_s,
+            "prefetch_share": prefetch_s / denom,
+            "dispatch_share": dispatch_s / denom,
+            "metrics_share": metrics_s / denom,
+            "checkpoint_share": checkpoint_s / denom,
+            "publish_share": publish_s / denom,
+        }
+        # Host goodput: fraction of wall time the host spends inside
+        # device dispatch (i.e. not stalled on data, checkpoint or
+        # metrics plumbing). MFU needs the model's flop estimate.
+        self.last_goodput = dispatch_s / denom
+        if self.flops_per_step and steps_run:
+            self.last_mfu = _telemetry.mfu(
+                self.flops_per_step * steps_run / denom)
+        return state, out
+
+    def stats(self) -> dict:
+        """Telemetry-bridge stats dict (util.telemetry republishes these
+        as train_* gauges at every /metrics scrape): the last run's
+        step-time breakdown plus MFU/goodput and the fused-dispatch
+        compile-once accounting."""
+        return {
+            "dispatch_traces": self.dispatch_traces,
+            "retraces_unexpected": self.sentinel.retraces_unexpected,
+            "unroll": self.unroll,
+            "mfu": self.last_mfu,
+            "goodput": self.last_goodput,
+            **self.last_breakdown,
+        }
 
 
 def run_steps(step_fn: Callable, state, device_batches: Iterable,

@@ -11,9 +11,10 @@ dashboard's /metrics endpoint serves.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.constants import \
     METRICS_FLUSH_PERIOD_S as _FLUSH_PERIOD_S
@@ -58,7 +59,9 @@ class _Registry:
             while True:
                 time.sleep(_FLUSH_PERIOD_S)
                 try:
-                    client.control("push_metrics", (wid, self.snapshot()))
+                    # module-level snapshot(): runs collect hooks so a
+                    # worker-resident engine's gauges refresh per flush
+                    client.control("push_metrics", (wid, snapshot()))
                 except Exception:
                     return  # driver gone; session over
 
@@ -71,7 +74,7 @@ class _Registry:
         if client is not None and client.mode == "worker":
             try:
                 wid = getattr(client.rt, "worker_id", "worker")
-                client.control("push_metrics", (wid, self.snapshot()))
+                client.control("push_metrics", (wid, snapshot()))
             except Exception:
                 pass
 
@@ -171,8 +174,30 @@ class Histogram(Metric):
                                for k, v in self._series.items()}}
 
 
+# Pull-style collectors: hooks run at the top of every snapshot (scrape
+# or worker flush), BEFORE the registry lock is taken, so a hook may
+# freely create/register/set metrics. util.telemetry uses this to
+# refresh engine/train gauges from their stats() dicts at scrape time.
+_collect_hooks: list[Callable[[], None]] = []
+
+
+def add_collect_hook(fn: Callable[[], None]) -> None:
+    if fn not in _collect_hooks:
+        _collect_hooks.append(fn)
+
+
+def remove_collect_hook(fn: Callable[[], None]) -> None:
+    if fn in _collect_hooks:
+        _collect_hooks.remove(fn)
+
+
 def snapshot() -> list[dict]:
     """This process's metrics."""
+    for hook in list(_collect_hooks):
+        try:
+            hook()
+        except Exception:
+            pass   # a broken collector must not break the scrape
     return _registry.snapshot()
 
 
@@ -213,17 +238,53 @@ def _esc(value) -> str:
             .replace("\n", "\\n"))
 
 
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def sanitize_name(name: str, label: bool = False) -> str:
+    """Map an arbitrary string onto the Prometheus metric-name charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (labels additionally exclude ':').
+    Application code is free to name metrics 'engine0/ttft ms'; the
+    exposition must not emit that verbatim or the scrape is rejected."""
+    ok = _LABEL_OK if label else _NAME_OK
+    if name and ok.match(name):
+        return name
+    bad = r"[^a-zA-Z0-9_]" if label else r"[^a-zA-Z0-9_:]"
+    out = re.sub(bad, "_", name or "_")
+    if not re.match(r"[a-zA-Z_]" if label else r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def format_float(v) -> str:
+    """Canonical float formatting for `le` bucket labels and values —
+    Go strconv style ('0.001', '1.0', '+Inf'), round-trippable with
+    float(); never repr() (whose output for numpy scalars / ints is not
+    a Prometheus float)."""
+    v = float(v)
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return f"{int(v)}.0"
+    return repr(v)
+
+
 def _labels(pairs) -> str:
     if not pairs:
         return ""
-    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
+    return ("{" + ",".join(
+        f'{sanitize_name(str(k), label=True)}="{_esc(v)}"'
+        for k, v in pairs) + "}")
 
 
 def render_prometheus(metrics: list[dict]) -> str:
     """Prometheus text exposition of an aggregated snapshot."""
     lines = []
     for m in metrics:
-        name = "ray_tpu_" + m["name"]
+        name = sanitize_name("ray_tpu_" + m["name"])
         lines.append(f"# HELP {name} {_esc(m['description'])}")
         lines.append(f"# TYPE {name} {m['type']}")
         for key, val in m["series"].items():
@@ -234,7 +295,8 @@ def render_prometheus(metrics: list[dict]) -> str:
                 for i, b in enumerate(m["boundaries"]):
                     cum += buckets[i]
                     lines.append(
-                        f"{name}_bucket{_labels(key + ((('le'), repr(b)),))}"
+                        f"{name}_bucket"
+                        f"{_labels(key + (('le', format_float(b)),))}"
                         f" {cum}")
                 cum += buckets[-1]
                 lines.append(

@@ -1,0 +1,619 @@
+"""Hot-path flight recorder: one observability plane over the engine,
+trainer, and flywheel telemetry islands.
+
+The serving engine, training loop, and RL flywheel each keep rich
+private telemetry (`InferenceEngine.stats()`, `MetricsRing`,
+`weight_swap_ms`), none of which reached the plane the core ships — the
+`util.metrics` Prometheus registry, `util.tracing` spans, the
+dashboard's `/metrics` and `/api/timeline`. This module is the bridge,
+built from four pieces:
+
+  * `FlightRecorder` — per-request lifecycle tracing for an engine:
+    submit → queue wait → each prefill chunk (prefix-hit/COW annotated)
+    → decode → first token → finish/cancel/swap-crossing, recorded as
+    `util.tracing`-shaped span dicts in a bounded ring (evictions
+    counted, never silent). Sampled per request
+    (`RAY_TPU_TELEMETRY_SAMPLE`, default 1.0) and cheap enough to leave
+    on: the per-token hook is one dict lookup + an int increment, and an
+    unsampled request costs a single failed lookup per hook.
+    Distills TTFT / TPOT / queue-wait into `util.metrics` histograms.
+
+  * stats-dict metrics bridge — `register_stats_source(name, obj)`
+    holds a weakref to anything with a `stats() -> dict` (engines,
+    replicas, train loops, flywheels) and a collect hook
+    (`metrics.add_collect_hook`) republishes every numeric stat as a
+    Gauge — or, for the monotone keys in `COUNTER_KEYS`, a delta-tracked
+    Counter that treats a decrease as `reset_stats()` — tagged by
+    source, so the dashboard's `/metrics` serves engine / replica /
+    paged-cache / spec-decode / flywheel-staleness series to Prometheus
+    with no per-step push anywhere on the hot path.
+
+  * `RetraceSentinel` — runtime watcher over compile-once counters
+    (`decode_traces`, `verify_traces`, `swap_traces`, the fused train
+    dispatch). Pinned paths carry a hard cap from construction; bucket-
+    dependent paths (prefill) are baselined by `arm()` after warmup.
+    The moment any watched counter exceeds its allowance the sentinel
+    increments `retraces_unexpected` and emits ONE WARN per path — the
+    property the compile-once tests pin only at test time, enforced in
+    production.
+
+  * `chrome_trace_events()` / `summary()` / `check_invariants()` —
+    exports: recorder spans + `util.tracing` spans as chrome://tracing
+    events (the node's "timeline" verb merges them with task events into
+    one view), a JSON health summary for `/api/telemetry`, and the
+    self-test the shared test-session fixture runs at teardown.
+
+Everything here is driver/host-side: no device syncs, no jax import at
+module load.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import os
+import random
+import re
+import threading
+import time
+import uuid
+import weakref
+
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SAMPLE = float(os.environ.get("RAY_TPU_TELEMETRY_SAMPLE", "1.0"))
+DEFAULT_MAX_SPANS = int(os.environ.get("RAY_TPU_TELEMETRY_MAX_SPANS",
+                                       "4096"))
+# Per-request chunk-span bound: a pathological prompt chunked a thousand
+# times must not make one live trace unbounded.
+MAX_CHUNKS_PER_REQUEST = 256
+
+_lock = threading.Lock()
+_ids: dict[str, itertools.count] = {}
+_recorders: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_sentinels: "weakref.WeakSet[RetraceSentinel]" = weakref.WeakSet()
+
+
+def next_name(kind: str) -> str:
+    """Process-unique instance name per kind: engine0, engine1, train0…
+    Used to tag each source's metric series."""
+    with _lock:
+        counter = _ids.setdefault(kind, itertools.count())
+        return f"{kind}{next(counter)}"
+
+
+def _now_ns() -> int:
+    return time.time_ns()
+
+
+# ---------------------------------------------------------------------------
+# latency histograms (module-level, tagged by source)
+# ---------------------------------------------------------------------------
+
+_MS_BOUNDARIES = [0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000]
+_metric_cache: dict[tuple[type, str], "_metrics.Metric"] = {}
+
+
+def _metric(cls, name: str, desc: str = "", boundaries=None):
+    """Lazily create/reuse one tagged metric; returns None when the name
+    is already registered as a conflicting type (the scrape must not
+    break because two subsystems picked one name)."""
+    key = (cls, name)
+    with _lock:
+        m = _metric_cache.get(key)
+        if m is not None:
+            return m
+        try:
+            if cls is _metrics.Histogram:
+                m = cls(name, desc, boundaries=boundaries,
+                        tag_keys=("source",))
+            else:
+                m = cls(name, desc, tag_keys=("source",))
+        except (ValueError, TypeError):
+            return None
+        _metric_cache[key] = m
+        return m
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: per-request engine tracing
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Sampled per-request lifecycle tracer for one engine.
+
+    The engine calls the `on_*` hooks from inside its scheduler (under
+    its own lock, so no recorder state races); every hook for an
+    unsampled request is one dict miss. Spans use the `util.tracing`
+    dict shape (epoch-ns timestamps, so they interleave with task events
+    on the merged timeline) and land in a bounded ring on finish —
+    `dropped_spans` counts ring evictions so truncation is observable.
+    """
+
+    def __init__(self, name: str | None = None, *,
+                 sample: float | None = None,
+                 max_spans: int | None = None):
+        self.name = name or next_name("recorder")
+        self.sample = (DEFAULT_SAMPLE if sample is None
+                       else max(0.0, min(1.0, float(sample))))
+        self.max_spans = max(1, int(DEFAULT_MAX_SPANS if max_spans is None
+                                    else max_spans))
+        self._spans: collections.deque = collections.deque()
+        self._live: dict[int, dict] = {}
+        self._rng = random.Random(0x5EED ^ hash(self.name))
+        self.dropped_spans = 0
+        self.requests_seen = 0
+        self.requests_traced = 0
+        _recorders.add(self)
+
+    # -- engine hooks (hot path) --------------------------------------
+
+    def on_submit(self, rid: int, prompt_len: int) -> None:
+        self.requests_seen += 1
+        if self.sample <= 0.0 or (self.sample < 1.0
+                                  and self._rng.random() >= self.sample):
+            return
+        now = _now_ns()
+        trace_id = uuid.uuid4().hex
+        root = self._span("engine.request", trace_id, None, now,
+                          {"rid": rid, "engine": self.name,
+                           "prompt_len": int(prompt_len)})
+        queue = self._span("queue_wait", trace_id, root["span_id"], now,
+                           {"rid": rid})
+        self._live[rid] = {"root": root, "queue": queue, "extra": [],
+                           "first_ns": None, "tokens": 0}
+        self.requests_traced += 1
+
+    def on_admit(self, rid: int, prefix_hit_tokens: int,
+                 cow: bool) -> None:
+        tr = self._live.get(rid)
+        if tr is None:
+            return
+        now = _now_ns()
+        tr["queue"]["end_ns"] = now
+        tr["root"]["attributes"].update(
+            prefix_hit_tokens=int(prefix_hit_tokens), cow=bool(cow))
+        h = _metric(_metrics.Histogram, "engine_queue_wait_ms",
+                    "submit -> slot admission, ms",
+                    boundaries=_MS_BOUNDARIES)
+        if h is not None:
+            h.observe((now - tr["queue"]["start_ns"]) / 1e6,
+                      tags={"source": self.name})
+
+    def on_prefill_chunk(self, rid: int, tokens: int, bucket: int,
+                         dur_s: float) -> None:
+        tr = self._live.get(rid)
+        if tr is None or len(tr["extra"]) >= MAX_CHUNKS_PER_REQUEST:
+            return
+        end = _now_ns()
+        root = tr["root"]
+        s = self._span("prefill_chunk", root["trace_id"],
+                       root["span_id"], end - int(dur_s * 1e9),
+                       {"rid": rid, "tokens": int(tokens),
+                        "bucket": int(bucket)})
+        s["end_ns"] = end
+        tr["extra"].append(s)
+
+    def on_first_token(self, rid: int, wait_s: float) -> None:
+        tr = self._live.get(rid)
+        if tr is None:
+            return
+        tr["first_ns"] = _now_ns()
+        tr["extra"].append(self._instant(tr, "first_token", rid))
+        h = _metric(_metrics.Histogram, "engine_ttft_ms",
+                    "submit -> first token, ms",
+                    boundaries=_MS_BOUNDARIES)
+        if h is not None:
+            h.observe(wait_s * 1e3, tags={"source": self.name})
+
+    def on_token(self, rid: int) -> None:
+        tr = self._live.get(rid)
+        if tr is not None:
+            tr["tokens"] += 1
+
+    def on_swap_crossing(self, rid: int) -> None:
+        tr = self._live.get(rid)
+        if tr is not None:
+            tr["extra"].append(self._instant(tr, "swap_crossing", rid))
+
+    def on_finish(self, rid: int, outcome: str) -> None:
+        tr = self._live.pop(rid, None)
+        if tr is None:
+            return
+        now = _now_ns()
+        root, queue = tr["root"], tr["queue"]
+        if queue["end_ns"] is None:     # cancelled while still pending
+            queue["end_ns"] = now
+        root["end_ns"] = now
+        root["attributes"]["outcome"] = outcome
+        root["attributes"]["tokens"] = tr["tokens"]
+        spans = [root, queue] + tr["extra"]
+        first = tr["first_ns"]
+        if first is not None:
+            dec = self._span("decode", root["trace_id"],
+                             root["span_id"], first,
+                             {"rid": rid, "tokens": tr["tokens"]})
+            dec["end_ns"] = now
+            spans.append(dec)
+            if tr["tokens"] > 1:
+                h = _metric(_metrics.Histogram, "engine_tpot_ms",
+                            "inter-token latency after first token, ms",
+                            boundaries=_MS_BOUNDARIES)
+                if h is not None:
+                    h.observe((now - first) / 1e6 / (tr["tokens"] - 1),
+                              tags={"source": self.name})
+        for s in spans:
+            if len(self._spans) >= self.max_spans:
+                self._spans.popleft()
+                self.dropped_spans += 1
+            self._spans.append(s)
+
+    # -- internals ----------------------------------------------------
+
+    def _span(self, name, trace_id, parent, start_ns, attrs) -> dict:
+        return {"name": name, "trace_id": trace_id,
+                "span_id": uuid.uuid4().hex[:16],
+                "parent_span_id": parent, "start_ns": start_ns,
+                "end_ns": None, "attributes": attrs, "status": "OK",
+                "process": os.getpid()}
+
+    def _instant(self, tr, name, rid) -> dict:
+        now = _now_ns()
+        root = tr["root"]
+        s = self._span(name, root["trace_id"], root["span_id"], now,
+                       {"rid": rid})
+        s["end_ns"] = now
+        return s
+
+    # -- export -------------------------------------------------------
+
+    def get_spans(self) -> list[dict]:
+        return list(self._spans)
+
+    def live_requests(self) -> int:
+        return len(self._live)
+
+    def chrome_events(self) -> list[dict]:
+        """Recorder spans as chrome://tracing events, cat="request" so
+        they are distinguishable from task events (cat="task") and
+        application spans (cat="span") on the merged timeline. Instant
+        markers (first_token / swap_crossing) become "i" events."""
+        out = []
+        for s in self.get_spans():
+            rid = s["attributes"].get("rid", 0)
+            base = {"name": s["name"], "cat": "request",
+                    "pid": s["process"], "tid": f"{self.name}/r{rid}",
+                    "args": s["attributes"]}
+            end = s["end_ns"] or _now_ns()
+            if end == s["start_ns"]:
+                out.append({**base, "ph": "i", "ts": s["start_ns"] / 1e3,
+                            "s": "t"})
+            else:
+                out.append({**base, "ph": "X", "ts": s["start_ns"] / 1e3,
+                            "dur": (end - s["start_ns"]) / 1e3})
+        return out
+
+    def clear(self) -> None:
+        self._spans.clear()
+        self.dropped_spans = 0
+
+    def check_invariants(self) -> None:
+        assert len(self._spans) <= self.max_spans, \
+            f"{self.name}: span ring {len(self._spans)} > cap " \
+            f"{self.max_spans}"
+        assert 0.0 <= self.sample <= 1.0, self.sample
+        assert self.requests_traced <= self.requests_seen
+        for tr in self._live.values():
+            assert len(tr["extra"]) <= MAX_CHUNKS_PER_REQUEST + 8
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+class RetraceSentinel:
+    """Runtime watcher over compile-once trace counters.
+
+    Two watch flavors: a `cap` watch is armed from construction with a
+    hard allowance (decode must trace exactly once, ever — caps hold for
+    any workload, so the existing compile-once suites run fully watched
+    and report zero); a dynamic watch (cap=None) has no allowance until
+    `arm()` snapshots its current count as the baseline — the shape for
+    bucket-dependent paths like chunked prefill, where "warmed up" is
+    workload-defined. `check()` is a handful of int compares, cheap
+    enough for every scheduler tick; the first violation per path logs
+    ONE WARN and every excess trace increments `retraces_unexpected`.
+    """
+
+    def __init__(self, name: str | None = None):
+        self.name = name or next_name("sentinel")
+        self._watches: dict[str, dict] = {}
+        self.retraces_unexpected = 0
+        self.armed = False
+        self.events: collections.deque = collections.deque(maxlen=64)
+        _sentinels.add(self)
+
+    def watch(self, path: str, getter, cap: int | None = None) -> None:
+        self._watches[path] = {
+            "getter": getter,
+            "cap": None if cap is None else int(cap),
+            "limit": None if cap is None else int(cap),
+            "counted": 0, "warned": False}
+
+    def arm(self) -> None:
+        """Declare warmup over: baseline every dynamic watch at its
+        current count, so any further trace on it is unexpected. Cap
+        watches are unaffected (they were armed from construction)."""
+        self.armed = True
+        for w in self._watches.values():
+            if w["cap"] is None:
+                try:
+                    w["limit"] = int(w["getter"]())
+                except Exception:
+                    continue
+                w["counted"] = w["limit"]
+
+    def check(self) -> int:
+        """Compare every watched counter against its allowance; count
+        and WARN on new excess traces. Returns newly-counted excess."""
+        new = 0
+        for path, w in self._watches.items():
+            limit = w["limit"]
+            if limit is None:
+                continue
+            try:
+                cur = int(w["getter"]())
+            except Exception:
+                continue
+            base = max(limit, w["counted"])
+            if cur > base:
+                delta = cur - base
+                w["counted"] = cur
+                self.retraces_unexpected += delta
+                new += delta
+                self.events.append({
+                    "ts": time.time(), "sentinel": self.name,
+                    "path": path, "traces": cur, "allowed": limit})
+                if not w["warned"]:
+                    w["warned"] = True
+                    logger.warning(
+                        "retrace sentinel [%s]: pinned path %r "
+                        "re-traced at runtime (traces=%d, allowed=%d) — "
+                        "a compile-once guarantee broke; expect a "
+                        "latency spike and check for changing input "
+                        "shapes/dtypes", self.name, path, cur, limit)
+        if new:
+            c = _metric(_metrics.Counter, "retraces_unexpected",
+                        "traces of pinned compile-once paths beyond "
+                        "their allowance")
+            if c is not None:
+                c.inc(new, tags={"source": self.name})
+        return new
+
+    def watching(self) -> bool:
+        return any(w["limit"] is not None
+                   for w in self._watches.values())
+
+    def reset(self) -> None:
+        self.retraces_unexpected = 0
+        self.events.clear()
+        for w in self._watches.items():
+            pass
+        for w in self._watches.values():
+            w["counted"] = 0
+            w["warned"] = False
+            if w["cap"] is None:
+                w["limit"] = None
+        self.armed = False
+
+
+# ---------------------------------------------------------------------------
+# stats-dict -> metrics bridge
+# ---------------------------------------------------------------------------
+
+# Monotone-while-not-reset stats keys published as Counters with delta
+# tracking (a decrease means reset_stats(); the post-reset count re-adds
+# from zero). Everything else numeric is a Gauge.
+COUNTER_KEYS = frozenset({
+    "decode_steps", "prefill_tokens", "decode_tokens", "prefill_chunks",
+    "prefix_hit_tokens", "cow_copies", "evicted_blocks", "cancelled",
+    "swaps", "spec_steps", "total", "snapshots", "commits", "stalls",
+    "fetches", "iterations",
+})
+
+_sources: dict[str, tuple] = {}          # name -> (weakref, kind)
+_last_counts: dict[tuple[str, str], float] = {}
+_hook_installed = False
+
+
+def register_stats_source(name: str, obj, kind: str = "engine") -> str:
+    """Publish `obj.stats()` into the metrics registry at every scrape/
+    flush, as `<kind>_<key>` series tagged source=<name>. Holds only a
+    weakref — a garbage-collected source silently drops out (its gauges
+    keep their last value for the session). Returns the (possibly
+    uniquified) registered name."""
+    global _hook_installed
+    with _lock:
+        final = name
+        i = 2
+        while final in _sources and _sources[final][0]() is not None \
+                and _sources[final][0]() is not obj:
+            final = f"{name}-{i}"
+            i += 1
+        _sources[final] = (weakref.ref(obj), kind)
+        if not _hook_installed:
+            _metrics.add_collect_hook(_collect)
+            _hook_installed = True
+    return final
+
+
+def unregister_stats_source(name: str) -> None:
+    with _lock:
+        _sources.pop(name, None)
+        for key in [k for k in _last_counts if k[0] == name]:
+            del _last_counts[key]
+
+
+def _collect() -> None:
+    """The metrics collect hook: refresh every live source's series.
+    Runs BEFORE the registry lock (metrics.snapshot contract), so it may
+    freely create metrics; a broken source never breaks the scrape."""
+    with _lock:
+        items = list(_sources.items())
+    dead = []
+    for name, (ref, kind) in items:
+        obj = ref()
+        if obj is None:
+            dead.append(name)
+            continue
+        try:
+            stats = obj.stats()
+        except Exception:
+            continue
+        if isinstance(stats, dict):
+            _publish_stats(kind, name, stats)
+    for name in dead:
+        unregister_stats_source(name)
+
+
+def _publish_stats(kind: str, name: str, stats: dict) -> None:
+    for key, val in stats.items():
+        if isinstance(val, bool) or isinstance(val, str):
+            continue
+        try:
+            num = float(val)
+        except (TypeError, ValueError):
+            continue
+        mname = f"{kind}_{key}"
+        if key in COUNTER_KEYS:
+            c = _metric(_metrics.Counter, mname)
+            if c is None:
+                continue
+            ckey = (name, mname)
+            last = _last_counts.get(ckey, 0.0)
+            if num < last:          # stats reset upstream
+                last = 0.0
+            if num > last:
+                c.inc(num - last, tags={"source": name})
+            _last_counts[ckey] = num
+        else:
+            g = _metric(_metrics.Gauge, mname)
+            if g is not None:
+                g.set(num, tags={"source": name})
+
+
+# ---------------------------------------------------------------------------
+# MFU helpers
+# ---------------------------------------------------------------------------
+
+# bf16 peak FLOPs per chip by jax device_kind substring (the table
+# bench.py established; first match wins).
+PEAK_FLOPS = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),   # v5 litepod
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak bf16 FLOPs/s of `device` (default: jax.devices()[0])."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS:
+        if key in kind:
+            return val
+    return 197e12
+
+
+def mfu(flops_per_sec: float, n_devices: int | None = None,
+        device=None) -> float:
+    """Model FLOPs utilization: achieved model FLOPs/s over the
+    devices' aggregate peak."""
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+    return flops_per_sec / (device_peak_flops(device)
+                            * max(1, int(n_devices)))
+
+
+# ---------------------------------------------------------------------------
+# exports / self-test
+# ---------------------------------------------------------------------------
+
+def chrome_trace_events() -> list[dict]:
+    """This process's recorder spans + application tracing spans as
+    chrome://tracing events. The node's "timeline" control verb merges
+    these with the task-event trace, so `GET /api/timeline` and
+    `ray_tpu timeline` serve one combined view (cat = task | request |
+    span)."""
+    out = []
+    for rec in list(_recorders):
+        out.extend(rec.chrome_events())
+    out.extend(_tracing.spans_to_chrome_trace())
+    return out
+
+
+def summary() -> dict:
+    """JSON health summary for `/api/telemetry`."""
+    return {
+        "recorders": [{
+            "name": r.name, "sample": r.sample,
+            "requests_seen": r.requests_seen,
+            "requests_traced": r.requests_traced,
+            "live_requests": r.live_requests(),
+            "spans": len(r.get_spans()),
+            "dropped_spans": r.dropped_spans,
+        } for r in list(_recorders)],
+        "sentinels": [{
+            "name": s.name, "armed": s.armed,
+            "watching": s.watching(),
+            "retraces_unexpected": s.retraces_unexpected,
+            "events": list(s.events),
+        } for s in list(_sentinels)],
+        "tracing": {
+            "enabled": _tracing.tracing_enabled(),
+            "spans": len(_tracing.get_spans()),
+            "max_spans": _tracing.max_spans(),
+            "dropped_spans": _tracing.dropped_spans(),
+        },
+        "stats_sources": sorted(_sources.keys()),
+    }
+
+
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(?:\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (\S+)$')
+
+
+def check_invariants() -> None:
+    """Telemetry-plane self-test (tests/conftest.py runs it at session
+    teardown, mirroring the engine's check_invariants pattern): every
+    rendered metric sample parses under the Prometheus exposition
+    grammar, the tracing and recorder rings honor their bounds, and
+    every sentinel still watches its pinned paths."""
+    text = _metrics.render_prometheus(_metrics.snapshot())
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        float(m.group(1))           # value must be a number
+    assert len(_tracing.get_spans()) <= _tracing.max_spans(), \
+        "tracing span ring exceeded its cap"
+    for rec in list(_recorders):
+        rec.check_invariants()
+    for s in list(_sentinels):
+        assert s.watching() or not s._watches, \
+            f"sentinel {s.name} has watches but none armed"

@@ -27,6 +27,42 @@ _lock = threading.Lock()
 _spans: List[dict] = []
 _current = threading.local()
 
+# Retention: the span list is a ring — a long-running engine must not
+# grow driver memory without bound. Overflow evictions are counted so a
+# truncated export is observable, never silent.
+DEFAULT_MAX_SPANS = 10_000
+_max_spans = int(os.environ.get("RAY_TPU_TRACING_MAX_SPANS",
+                                DEFAULT_MAX_SPANS))
+_dropped = 0
+
+
+def set_max_spans(cap: int) -> None:
+    """Configure the span ring's capacity (evicting oldest if needed)."""
+    global _max_spans, _dropped
+    with _lock:
+        _max_spans = max(1, int(cap))
+        while len(_spans) > _max_spans:
+            _spans.pop(0)
+            _dropped += 1
+
+
+def max_spans() -> int:
+    return _max_spans
+
+
+def dropped_spans() -> int:
+    """Spans evicted from the ring since process start (or clear)."""
+    return _dropped
+
+
+def _record(s: dict) -> None:
+    global _dropped
+    with _lock:
+        _spans.append(s)
+        while len(_spans) > _max_spans:
+            _spans.pop(0)
+            _dropped += 1
+
 
 def enable_tracing() -> None:
     """Turn span recording on in this process (workers inherit via the
@@ -72,8 +108,28 @@ def span(name: str, attributes: Optional[Dict] = None):
     finally:
         s["end_ns"] = time.time_ns()
         _current.span = parent
-        with _lock:
-            _spans.append(s)
+        _record(s)
+
+
+def capture_context() -> Optional[dict]:
+    """The calling thread's active span, for handing to another thread
+    (`_current` is a threading.local — a worker thread spawned by a
+    request does NOT inherit the submitter's span without this)."""
+    return getattr(_current, "span", None)
+
+
+def attach_context(ctx: Optional[dict]):
+    """Make `ctx` (from `capture_context()` on the submitting thread)
+    the calling thread's active span, so spans this thread opens nest
+    under the submitter's. Returns a token for `detach_context`."""
+    prev = getattr(_current, "span", None)
+    _current.span = ctx
+    return prev
+
+
+def detach_context(token) -> None:
+    """Restore the context that was active before `attach_context`."""
+    _current.span = token
 
 
 def get_spans() -> List[dict]:
@@ -82,8 +138,10 @@ def get_spans() -> List[dict]:
 
 
 def clear_spans() -> None:
+    global _dropped
     with _lock:
         _spans.clear()
+        _dropped = 0
 
 
 def export_json(path: str) -> int:

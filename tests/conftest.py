@@ -40,4 +40,10 @@ def ray_session():
     # reference's Cluster.add_node(num_gpus=8) on a laptop, SURVEY.md §4).
     ray_tpu.init(num_cpus=4, num_tpus=2, ignore_reinit_error=True)
     yield ray_tpu
+    # Telemetry-plane self-test before teardown: the whole session's
+    # metric registry must still render parseable Prometheus, every
+    # span ring must honor its bound, and every retrace sentinel must
+    # still be watching its pinned paths.
+    from ray_tpu.util import telemetry
+    telemetry.check_invariants()
     ray_tpu.shutdown()

@@ -16,6 +16,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     monkeypatch.setenv("RAY_TPU_INFER_BENCH_REQUESTS", "3")  # CI fast
     monkeypatch.setenv("RAY_TPU_INFER_BENCH_NEW", "3")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_TRACE_OVERHEAD", "1")
     import bench_infer
 
     bench_infer.main()
@@ -48,6 +49,16 @@ def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     assert np.isfinite(rec["weight_swap_ms"]) and rec["weight_swap_ms"] > 0
     assert rec["weight_swap_ms"] < 1000.0     # warm swap, not a compile
     assert rec["rollout_tok_s"] > 0.0
+    # telemetry fields: TTFT percentiles over the timed region, a clean
+    # retrace sentinel, and the flight-recorder overhead probe. The
+    # target is <1% sampled-on vs sampled-off; XLA:CPU smoke wall times
+    # are dominated by scheduler noise, so only a loose bound is
+    # assertable here — the headline overhead number belongs on silicon.
+    assert np.isfinite(rec["ttft_ms_p50"]) and rec["ttft_ms_p50"] > 0
+    assert rec["ttft_ms_p50"] <= rec["ttft_ms_p99"]
+    assert rec["retraces_unexpected"] == 0
+    assert np.isfinite(rec["trace_overhead_pct"])
+    assert abs(rec["trace_overhead_pct"]) < 50.0
 
 
 def test_bench_infer_spec_ngram_smoke(capsys, monkeypatch):

@@ -27,3 +27,14 @@ def test_bench_cpu_smoke(capsys, monkeypatch):
     assert rec["vs_baseline"] == 0.0        # CPU mode reports no MFU ratio
     # fault-tolerance cost is part of the published contract
     assert np.isfinite(rec["checkpoint_overhead_pct"])
+    # telemetry fields: MFU (meaningless on CPU but present and finite),
+    # the host step-time breakdown shares, and a clean retrace sentinel
+    # on the fused dispatch's compile-once pin.
+    assert np.isfinite(rec["mfu"]) and rec["mfu"] >= 0
+    bd = rec["step_breakdown"]
+    for key in ("prefetch", "dispatch", "metrics", "checkpoint",
+                "publish"):
+        assert 0.0 <= bd[key] <= 1.0, (key, bd)
+    assert sum(bd.values()) <= 1.001, bd
+    assert bd["dispatch"] > 0.0, bd
+    assert rec["retraces_unexpected"] == 0
