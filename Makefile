@@ -1,0 +1,15 @@
+PY ?= python
+
+.PHONY: lint test test-fast
+
+# Static invariant checks (R001-R005): exits non-zero on any
+# non-waived finding. tests/test_graftlint.py::test_repo_is_clean runs
+# the same sweep in tier-1, so CI cannot drift from this target.
+lint:
+	$(PY) -m ray_tpu.tools.graftlint ray_tpu/
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -161,6 +161,7 @@ class FlywheelLoop:
                 continue
             if hasattr(up, "remote"):   # serve DeploymentHandle sugar
                 if host is None:
+                    # graftlint: disable-next-line=R001 host copy made only for remote serve-handle targets, between dispatches (the publisher runs at the donation-safety point, never inside a step)
                     host = self._jax.tree.map(np.asarray, state.params)
                 up.remote(host)
             else:

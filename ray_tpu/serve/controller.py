@@ -126,14 +126,20 @@ class ServeController:
 
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
+        # Snapshot-and-clear under the lock, kill outside it:
+        # _kill_replicas blocks up to the prepare_shutdown timeout per
+        # batch, and status()/route_table() RPCs must not stall behind
+        # the teardown (graftlint R004 pins this).
+        doomed: list[list] = []
         with self._lock:
             for st in self._deployments.values():
-                self._kill_replicas(st.replicas)
+                doomed.append(st.replicas)
                 st.replicas = []
             self._deployments.clear()
-            for replicas in self._graveyard:
-                self._kill_replicas(replicas)
+            doomed.extend(self._graveyard)
             self._graveyard.clear()
+        for replicas in doomed:
+            self._kill_replicas(replicas)
         return True
 
     def ping(self) -> bool:

@@ -670,6 +670,9 @@ class InferenceEngine:
         self._out: dict[int, collections.deque] = {}
         self._done: set[int] = set()
         self._lock = threading.RLock()
+        # Serializes weight hot-swaps; exists so the blocking
+        # host->device upload in _place_tree happens OUTSIDE _lock.
+        self._swap_mutex = threading.Lock()
         self._decode_steps = 0
         self._step_times = collections.deque(maxlen=512)
         self._occupancy = collections.deque(maxlen=512)
@@ -725,18 +728,22 @@ class InferenceEngine:
         self._recorder = _telemetry.FlightRecorder(
             self.name, sample=telemetry_sample)
         self._sentinel = _telemetry.RetraceSentinel(self.name)
-        self._sentinel.watch("decode", lambda: self.decode_traces, cap=1)
+        self._sentinel.watch("decode", lambda: self.decode_traces, cap=1,
+                             registered=True)
         self._sentinel.watch("swap", lambda: self.swap_traces,
-                             cap=2 if spec == "draft" else 1)
+                             cap=2 if spec == "draft" else 1,
+                             registered=True)
         if spec is not None:
             self._sentinel.watch("verify", lambda: self.verify_traces,
-                                 cap=1)
+                                 cap=1, registered=True)
         if spec == "draft":
             self._sentinel.watch("draft", lambda: self.draft_traces,
-                                 cap=1)
+                                 cap=1, registered=True)
             self._sentinel.watch("draft_prefill",
-                                 lambda: self.draft_prefill_traces)
-        self._sentinel.watch("prefill", lambda: self.prefill_traces)
+                                 lambda: self.draft_prefill_traces,
+                                 registered=True)
+        self._sentinel.watch("prefill", lambda: self.prefill_traces,
+                             registered=True)
         _telemetry.register_stats_source(self.name, self, kind="engine")
 
     def arm_retrace_sentinel(self):
@@ -858,10 +865,11 @@ class InferenceEngine:
     # weight hot-swap (RL flywheel)
     # ------------------------------------------------------------------
 
-    def _swap_tree(self, old, new, what: str):
-        """Validate leaf-for-leaf compatibility, place `new` on the old
-        leaves' shardings, and copy it into the old buffers (donated).
-        Returns the swapped pytree (living in the OLD device memory)."""
+    def _place_tree(self, old, new, what: str):
+        """Validate leaf-for-leaf compatibility and place `new` on the
+        old leaves' shardings. Pure host+transfer work against a
+        *snapshot* of the old tree — runs under the swap mutex only,
+        never the scheduler lock, so ticks proceed during the upload."""
         jax = self._jax
         old_leaves, old_def = jax.tree.flatten(old)
         new_leaves, new_def = jax.tree.flatten(new)
@@ -875,11 +883,10 @@ class InferenceEngine:
                     f"update_params: {what} leaf mismatch "
                     f"{n.shape}/{n.dtype} != {o.shape}/{o.dtype} — "
                     f"hot-swap requires identical shapes and dtypes")
-        placed = jax.tree.unflatten(old_def, [
+        return jax.tree.unflatten(old_def, [
             jax.device_put(n, o.sharding) if hasattr(o, "sharding")
             else jax.numpy.asarray(n)
             for o, n in zip(old_leaves, new_leaves)])
-        return self._swap_fn(old, placed)
 
     def update_params(self, new_params, *, draft_params=None) -> int:
         """Hot-swap model weights into the live engine between ticks.
@@ -912,23 +919,36 @@ class InferenceEngine:
           (update_params call to first post-swap token).
 
         Returns the new `params_version`."""
-        with self._lock:
+        # Swappers serialize on the swap mutex; the scheduler lock is
+        # held only for the two brief sections that touch engine state
+        # (snapshot, commit). Validation and the host->device upload of
+        # the new tree — the slow part — happen between them, so decode
+        # ticks keep running while weights stream in (R004: the swap
+        # mutex is declared blocking_ok for exactly this).
+        with self._swap_mutex:
             t0 = time.perf_counter()
-            self.params = self._swap_tree(self.params, new_params,
-                                          "params")
-            if draft_params is not None:
-                if self.draft_params is None:
-                    raise ValueError(
-                        "update_params: draft_params given but the "
-                        "engine has no draft model")
-                self.draft_params = self._swap_tree(
-                    self.draft_params, draft_params, "draft_params")
-            if self._tree is not None:
-                self._tree.flush()
-            self._params_version += 1
-            self._swaps += 1
-            self._swap_pending_ts = t0
-            return self._params_version
+            with self._lock:
+                old = self.params
+                old_draft = self.draft_params
+            if draft_params is not None and old_draft is None:
+                raise ValueError(
+                    "update_params: draft_params given but the "
+                    "engine has no draft model")
+            placed = self._place_tree(old, new_params, "params")
+            placed_draft = (
+                self._place_tree(old_draft, draft_params, "draft_params")
+                if draft_params is not None else None)
+            with self._lock:
+                self.params = self._swap_fn(old, placed)
+                if placed_draft is not None:
+                    self.draft_params = self._swap_fn(
+                        old_draft, placed_draft)
+                if self._tree is not None:
+                    self._tree.flush()
+                self._params_version += 1
+                self._swaps += 1
+                self._swap_pending_ts = t0
+                return self._params_version
 
     @property
     def params_version(self) -> int:
@@ -1072,6 +1092,7 @@ class InferenceEngine:
                 jnp.asarray(s.table), np.int32(s.filled),
                 np.int32(clen), np.float32(s.temperature),
                 self._base_key, np.int32(self._decode_steps))
+            # graftlint: disable-next-line=R001,R004 the chunk's one deliberate sync: the first token must reach the host to park on the slot, and syncing here keeps the prefill timing honest
             tok = int(tok)    # device sync, so the timing is honest
             dt = time.perf_counter() - t0
             self._prefill_time += dt
@@ -1084,6 +1105,7 @@ class InferenceEngine:
                 # compute-time version) until the draft cache (if any)
                 # catches up and the slot joins decode.
                 s.token = tok
+                # graftlint: disable-next-line=R001,R004 lp is already on host after the int(tok) sync above; float() here is a cast, not a new device round-trip
                 s.token_logp = float(lp)
                 s.token_ver = self._params_version
         # Draft-model backend: the draft pool has no prefix sharing, so
@@ -1201,6 +1223,7 @@ class InferenceEngine:
         shardings when the engine runs on a mesh."""
         if self._io_sh is None:
             return self._jax.numpy.asarray(arr)
+        # graftlint: disable-next-line=R004 µs-scale host->device placement of tiny per-tick inputs; placing outside the lock would race slot state, and the transfer is async (no sync back)
         return self._jax.device_put(arr, self._io_sh[name])
 
     def _batch_arrays(self):
@@ -1230,7 +1253,9 @@ class InferenceEngine:
             self._dev("pos", pos), self._dev("tables", tables),
             self._dev("temps", temps), self._base_key,
             np.int32(self._decode_steps))
+        # graftlint: disable-next-line=R001,R004 the decode tick IS the scheduler's unit of work: it must sync on the sampled tokens to route them, and the lock is held for exactly one tick by design
         nxt = np.asarray(nxt)    # device sync
+        # graftlint: disable-next-line=R001,R004 same sync as nxt above — lps arrives in the same device batch, so this is a no-cost host view
         lps = np.asarray(lps)
         dt = time.perf_counter() - t0
         self._step_times.append(dt)
@@ -1300,6 +1325,7 @@ class InferenceEngine:
                 self._dev("tokens", tokens), self._dev("pos", pos),
                 self._dev("tables", dtables), self._dev("temps", temps),
                 self._base_key, np.int32(self._decode_steps))
+            # graftlint: disable-next-line=R001,R004 draft proposals must reach the host to build the verify window; one sync per spec tick, same budget as the plain decode tick's
             drafts = np.asarray(dj)
             for i in worth:
                 proposals[i] = drafts[i].tolist()
@@ -1309,7 +1335,9 @@ class InferenceEngine:
             self._dev("pos", pos), self._dev("tables", tables),
             self._dev("temps", temps), self._base_key,
             np.int32(self._decode_steps))
+        # graftlint: disable-next-line=R001,R004 the spec tick's one deliberate sync: accepted tokens must reach the host to emit; replaces W plain-tick syncs
         out, acc = np.asarray(out), np.asarray(acc)   # device sync
+        # graftlint: disable-next-line=R001,R004 same device batch as out/acc above — already materialized, no extra round-trip
         out_lp = np.asarray(out_lp)
         dt = time.perf_counter() - t0
         self._step_times.append(dt)

@@ -1,0 +1,18 @@
+"""graftlint: AST-based invariant checker for ray_tpu.
+
+Rules (see RULES.md for the full reference):
+
+- R001 host-sync-in-hot-path
+- R002 use-after-donate
+- R003 retrace hazards / compile-once inventory
+- R004 lock discipline (blocking under lock + lock-order graph)
+- R005 stats() docstring/dict contract
+
+Run with ``python -m ray_tpu.tools.graftlint <paths>``.
+"""
+
+from ray_tpu.tools.graftlint.core import (  # noqa: F401
+    Finding,
+    lint_file,
+    lint_paths,
+)

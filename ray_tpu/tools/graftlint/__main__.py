@@ -1,0 +1,57 @@
+"""CLI: python -m ray_tpu.tools.graftlint <paths> [--json] [...]"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ray_tpu.tools.graftlint import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based invariant checker for ray_tpu "
+                    "(see ray_tpu/tools/graftlint/RULES.md)")
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to lint")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--disable", default=None,
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--show-waived", action="store_true",
+                        help="include waived findings in text output")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+
+    select = set(args.select.split(",")) if args.select else None
+    disable = set(args.disable.split(",")) if args.disable else None
+    from ray_tpu.tools.graftlint.rules import ALL_RULES
+    for rid in (select or set()) | (disable or set()):
+        if rid not in ALL_RULES:
+            print(f"graftlint: unknown rule {rid!r}", file=sys.stderr)
+            return 2
+
+    try:
+        findings, nfiles = core.lint_paths(args.paths, select=select,
+                                           disable=disable)
+    except FileNotFoundError as exc:
+        print(f"graftlint: no such path: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(core.to_json(findings, nfiles), indent=2))
+    else:
+        print(core.format_text(findings, nfiles,
+                               show_waived=args.show_waived))
+    return 1 if any(not f.waived for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,286 @@
+"""Shared AST analyses: parents, qualnames, dotted names, and the
+per-file jax.jit registry."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+def add_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def call_name_loose(call: ast.Call) -> str | None:
+    """Like call_name, but when the receiver chain is unresolvable
+    (subscripts, chained calls) still yields '?.<attr>' so method-tail
+    checks like `.item()` / `.result()` see through `x.mean().item()`
+    and `futs[0].result()`."""
+    name = dotted_name(call.func)
+    if name is None and isinstance(call.func, ast.Attribute):
+        return "?." + call.func.attr
+    return name
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """Matches jax.jit / self._jax.jit / jit(...) call expressions."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    return parts[-1] == "jit" and (len(parts) == 1 or "jax" in parts[-2]
+                                   or "jax" in parts[0])
+
+
+FuncDef = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def qualnames(tree: ast.AST) -> dict[FuncDef, str]:
+    """Map each function def to its dotted qualname (Class.method)."""
+    out: dict[FuncDef, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out[child] = qn
+                visit(child, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def class_methods(tree: ast.AST) -> dict[str, dict[str, FuncDef]]:
+    """class name -> {method name -> def} (top-level classes only)."""
+    out: dict[str, dict[str, FuncDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            meths = {}
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    meths[item.name] = item
+            out[node.name] = meths
+    return out
+
+
+@dataclass
+class JitInfo:
+    """One jax.jit site: where it was created and what it wraps."""
+
+    anchor: str                      # assign target / factory qualname
+    call: ast.Call                   # the jax.jit(...) call
+    func_node: ast.AST | None = None # resolved wrapped fn (def or Lambda)
+    donate: tuple[int, ...] = ()
+    donate_unknown: bool = False     # **kwargs or non-literal donation
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    kind: str = "assign"             # assign | return | decorator
+    lineno: int = 0
+    enclosing: FuncDef | None = None
+
+
+def _int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                vals.append(elt.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> tuple[str, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _jit_kwargs(info: JitInfo) -> None:
+    for kw in info.call.keywords:
+        if kw.arg is None:
+            info.donate_unknown = True        # jax.jit(f, **kwargs)
+        elif kw.arg == "donate_argnums":
+            t = _int_tuple(kw.value)
+            if t is None:
+                info.donate_unknown = True
+            else:
+                info.donate = t
+        elif kw.arg == "static_argnums":
+            info.static_argnums = _int_tuple(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            info.static_argnames = _str_tuple(kw.value) or ()
+
+
+def _resolve_func(call: ast.Call, tree: ast.AST) -> ast.AST | None:
+    """Resolve jax.jit's first positional arg to a def/Lambda in-file."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Name):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == arg.id:
+                return node
+    return None   # attribute refs (self._method) are unresolvable
+
+
+def _jit_in_value(value: ast.AST) -> ast.Call | None:
+    """The jax.jit call inside an assign value, looking through one
+    level of IfExp (e.g. `jax.jit(f) if cond else None`). Returns None
+    for immediately-invoked jits like `jax.jit(f)(x)`."""
+    cands = [value]
+    if isinstance(value, ast.IfExp):
+        cands = [value.body, value.orelse]
+    for cand in cands:
+        if is_jit_call(cand):
+            return cand
+    return None
+
+
+@dataclass
+class JitIndex:
+    by_anchor: dict[str, JitInfo] = field(default_factory=dict)
+    all: list[JitInfo] = field(default_factory=list)
+
+    def jitted_bodies(self):
+        """(info, params, body_stmts) for every resolvable wrapped fn."""
+        for info in self.all:
+            fn = info.func_node
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield info, fn.args, fn.body
+            elif isinstance(fn, ast.Lambda):
+                yield info, fn.args, [ast.Expr(value=fn.body)]
+
+
+def build_jit_index(tree: ast.AST,
+                    qn: dict[FuncDef, str] | None = None) -> JitIndex:
+    """Find every jax.jit site: assignments (incl. through IfExp),
+    `return jax.jit(...)` factories (anchored at the enclosing function
+    name), and @jax.jit / @partial(jax.jit, ...) decorators."""
+    qn = qn if qn is not None else qualnames(tree)
+    index = JitIndex()
+
+    def enclosing_func(node: ast.AST) -> FuncDef | None:
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "parent", None)
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            call = _jit_in_value(node.value)
+            if call is None:
+                continue
+            for target in node.targets:
+                anchor = dotted_name(target)
+                if anchor is None:
+                    continue
+                info = JitInfo(anchor=anchor, call=call,
+                               lineno=node.lineno,
+                               enclosing=enclosing_func(node))
+                info.func_node = _resolve_func(call, tree)
+                _jit_kwargs(info)
+                index.by_anchor[anchor] = info
+                index.all.append(info)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            call = _jit_in_value(node.value)
+            if call is None:
+                continue
+            fn = enclosing_func(node)
+            anchor = fn.name if fn is not None else "<module>"
+            info = JitInfo(anchor=anchor, call=call, kind="return",
+                           lineno=node.lineno, enclosing=fn)
+            info.func_node = _resolve_func(call, tree)
+            _jit_kwargs(info)
+            index.by_anchor.setdefault(anchor, info)
+            index.all.append(info)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                is_bare = dotted_name(deco) is not None and \
+                    dotted_name(deco).split(".")[-1] == "jit"
+                is_partial = (isinstance(deco, ast.Call)
+                              and call_name(deco) is not None
+                              and call_name(deco).split(".")[-1] == "partial"
+                              and deco.args and is_jit_call_name(deco.args[0]))
+                if is_bare or is_partial:
+                    info = JitInfo(anchor=qn.get(node, node.name),
+                                   call=deco if isinstance(deco, ast.Call)
+                                   else ast.Call(func=deco, args=[],
+                                                 keywords=[]),
+                                   func_node=node, kind="decorator",
+                                   lineno=node.lineno)
+                    if isinstance(deco, ast.Call):
+                        _jit_kwargs(info)
+                    index.by_anchor.setdefault(info.anchor, info)
+                    index.all.append(info)
+                    break
+    return index
+
+
+def is_jit_call_name(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and name.split(".")[-1] == "jit"
+
+
+def assigned_names(target: ast.AST) -> list[str]:
+    """Dotted names stored by an assignment target (flattens tuples)."""
+    out: list[str] = []
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+    else:
+        name = dotted_name(target)
+        if name is not None:
+            out.append(name)
+    return out
+
+
+def stmt_assigned_names(stmt: ast.stmt) -> list[str]:
+    if isinstance(stmt, ast.Assign):
+        names = []
+        for t in stmt.targets:
+            names.extend(assigned_names(t))
+        return names
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return assigned_names(stmt.target)
+    return []

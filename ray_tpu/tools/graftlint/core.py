@@ -1,0 +1,210 @@
+"""Rule engine: file discovery, waiver parsing, driving, reporting.
+
+Exit-code contract: 0 = clean (all findings waived or none), 1 = active
+findings, 2 = usage error (bad path, unknown rule, syntax error in a
+linted file is reported as a finding, not an exit-2).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from functools import cached_property
+
+from ray_tpu.tools.graftlint import astutil
+
+# Repo root: three levels up from this file's directory.
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+WAIVER_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<next>-next-line)?="
+    r"(?P<rules>[A-Z][0-9]{3}(?:,[A-Z][0-9]{3})*)"
+    r"(?P<reason>.*)$")
+
+# Anything that looks like a waiver comment but fails WAIVER_RE.
+_WAIVER_PROBE = re.compile(r"#\s*graftlint:\s*disable")
+
+# Waiver-syntax findings (never themselves waivable).
+W001 = "W001"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str          # repo-relative posix path (or absolute if outside)
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        tag = f" [waived: {self.waiver_reason}]" if self.waived else ""
+        return (f"{self.file}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}{tag}")
+
+
+@dataclasses.dataclass
+class Waiver:
+    line: int          # line the comment sits on
+    rules: tuple[str, ...]
+    reason: str
+    next_line: bool
+
+
+def parse_waivers(lines: list[str], rel: str) \
+        -> tuple[list[Waiver], list[Finding]]:
+    waivers, findings = [], []
+    for i, text in enumerate(lines, start=1):
+        m = WAIVER_RE.search(text)
+        if m is None:
+            if _WAIVER_PROBE.search(text):
+                findings.append(Finding(
+                    W001, rel, i, 0,
+                    "malformed graftlint waiver (expected "
+                    "'graftlint: disable=R00X <reason>' in a comment)"))
+            continue
+        reason = m.group("reason").strip()
+        rules = tuple(m.group("rules").split(","))
+        if not reason:
+            findings.append(Finding(
+                W001, rel, i, m.start(),
+                f"waiver for {','.join(rules)} is missing a reason — "
+                "reasons are mandatory"))
+            continue
+        waivers.append(Waiver(i, rules, reason,
+                              m.group("next") is not None))
+    return waivers, findings
+
+
+class FileContext:
+    """Everything a rule needs about one file, computed lazily once."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+        self.rel = rel.replace(os.sep, "/") if not rel.startswith("..") \
+            else os.path.abspath(path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        astutil.add_parents(tree)
+        self.waivers, self.waiver_findings = parse_waivers(
+            self.lines, self.rel)
+
+    @cached_property
+    def qualnames(self):
+        return astutil.qualnames(self.tree)
+
+    @cached_property
+    def jits(self):
+        return astutil.build_jit_index(self.tree, self.qualnames)
+
+    @cached_property
+    def classes(self):
+        return astutil.class_methods(self.tree)
+
+    def apply_waivers(self, findings: list[Finding]) -> None:
+        by_line: dict[int, list[Waiver]] = {}
+        for w in self.waivers:
+            by_line.setdefault(w.line + 1 if w.next_line else w.line,
+                               []).append(w)
+        for f in findings:
+            if f.rule == W001:
+                continue
+            for w in by_line.get(f.line, []):
+                if f.rule in w.rules:
+                    f.waived = True
+                    f.waiver_reason = w.reason
+                    break
+
+
+def _rule_modules():
+    from ray_tpu.tools.graftlint.rules import ALL_RULES
+    return ALL_RULES
+
+
+def lint_file(path: str, select: set[str] | None = None,
+              disable: set[str] | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    rel = rel.replace(os.sep, "/") if not rel.startswith("..") else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding("E999", rel, exc.lineno or 1, exc.offset or 0,
+                        f"syntax error: {exc.msg}")]
+    ctx = FileContext(path, source, tree)
+    findings: list[Finding] = list(ctx.waiver_findings)
+    for rule_id, mod in _rule_modules().items():
+        if select is not None and rule_id not in select:
+            continue
+        if disable is not None and rule_id in disable:
+            continue
+        findings.extend(mod.check(ctx))
+    ctx.apply_waivers(findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py") and os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def lint_paths(paths: list[str], select: set[str] | None = None,
+               disable: set[str] | None = None) \
+        -> tuple[list[Finding], int]:
+    files = iter_python_files(paths)
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, select=select, disable=disable))
+    return findings, len(files)
+
+
+def to_json(findings: list[Finding], files_scanned: int) -> dict:
+    active = [f for f in findings if not f.waived]
+    return {
+        "version": 1,
+        "files_scanned": files_scanned,
+        "findings": [f.to_json() for f in findings],
+        "counts": {
+            "total": len(findings),
+            "waived": len(findings) - len(active),
+            "active": len(active),
+        },
+    }
+
+
+def format_text(findings: list[Finding], files_scanned: int,
+                show_waived: bool = False) -> str:
+    lines = []
+    for f in findings:
+        if f.waived and not show_waived:
+            continue
+        lines.append(str(f))
+    active = sum(1 for f in findings if not f.waived)
+    waived = len(findings) - active
+    lines.append(f"{active} finding(s) ({waived} waived) "
+                 f"across {files_scanned} file(s)")
+    return "\n".join(lines)

@@ -1,0 +1,17 @@
+"""Rule registry. Each module exposes `check(ctx) -> list[Finding]`."""
+
+from ray_tpu.tools.graftlint.rules import (
+    donation,
+    hot_sync,
+    locks,
+    retrace,
+    stats_contract,
+)
+
+ALL_RULES = {
+    "R001": hot_sync,
+    "R002": donation,
+    "R003": retrace,
+    "R004": locks,
+    "R005": stats_contract,
+}

@@ -1,0 +1,159 @@
+"""R002 use-after-donate.
+
+For every call site of a donating callable (a jax.jit with
+donate_argnums in this file, or the result of a registered donating
+factory like `fuse_steps`), any Name / self-attribute passed at a
+donated position must not be read again before it is reassigned: after
+dispatch the buffer is dead, and reading it returns garbage (or a
+deleted-buffer error on real hardware).
+
+A donated arg whose name is also a target of the same statement
+(`state, m = step(state, batch)`) is the canonical clean pattern. For
+anything else we do a linear scan over the statements that follow the
+call in source order (including the loop body before the call when the
+call sits inside a loop — the next iteration re-executes it): the first
+Load of the name before a full reassignment is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.tools.graftlint import astutil, scopes
+from ray_tpu.tools.graftlint.core import Finding
+
+RULE = "R002"
+
+
+def _donating_callables(ctx) -> dict[str, tuple[int, ...]]:
+    """Last-segment callable name -> donated argnums."""
+    out: dict[str, tuple[int, ...]] = {}
+    for anchor, info in ctx.jits.by_anchor.items():
+        if info.donate:
+            out[anchor.split(".")[-1]] = info.donate
+        elif info.donate_unknown:
+            # `jax.jit(f, **kwargs)` — if the factory is registered we
+            # know its donation contract; otherwise assume argnum 0,
+            # the overwhelmingly common convention, to stay on the
+            # conservative side.
+            fac = anchor.split(".")[-1]
+            out[fac] = scopes.DONATING_FACTORIES.get(fac, (0,))
+    # Anchors assigned from a registered donating factory (possibly
+    # through an IfExp): `self._dispatch = step if ... else
+    # fuse_steps(...)` — calls through the anchor donate.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        values = [node.value]
+        if isinstance(node.value, ast.IfExp):
+            values = [node.value.body, node.value.orelse]
+        for value in values:
+            if not isinstance(value, ast.Call):
+                continue
+            vname = astutil.call_name(value)
+            if vname is None:
+                continue
+            donate = scopes.DONATING_FACTORIES.get(vname.split(".")[-1])
+            if donate is None:
+                continue
+            for t in node.targets:
+                an = astutil.dotted_name(t)
+                if an is not None:
+                    out[an.split(".")[-1]] = donate
+    return out
+
+
+def _loads_name(stmt: ast.stmt, name: str) -> ast.AST | None:
+    """First Load of dotted `name` in stmt, ignoring Store contexts."""
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)) and \
+                isinstance(getattr(node, "ctx", None), ast.Load) and \
+                astutil.dotted_name(node) == name:
+            return node
+    return None
+
+
+def _enclosing_stmt_chain(node: ast.AST) -> list[ast.stmt]:
+    """All statements on the parent chain of `node` (innermost first)."""
+    out = []
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.stmt):
+            out.append(cur)
+        cur = getattr(cur, "parent", None)
+    return out
+
+
+def _function_stmts(fn) -> list[ast.stmt]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt) and node is not fn:
+            out.append(node)
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+def check(ctx) -> list[Finding]:
+    donators = _donating_callables(ctx)
+    if not donators:
+        return []
+    findings = []
+    for fn, qual in ctx.qualnames.items():
+        stmts = None
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            cname = astutil.call_name(call)
+            if cname is None:
+                continue
+            tail = cname.split(".")[-1]
+            donate = donators.get(tail)
+            if donate is None:
+                continue
+            if tail in scopes.DONATING_FACTORIES:
+                # calling the factory itself (fuse_steps(...)) does not
+                # donate — only calls through its *result* do, and
+                # those go through an assigned anchor name.
+                continue
+            chain = _enclosing_stmt_chain(call)
+            if not chain:
+                continue
+            call_stmt = chain[0]
+            same_stmt_targets = set(astutil.stmt_assigned_names(call_stmt))
+            for pos in donate:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                name = astutil.dotted_name(arg)
+                if name is None or name == "self":
+                    continue
+                if name in same_stmt_targets:
+                    continue   # `x, y = f(x)` — reassigned on return
+                if stmts is None:
+                    stmts = _function_stmts(fn)
+                # statements after the call, plus (for calls inside a
+                # loop) the loop body from its top — next iteration
+                # re-reads anything left unassigned.
+                loop = next((s for s in chain
+                             if isinstance(s, (ast.For, ast.While))), None)
+                seq = [s for s in stmts
+                       if s.lineno > call_stmt.lineno]
+                if loop is not None:
+                    seq += [s for s in stmts
+                            if loop.lineno < s.lineno <= call_stmt.lineno
+                            and s is not call_stmt]
+                bad = None
+                for stmt in seq:
+                    load = _loads_name(stmt, name)
+                    if load is not None:
+                        bad = load
+                        break
+                    if name in astutil.stmt_assigned_names(stmt):
+                        break   # fully reassigned; buffer is live again
+                if bad is not None:
+                    findings.append(Finding(
+                        RULE, ctx.rel, bad.lineno, bad.col_offset,
+                        f"'{name}' donated to {cname}() at line "
+                        f"{call.lineno} is read again before "
+                        "reassignment"))
+    return findings

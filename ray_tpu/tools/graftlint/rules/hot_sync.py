@@ -1,0 +1,157 @@
+"""R001 host-sync-in-hot-path.
+
+Two sweeps:
+
+1. Inside every resolvable jax.jit-wrapped function body (these run
+   under trace — a host sync there is either a trace-time crash or a
+   silent constant-folding bug): `.item()`, `np.asarray`/`np.array`,
+   `jax.device_get`, `.block_until_ready()`, `print`, and `float()`/
+   `int()` on non-constants.
+
+2. Inside registered hot scopes (`scopes.HOT_SCOPES` — the engine tick,
+   TrainLoop.run's step body, etc., which are host code but
+   latency-critical): device syncs (`np.asarray`, `jax.device_get`,
+   `.item()`, `.block_until_ready()`), `print`, `time.sleep`, queue
+   receives/puts/joins, and `int()`/`float()` applied to values freshly
+   returned by a jitted callable (the classic accidental sync on a
+   device array).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.tools.graftlint import astutil, scopes
+from ray_tpu.tools.graftlint.core import Finding
+
+RULE = "R001"
+
+_NP = ("np", "numpy")
+
+
+def _is_np_asarray(name: str) -> bool:
+    parts = name.split(".")
+    return len(parts) == 2 and parts[0] in _NP and parts[1] == "asarray"
+
+
+def _is_device_get(name: str) -> bool:
+    return name.split(".")[-1] in ("device_get", "_device_get") \
+        or name == "_device_get"
+
+
+def _is_queueish(name: str) -> bool:
+    """Receiver of .get/.put/.join that is plausibly a queue (so plain
+    dict.get / set ops don't light up)."""
+    parts = name.split(".")
+    return len(parts) >= 2 and parts[-1] in ("get", "put", "join") \
+        and "queue" in parts[-2].lower()
+
+
+def _jit_body_findings(ctx) -> list[Finding]:
+    findings = []
+    for info, args, body in ctx.jits.jitted_bodies():
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = astutil.call_name_loose(node)
+                if name is None:
+                    continue
+                tail = name.split(".")[-1]
+                msg = None
+                if name == "print":
+                    msg = "print() under jit traces every call"
+                elif tail == "item" and "." in name:
+                    msg = ".item() is a host sync"
+                elif _is_np_asarray(name) or (
+                        name.split(".")[0] in _NP and tail == "array"):
+                    msg = f"{name}() pulls the traced value to host"
+                elif _is_device_get(name):
+                    msg = f"{name}() is a host sync"
+                elif tail == "block_until_ready":
+                    msg = ".block_until_ready() is a host sync"
+                elif name in ("float", "int") and node.args and not \
+                        isinstance(node.args[0], ast.Constant):
+                    msg = (f"{name}() on a traced value forces "
+                           "concretization")
+                if msg is not None:
+                    findings.append(Finding(
+                        RULE, ctx.rel, node.lineno, node.col_offset,
+                        f"in jitted fn '{info.anchor}': {msg}"))
+    return findings
+
+
+def _jitted_callable_attrs(ctx) -> set[str]:
+    """Last path segment of every jit anchor in this file, e.g.
+    '_prefill_fn' from 'self._prefill_fn'."""
+    return {a.split(".")[-1] for a in ctx.jits.by_anchor}
+
+
+def _hot_scope_findings(ctx) -> list[Finding]:
+    hot = scopes.HOT_SCOPES.get(ctx.rel)
+    if not hot:
+        return []
+    findings = []
+    jit_attrs = _jitted_callable_attrs(ctx)
+    for fn, qual in ctx.qualnames.items():
+        if qual not in hot:
+            continue
+        # Names bound (possibly via tuple unpack) from jitted-callable
+        # calls inside this scope — int()/float() on them is a sync.
+        device_names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                cname = astutil.call_name(node.value)
+                if cname is not None and \
+                        cname.split(".")[-1] in jit_attrs:
+                    for t in node.targets:
+                        for n in astutil.assigned_names(t):
+                            if "." not in n:
+                                device_names.add(n)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name_loose(node)
+            # bare np.asarray / device_get passed as an argument
+            # (e.g. jax.tree.map(np.asarray, tree)) syncs too
+            for arg in node.args:
+                aname = astutil.dotted_name(arg)
+                if aname and (_is_np_asarray(aname)
+                              or _is_device_get(aname)):
+                    findings.append(Finding(
+                        RULE, ctx.rel, arg.lineno, arg.col_offset,
+                        f"in hot scope '{qual}': {aname} mapped over a "
+                        "tree is a host sync"))
+            if name is None:
+                continue
+            tail = name.split(".")[-1]
+            msg = None
+            if name == "print":
+                msg = "print() blocks the tick on stdout"
+            elif tail == "item" and "." in name:
+                msg = ".item() is a device sync"
+            elif _is_np_asarray(name):
+                msg = f"{name}() is a device sync"
+            elif _is_device_get(name):
+                msg = f"{name}() is a device sync"
+            elif tail == "block_until_ready":
+                msg = ".block_until_ready() stalls the pipeline"
+            elif name == "time.sleep":
+                msg = "time.sleep() stalls the hot path"
+            elif _is_queueish(name):
+                msg = f"{name}() can block the hot path"
+            elif name in ("float", "int") and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in device_names:
+                msg = (f"{name}({node.args[0].id}) syncs on a value "
+                       "just returned by a jitted callable")
+            if msg is not None:
+                findings.append(Finding(
+                    RULE, ctx.rel, node.lineno, node.col_offset,
+                    f"in hot scope '{qual}': {msg}"))
+    return findings
+
+
+def check(ctx) -> list[Finding]:
+    return _jit_body_findings(ctx) + _hot_scope_findings(ctx)

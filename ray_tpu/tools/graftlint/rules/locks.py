@@ -1,0 +1,223 @@
+"""R004 lock discipline.
+
+Two checks:
+
+1. **Blocking under lock.** Inside `with <lock>:` bodies — following
+   same-class method calls up to 3 levels deep — flag calls that can
+   block: `time.sleep`, `ray_tpu.get`/`ray_tpu.wait`, `.result()`,
+   `.wait()`, `.join()`, queue `.get()`/`.put()`, device syncs
+   (`jax.device_get`, `np.asarray`, `.block_until_ready()`,
+   `jax.device_put`). A blocked holder of the engine scheduler lock
+   stalls every stream's tick.
+
+2. **Lock-order graph.** Nested acquisitions (lexical or via the same
+   recursive walk) are edges; in registered files every observed edge
+   must be declared in `scopes.LOCK_ORDER`, and the union of declared
+   and observed edges must be acyclic.
+
+Lock identity: for files registered in `scopes.LOCKS` the with-expr
+dotted name is matched against the declared map (locks with
+`blocking_ok=True` — e.g. the engine swap mutex, which exists precisely
+to hold blocking placement away from the scheduler — skip check 1 but
+still participate in check 2). For unregistered files, any with-expr
+whose last segment ends in 'lock'/'mutex' (case-insensitive) is treated
+as a lock named '<expr>'.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.tools.graftlint import astutil, scopes
+from ray_tpu.tools.graftlint.core import Finding
+
+RULE = "R004"
+
+_GENERIC_LOCK = re.compile(r"(lock|mutex)s?$", re.IGNORECASE)
+_MAX_DEPTH = 3
+
+_BLOCKING_TAILS = {"result", "wait", "join", "block_until_ready",
+                   "device_put"}
+_EXACT_BLOCKING = {"time.sleep", "ray_tpu.get", "ray_tpu.wait"}
+
+
+def _blocking_reason(name: str) -> str | None:
+    parts = name.split(".")
+    tail = parts[-1]
+    if name in _EXACT_BLOCKING:
+        return f"{name}() blocks"
+    if tail in _BLOCKING_TAILS and len(parts) >= 2:
+        return f".{tail}() can block indefinitely"
+    if tail in ("device_get", "_device_get") or name == "_device_get":
+        return f"{name}() is a device sync"
+    if len(parts) == 2 and parts[0] in ("np", "numpy") and \
+            tail == "asarray":
+        return f"{name}() is a device sync"
+    if tail in ("get", "put") and len(parts) >= 2 and \
+            "queue" in parts[-2].lower():
+        return f"{name}() can block on the queue"
+    return None
+
+
+def _lock_spec(ctx, expr: ast.AST) -> scopes.LockSpec | None:
+    name = astutil.dotted_name(expr)
+    if name is None:
+        return None
+    declared = scopes.LOCKS.get(ctx.rel)
+    if declared is not None:
+        return declared.get(name)
+    if ctx.rel.startswith("ray_tpu/"):
+        return None   # in-repo files must declare their locks
+    if _GENERIC_LOCK.search(name.split(".")[-1]):
+        return scopes.LockSpec(name)
+    return None
+
+
+def check(ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    seen_lines: set[tuple[int, str]] = set()
+    observed_edges: dict[tuple[str, str], int] = {}
+    methods_by_class = ctx.classes
+
+    def class_of(fn) -> dict | None:
+        qual = ctx.qualnames.get(fn)
+        if qual and "." in qual:
+            return methods_by_class.get(qual.split(".")[0])
+        return None
+
+    def scan_node(node, held: list[scopes.LockSpec], cls, depth: int,
+                  visited: frozenset):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return   # not executed at this point in the flow
+        if isinstance(node, ast.With):
+            specs = []
+            for item in node.items:
+                spec = _lock_spec(ctx, item.context_expr)
+                if spec is not None:
+                    specs.append(spec)
+                else:
+                    scan_node(item.context_expr, held, cls, depth,
+                              visited)
+            if specs:
+                new_held = list(held)
+                for spec in specs:
+                    for h in new_held:
+                        if h.name != spec.name:
+                            observed_edges.setdefault(
+                                (h.name, spec.name), node.lineno)
+                    # reentrant re-acquire of the same (R)Lock is not
+                    # a new edge and not a new hold level
+                    if all(h.name != spec.name for h in new_held):
+                        new_held.append(spec)
+                for stmt in node.body:
+                    scan_node(stmt, new_held, cls, depth, visited)
+                return
+        if isinstance(node, ast.Call):
+            cname = astutil.call_name_loose(node)
+            if cname is not None and held:
+                innermost_strict = next(
+                    (s for s in reversed(held) if not s.blocking_ok),
+                    None)
+                reason = _blocking_reason(cname)
+                if reason is not None and innermost_strict is not None:
+                    key = (node.lineno, innermost_strict.name)
+                    if key not in seen_lines:
+                        seen_lines.add(key)
+                        findings.append(Finding(
+                            RULE, ctx.rel, node.lineno, node.col_offset,
+                            f"{reason} while holding lock "
+                            f"'{innermost_strict.name}'"))
+                # follow self.method() calls within the class
+                parts = cname.split(".")
+                if cls is not None and depth < _MAX_DEPTH and \
+                        len(parts) == 2 and parts[0] == "self" and \
+                        parts[1] in cls and parts[1] not in visited:
+                    target = cls[parts[1]]
+                    for stmt in target.body:
+                        scan_node(stmt, held, cls, depth + 1,
+                                  visited | {parts[1]})
+        for child in ast.iter_child_nodes(node):
+            scan_node(child, held, cls, depth, visited)
+
+    # entry points: every `with <lock>:` not already inside another
+    # lock-with (nested ones are reached by the scan itself)
+    for fn, qual in ctx.qualnames.items():
+        cls = class_of(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_lock_spec(ctx, it.context_expr) is not None
+                       for it in node.items):
+                continue
+            outer = getattr(node, "parent", None)
+            enclosed = False
+            while outer is not None and outer is not fn:
+                if isinstance(outer, ast.With) and any(
+                        _lock_spec(ctx, it.context_expr) is not None
+                        for it in outer.items):
+                    enclosed = True
+                    break
+                if isinstance(outer, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    break   # nested def: its own entry point
+                outer = getattr(outer, "parent", None)
+            if not enclosed:
+                scan_node(node, [], cls, 0, frozenset())
+
+    # module-level with-locks (e.g. telemetry's registry lock) live in
+    # functions too — covered above since qualnames maps all defs; a
+    # with-lock at true module scope is rare and skipped.
+
+    # lock-order: observed edges must be declared (registered files),
+    # and declared ∪ observed must be acyclic
+    declared = set(scopes.LOCK_ORDER)
+    in_registry = ctx.rel in scopes.LOCKS
+    for edge, lineno in sorted(observed_edges.items(),
+                               key=lambda kv: kv[1]):
+        if in_registry and edge not in declared:
+            findings.append(Finding(
+                RULE, ctx.rel, lineno, 0,
+                f"undeclared lock-order edge {edge[0]} -> {edge[1]} — "
+                "declare it in scopes.LOCK_ORDER or restructure"))
+    graph: dict[str, set[str]] = {}
+    for a, b in declared | set(observed_edges):
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycle = _find_cycle(graph)
+    if cycle is not None:
+        involved = [observed_edges[e] for e in observed_edges
+                    if e[0] in cycle and e[1] in cycle]
+        if involved:   # only report where an edge is visible
+            findings.append(Finding(
+                RULE, ctx.rel, min(involved), 0,
+                "lock-order cycle: " + " -> ".join(cycle)))
+    return findings
+
+
+def _find_cycle(graph: dict[str, set[str]]) -> list[str] | None:
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: list[str] = []
+
+    def dfs(n: str) -> list[str] | None:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph[n]):
+            if color[m] == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                found = dfs(m)
+                if found is not None:
+                    return found
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            found = dfs(n)
+            if found is not None:
+                return found
+    return None

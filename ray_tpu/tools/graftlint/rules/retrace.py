@@ -1,0 +1,152 @@
+"""R003 retrace hazards + compile-once inventory.
+
+Checks, everywhere:
+
+- Python `if`/`while` on a traced *parameter* of a jitted function
+  (trace-time branching on device values raises ConcretizationError or
+  silently bakes one branch in).
+- A jitted function reading a module-level mutable literal (list/dict/
+  set): mutating it between calls changes trace-time constants and
+  forces silent retraces.
+- `jax.jit(...)` created inside a `for`/`while` loop: a fresh jit per
+  iteration defeats the compile cache.
+- Unhashable (list/dict/set literal) or f-string arguments at positions
+  declared static via static_argnums/static_argnames: every distinct
+  object retraces.
+
+Plus, for files registered in `scopes.COMPILE_ONCE_JITS`: every jit
+anchor in the file must appear in the inventory — the same registry
+RetraceSentinel validates `registered=True` watches against — so adding
+a new jitted hot path without registering it fails at lint time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.tools.graftlint import astutil, scopes
+from ray_tpu.tools.graftlint.core import Finding
+
+RULE = "R003"
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _param_names(args: ast.arguments) -> set[str]:
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _module_mutable_globals(tree: ast.AST) -> set[str]:
+    out = set()
+    for stmt in getattr(tree, "body", []):
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, _MUTABLE_LITERALS):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _inventory_findings(ctx) -> list[Finding]:
+    inventory = scopes.COMPILE_ONCE_JITS.get(ctx.rel)
+    if inventory is None:
+        return []
+    findings = []
+    seen = set()
+    for info in ctx.jits.all:
+        if info.anchor in seen:
+            continue
+        seen.add(info.anchor)
+        if info.anchor not in inventory:
+            findings.append(Finding(
+                RULE, ctx.rel, info.lineno, 0,
+                f"jit anchor '{info.anchor}' is not in the compile-once "
+                "inventory (ray_tpu/tools/graftlint/scopes.py "
+                "COMPILE_ONCE_JITS) — register it and arm a "
+                "RetraceSentinel watch, or mark it None with a reason"))
+    return findings
+
+
+def check(ctx) -> list[Finding]:
+    findings = _inventory_findings(ctx)
+    mutable_globals = _module_mutable_globals(ctx.tree)
+
+    # per-jitted-body hazards
+    for info, args, body in ctx.jits.jitted_bodies():
+        params = _param_names(args)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.While)):
+                    for ref in ast.walk(node.test):
+                        if isinstance(ref, ast.Name) and \
+                                isinstance(ref.ctx, ast.Load) and \
+                                ref.id in params:
+                            findings.append(Finding(
+                                RULE, ctx.rel, node.lineno,
+                                node.col_offset,
+                                f"in jitted fn '{info.anchor}': Python "
+                                f"branch on traced param '{ref.id}' — "
+                                "use lax.cond/jnp.where"))
+                            break
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in mutable_globals and \
+                        node.id not in params:
+                    findings.append(Finding(
+                        RULE, ctx.rel, node.lineno, node.col_offset,
+                        f"in jitted fn '{info.anchor}': reads mutable "
+                        f"module global '{node.id}' — mutations force "
+                        "silent retraces"))
+
+    # jax.jit inside a loop
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.While)):
+            for sub in ast.walk(node):
+                if astutil.is_jit_call(sub):
+                    findings.append(Finding(
+                        RULE, ctx.rel, sub.lineno, sub.col_offset,
+                        "jax.jit() constructed inside a loop — hoist it "
+                        "out or the compile cache is defeated"))
+
+    # unhashable / f-string args at declared-static positions
+    static_jits = {a.split(".")[-1]: i for a, i in ctx.jits.by_anchor.items()
+                   if i.static_argnums or i.static_argnames}
+    if static_jits:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = astutil.call_name(node)
+            if cname is None:
+                continue
+            info = static_jits.get(cname.split(".")[-1])
+            if info is None:
+                continue
+            bad_args: list[ast.AST] = []
+            for pos in info.static_argnums:
+                if pos < len(node.args):
+                    bad_args.append(node.args[pos])
+            for kw in node.keywords:
+                if kw.arg in info.static_argnames:
+                    bad_args.append(kw.value)
+            for arg in bad_args:
+                if isinstance(arg, _MUTABLE_LITERALS):
+                    findings.append(Finding(
+                        RULE, ctx.rel, arg.lineno, arg.col_offset,
+                        f"unhashable literal passed at a static arg of "
+                        f"{cname}() — every call retraces"))
+                elif isinstance(arg, ast.JoinedStr):
+                    findings.append(Finding(
+                        RULE, ctx.rel, arg.lineno, arg.col_offset,
+                        f"f-string passed at a static arg of {cname}() "
+                        "— every distinct string retraces"))
+
+    uniq: dict[tuple, Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.line, f.col, f.message), f)
+    return list(uniq.values())

@@ -1,0 +1,80 @@
+"""R005 stats-contract.
+
+For every function named `stats` whose body returns a single dict
+literal with all-constant string keys (no `**` spread), the keys
+documented in its docstring as ``key`` must match the returned keys
+exactly, both directions. This is the static twin of
+test_telemetry.py's runtime docstring-contract check: the Prometheus
+bridge auto-registers one series per stats() key, so an undocumented
+key is an unreviewed series and a documented-but-missing key is a dead
+dashboard panel.
+
+Functions whose stats() builds the dict dynamically (returns a
+variable, uses `**`, computed keys) or whose docstring documents no
+``key`` tokens are skipped — the contract only binds where both sides
+are statically known.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.tools.graftlint import astutil
+from ray_tpu.tools.graftlint.core import Finding
+
+RULE = "R005"
+
+_DOC_KEY = re.compile(r"``([A-Za-z0-9_]+)``")
+
+
+def _returned_dict(fn) -> ast.Dict | None:
+    """The dict literal if every return in fn returns the same literal
+    shape we can check; else None."""
+    returns = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
+    dicts = [r.value for r in returns if isinstance(r.value, ast.Dict)]
+    if len(dicts) != 1 or len(returns) != 1:
+        return None
+    return dicts[0]
+
+
+def check(ctx) -> list[Finding]:
+    findings = []
+    for fn, qual in ctx.qualnames.items():
+        if fn.name != "stats":
+            continue
+        doc = ast.get_docstring(fn)
+        if not doc:
+            continue
+        documented = set(_DOC_KEY.findall(doc))
+        if not documented:
+            continue
+        d = _returned_dict(fn)
+        if d is None:
+            continue
+        keys = set()
+        static = True
+        for k in d.keys:
+            if k is None:                       # ** spread
+                static = False
+                break
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+            else:
+                static = False
+                break
+        if not static:
+            continue
+        undocumented = sorted(keys - documented)
+        missing = sorted(documented - keys)
+        if undocumented:
+            findings.append(Finding(
+                RULE, ctx.rel, fn.lineno, fn.col_offset,
+                f"{qual}() returns keys not documented in its "
+                f"docstring: {', '.join(undocumented)}"))
+        if missing:
+            findings.append(Finding(
+                RULE, ctx.rel, fn.lineno, fn.col_offset,
+                f"{qual}() docstring documents keys it does not "
+                f"return: {', '.join(missing)}"))
+    return findings

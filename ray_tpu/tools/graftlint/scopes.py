@@ -1,0 +1,150 @@
+"""Scope registries: the repo's declared hot paths, compile-once jits,
+locks, and lock-order graph.
+
+This module is the single source of truth shared by the static rules and
+by the runtime: `RetraceSentinel.watch(..., registered=True)` validates
+its watch name against RETRACE_WATCHES, so adding a new jitted hot path
+without registering it here fails loudly at engine construction — and
+adding a jit assignment to a registered file without an inventory entry
+fails R003 at lint time. Paths are repo-relative posix.
+
+Deliberately dependency-free: importable from ray_tpu.util.telemetry
+without dragging the linter (or jax) in.
+"""
+
+from __future__ import annotations
+
+ENGINE = "ray_tpu/serve/engine.py"
+LOOP = "ray_tpu/train/loop.py"
+FT = "ray_tpu/train/ft.py"
+FLYWHEEL = "ray_tpu/rl/flywheel.py"
+SPMD = "ray_tpu/train/spmd.py"
+PREDICTOR = "ray_tpu/train/predictor.py"
+CONTROLLER = "ray_tpu/serve/controller.py"
+REPLICA = "ray_tpu/serve/replica.py"
+TELEMETRY = "ray_tpu/util/telemetry.py"
+METRICS = "ray_tpu/util/metrics.py"
+
+# --- R001: functions whose bodies are latency-critical host code. A
+# host sync here stalls the device queue (or the scheduler tick).
+HOT_SCOPES: dict[str, frozenset[str]] = {
+    ENGINE: frozenset({
+        "InferenceEngine.step",
+        "InferenceEngine.tokens_for",
+        "InferenceEngine._try_admit",
+        "InferenceEngine._admit_pending",
+        "InferenceEngine._batch_arrays",
+        "InferenceEngine._run_prefill_chunk",
+        "InferenceEngine._prefill_tick",
+        "InferenceEngine._decode_tick",
+        "InferenceEngine._spec_tick",
+        "InferenceEngine._emit",
+    }),
+    LOOP: frozenset({
+        "TrainLoop.run",
+        "MetricsRing.push",
+        "MetricsRing._sync",
+        "DevicePrefetcher.__next__",
+    }),
+    FT: frozenset({
+        "AsyncCheckpointer.maybe_snapshot",
+        "AsyncCheckpointer.flush",
+    }),
+    FLYWHEEL: frozenset({
+        "FlywheelLoop._publish",
+    }),
+}
+
+# --- R003: compile-once inventory. For each registered file, every
+# `<anchor> = jax.jit(...)` assignment (or factory returning a jit) must
+# appear here; the value is the RetraceSentinel watch name guarding it,
+# or None for jits that are deliberately unwatched (cheap, cold, or
+# traced a bounded number of times by construction).
+COMPILE_ONCE_JITS: dict[str, dict[str, str | None]] = {
+    ENGINE: {
+        "self._prefill_fn": "prefill",
+        "self._decode_fn": "decode",
+        "self._copy_fn": None,          # COW block copy; shapes fixed
+        "self._verify_fn": "verify",
+        "self._propose_fn": "draft",
+        "self._draft_prefill_fn": "draft_prefill",
+        "self._swap_fn": "swap",
+    },
+    LOOP: {
+        "fuse_steps": "dispatch",       # factory: returns the fused jit
+    },
+    FT: {
+        "self._copy": None,             # device-side snapshot clone
+    },
+    FLYWHEEL: {
+        "self._step": None,             # watched via TrainLoop dispatch
+    },
+    SPMD: {
+        "make_train_step": None,        # factory; callers own the watch
+    },
+    PREDICTOR: {
+        "self._apply": None,            # one bucket set, traced per shape
+    },
+}
+
+# The sentinel watch names that must be armed with registered=True.
+RETRACE_WATCHES: frozenset[str] = frozenset(
+    name
+    for per_file in COMPILE_ONCE_JITS.values()
+    for name in per_file.values()
+    if name is not None
+)
+
+# --- R002: factories whose *returned* callable donates these argnums.
+# Keyed by bare factory name; matched at call sites of the assigned
+# target (e.g. `self._dispatch = fuse_steps(...)`).
+DONATING_FACTORIES: dict[str, tuple[int, ...]] = {
+    "fuse_steps": (0,),
+    "make_train_step": (0,),
+}
+
+
+class LockSpec:
+    """A declared lock. `blocking_ok` marks locks that exist to
+    serialize an inherently blocking operation (e.g. the engine swap
+    mutex, whose whole job is to hold device placement away from the
+    scheduler lock); R004 skips the blocking-call check under them but
+    still tracks them in the lock-order graph."""
+
+    __slots__ = ("name", "blocking_ok")
+
+    def __init__(self, name: str, blocking_ok: bool = False):
+        self.name = name
+        self.blocking_ok = blocking_ok
+
+
+# --- R004: declared locks, keyed by file -> {with-expr dotted name}.
+LOCKS: dict[str, dict[str, LockSpec]] = {
+    ENGINE: {
+        "self._lock": LockSpec("engine.scheduler"),
+        "self._swap_mutex": LockSpec("engine.swap", blocking_ok=True),
+    },
+    CONTROLLER: {
+        "self._lock": LockSpec("serve.controller"),
+    },
+    REPLICA: {
+        "self._lock": LockSpec("serve.replica"),
+    },
+    TELEMETRY: {
+        "_lock": LockSpec("telemetry.registry"),
+    },
+    METRICS: {
+        "self.lock": LockSpec("metrics.registry"),
+        "self._lock": LockSpec("metrics.series"),
+    },
+}
+
+# Declared lock-order edges (may-acquire-while-holding). Observed
+# nestings in registered files must be a subset; cycles in the union of
+# declared and observed edges are findings.
+LOCK_ORDER: frozenset[tuple[str, str]] = frozenset({
+    ("engine.swap", "engine.scheduler"),
+    ("engine.scheduler", "telemetry.registry"),
+    ("telemetry.registry", "metrics.registry"),
+    ("metrics.registry", "metrics.series"),
+})

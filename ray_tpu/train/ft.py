@@ -359,6 +359,7 @@ class AsyncCheckpointer:
         specs = [_leaf_spec(l) for l in jax.tree_util.tree_leaves(snap)]
         if self._queue.full():
             self.stalls += 1
+        # graftlint: disable-next-line=R001 bounded backpressure: blocks only when max_in_flight snapshots are pending (counted in `stalls`) — the memory bound IS the contract, not an accidental sync
         self._queue.put((int(step), snap, specs))
         self._last_snap_step = int(step)
         self.snapshots += 1
@@ -367,6 +368,7 @@ class AsyncCheckpointer:
     def flush(self) -> None:
         """Block until every enqueued snapshot is committed (the one
         deliberate end-of-run sync, mirroring MetricsRing.drain)."""
+        # graftlint: disable-next-line=R001 the one deliberate end-of-run barrier, mirroring MetricsRing.drain — callers invoke it after the timed region
         self._queue.join()
         self._raise_pending()
 

@@ -165,6 +165,7 @@ class MetricsRing:
         if take <= 0:
             return
         items = [self._pending.popleft() for _ in range(take)]
+        # graftlint: disable-next-line=R001 intentional lagged fetch: fires at most every `interval` pushed steps and only for entries >= `lag` dispatches old, so the device queue is never drained behind the live dispatch
         hosts = _device_get([m for _, m in items])
         self.fetches += 1
         for (count, _), host in zip(items, hosts):
@@ -259,7 +260,8 @@ class TrainLoop:
         self.sentinel = _telemetry.RetraceSentinel(self.name)
         if self.unroll > 1:
             self.sentinel.watch("dispatch",
-                                lambda: self.dispatch_traces, cap=1)
+                                lambda: self.dispatch_traces, cap=1,
+                                registered=True)
         _telemetry.register_stats_source(self.name, self, kind="train")
         # Optional train/ft.AsyncCheckpointer (any object with
         # maybe_snapshot(state, step) + flush()). Mutable attribute so a

@@ -337,7 +337,21 @@ class RetraceSentinel:
         self.events: collections.deque = collections.deque(maxlen=64)
         _sentinels.add(self)
 
-    def watch(self, path: str, getter, cap: int | None = None) -> None:
+    def watch(self, path: str, getter, cap: int | None = None,
+              *, registered: bool = False) -> None:
+        """`registered=True` asserts `path` is in graftlint's
+        compile-once inventory (scopes.RETRACE_WATCHES) — the repo's
+        jitted hot paths arm their watches through this, so the static
+        R003 registry and the runtime sentinel can never drift apart.
+        Ad-hoc/test watches keep the default."""
+        if registered:
+            from ray_tpu.tools.graftlint import scopes as _scopes
+            if path not in _scopes.RETRACE_WATCHES:
+                raise ValueError(
+                    f"sentinel watch {path!r} is not a registered "
+                    "compile-once path — add it to COMPILE_ONCE_JITS in "
+                    "ray_tpu/tools/graftlint/scopes.py (R003) so lint "
+                    "and runtime agree on the inventory")
         self._watches[path] = {
             "getter": getter,
             "cap": None if cap is None else int(cap),

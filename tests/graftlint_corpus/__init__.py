@@ -1,0 +1,3 @@
+# Fixture corpus for tests/test_graftlint.py. These files are linted
+# as data, never imported or executed; each rNNN_bad.py must trip its
+# rule and each rNNN_clean.py must not.

@@ -1,0 +1,22 @@
+"""R001 corpus: host syncs inside jitted functions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    print("step", x)                 # R001: print under jit
+    y = np.asarray(x)                # R001: host pull under trace
+    return jnp.sum(y)
+
+
+def _inner(x):
+    v = x.mean().item()              # R001: .item() is a host sync
+    lr = float(x[0])                 # R001: concretizes a traced value
+    jax.device_get(x)                # R001: explicit host sync
+    x.block_until_ready()            # R001: host sync
+    return x * v * lr
+
+
+fast_inner = jax.jit(_inner)

@@ -1,0 +1,17 @@
+"""R001 corpus: jitted functions that stay on-device."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_step(x):
+    y = jnp.asarray(x)               # jnp stays on device: fine
+    return jnp.sum(y) * 2.0
+
+
+def _inner(x):
+    scale = float(1e-3)              # constant arg: fine
+    return jnp.where(x > 0, x * scale, 0.0)
+
+
+fast_inner = jax.jit(_inner)

@@ -1,0 +1,22 @@
+"""R002 corpus: donated buffer read after dispatch."""
+import jax
+
+
+def _step(state, batch):
+    return state, batch
+
+
+step_fn = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(state, batches):
+    for batch in batches:
+        new_state, _ = step_fn(state, batch)
+        loss = state["loss"]         # R002: state was donated above
+        state = new_state
+    return state, loss
+
+
+def report(state, batch):
+    out, _ = step_fn(state, batch)
+    return out, state                # R002: donated `state` read again

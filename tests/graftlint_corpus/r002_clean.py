@@ -1,0 +1,21 @@
+"""R002 corpus: donation with same-statement reassignment."""
+import jax
+
+
+def _step(state, batch):
+    return state, batch
+
+
+step_fn = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(state, batches):
+    for batch in batches:
+        state, metrics = step_fn(state, batch)   # canonical pattern
+    return state, metrics
+
+
+def swap_then_rebuild(state, batch):
+    out, _ = step_fn(state, batch)
+    state = out                      # full reassignment before any read
+    return state

@@ -1,0 +1,28 @@
+"""R003 corpus: retrace hazards."""
+import jax
+import jax.numpy as jnp
+
+SCHEDULE = {"warmup": 100}           # mutable module global
+
+
+def _step(x, flag):
+    if flag:                         # R003: Python branch on traced arg
+        x = x * 2.0
+    return x + SCHEDULE["warmup"]    # R003: closes over mutable global
+
+
+step = jax.jit(_step)
+
+shaped = jax.jit(lambda x, shape: jnp.zeros(shape) + x,
+                 static_argnums=(1,))
+
+
+def build(xs):
+    fns = []
+    for x in xs:
+        fns.append(jax.jit(lambda v: v + x))   # R003: jit in a loop
+    return fns
+
+
+def call_site(x):
+    return shaped(x, [4, 4])         # R003: unhashable static arg

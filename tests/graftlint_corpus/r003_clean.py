@@ -1,0 +1,19 @@
+"""R003 corpus: trace-stable jit usage."""
+import jax
+import jax.numpy as jnp
+
+WARMUP = 100                         # immutable module global: fine
+
+
+def _step(x, flag):
+    return jax.lax.cond(flag, lambda v: v * 2.0, lambda v: v, x) + WARMUP
+
+
+step = jax.jit(_step)
+
+shaped = jax.jit(lambda x, shape: jnp.zeros(shape) + x,
+                 static_argnums=(1,))
+
+
+def call_site(x):
+    return shaped(x, (4, 4))         # hashable tuple static: fine

@@ -1,0 +1,40 @@
+"""R004 corpus: blocking under lock + lock-order cycle."""
+import threading
+import time
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = __import__("queue").Queue()
+        self._futs = []
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.01)             # R004: sleep under lock
+            item = self._queue.get()     # R004: queue recv under lock
+            self._futs[0].result()       # R004: future wait under lock
+            return item
+
+    def drain(self):
+        with self._lock:
+            self._slow_helper()          # R004: via method recursion
+
+    def _slow_helper(self):
+        time.sleep(0.5)
+
+
+class Ordered:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 1
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:           # R004: a->b and b->a = cycle
+                return 2

@@ -1,0 +1,39 @@
+"""R004 corpus: snapshot under lock, block outside it."""
+import threading
+import time
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = __import__("queue").Queue()
+        self._pending = []
+
+    def tick(self):
+        with self._lock:
+            work = list(self._pending)   # snapshot under lock
+            self._pending.clear()
+        time.sleep(0.01)                 # blocking happens outside
+        for item in work:
+            self._queue.put_nowait(item)
+
+    def drain(self):
+        with self._lock:
+            n = len(self._pending)
+        return n
+
+
+class Ordered:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:           # one consistent order: fine
+                return 1
+
+    def also_forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                return 2

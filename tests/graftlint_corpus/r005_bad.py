@@ -1,0 +1,17 @@
+"""R005 corpus: stats() docstring out of sync with returned keys."""
+
+
+class Engine:
+    def stats(self):
+        """Live counters.
+
+        - ``ticks``: scheduler iterations
+        - ``queued``: submitted but unadmitted requests
+        - ``retired``: finished requests
+        """
+        return {
+            "ticks": 0,
+            "queued": 0,
+            "emitted": 0,        # R005: undocumented key
+            # R005: documented key "retired" never returned
+        }

@@ -1,0 +1,14 @@
+"""R005 corpus: stats() docstring matches returned keys exactly."""
+
+
+class Engine:
+    def stats(self):
+        """Live counters.
+
+        - ``ticks``: scheduler iterations
+        - ``queued``: submitted but unadmitted requests
+        """
+        return {
+            "ticks": 0,
+            "queued": 0,
+        }

@@ -1,0 +1,352 @@
+"""graftlint: the AST invariant checker (ray_tpu/tools/graftlint/).
+
+Pins the tentpole contracts: the repo lints clean against the
+checked-in baseline (tier-1 — the baseline can never silently regress),
+every rule is proven live on a known-bad corpus file and silent on its
+clean twin, waivers require reasons, the CLI honors its exit-code and
+JSON schema contract, the RetraceSentinel's registered watches agree
+with the R003 compile-once registry, and the two R004 bug fixes (engine
+weight placement, controller shutdown kills) actually release their
+locks during the blocking work.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ray_tpu.tools.graftlint import astutil, core, scopes
+from ray_tpu.tools.graftlint.rules import ALL_RULES
+
+REPO = core.REPO_ROOT
+CORPUS = os.path.join(REPO, "tests", "graftlint_corpus")
+BASELINE = os.path.join(REPO, "ray_tpu", "tools", "graftlint",
+                        "baseline.json")
+
+
+def _lint(path, **kw):
+    return core.lint_file(path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# tier-1: the repo is clean and the waiver set matches the baseline
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    findings, nfiles = core.lint_paths([os.path.join(REPO, "ray_tpu")])
+    assert nfiles > 100
+    active = [f for f in findings if not f.waived]
+    assert not active, "graftlint found active findings:\n" + \
+        "\n".join(str(f) for f in active)
+    waived = sorted({(f.file, f.rule, f.waiver_reason)
+                     for f in findings if f.waived})
+    with open(BASELINE) as fh:
+        baseline = sorted((w["file"], w["rule"], w["reason"])
+                          for w in json.load(fh)["waived"])
+    assert waived == baseline, (
+        "waiver set drifted from baseline.json — if the new waiver is "
+        "deliberate, regenerate the baseline and justify it in review")
+
+
+def test_every_baseline_waiver_has_reason():
+    with open(BASELINE) as fh:
+        for w in json.load(fh)["waived"]:
+            assert w["reason"].strip(), w
+
+
+# ---------------------------------------------------------------------------
+# corpus: every rule fires on bad, stays silent on clean, and dies
+# when disabled (proven live, not vacuously clean)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(ALL_RULES))
+def test_rule_live_on_corpus(rule):
+    n = rule[1:].lstrip("0")
+    bad = os.path.join(CORPUS, f"r{int(n):03d}_bad.py")
+    clean = os.path.join(CORPUS, f"r{int(n):03d}_clean.py")
+    hits = [f for f in _lint(bad) if f.rule == rule]
+    assert hits, f"{rule} found nothing in its known-bad corpus file"
+    assert all(not f.waived for f in hits)
+    disabled = [f for f in _lint(bad, disable={rule}) if f.rule == rule]
+    assert not disabled, f"{rule} fired while disabled"
+    assert not [f for f in _lint(clean) if f.rule == rule], \
+        f"{rule} false-positived on its known-clean corpus file"
+
+
+def test_r004_detects_lock_order_cycle():
+    bad = os.path.join(CORPUS, "r004_bad.py")
+    msgs = [f.message for f in _lint(bad) if f.rule == "R004"]
+    assert any("cycle" in m for m in msgs)
+
+
+def test_r005_reports_both_directions():
+    bad = os.path.join(CORPUS, "r005_bad.py")
+    msgs = "\n".join(f.message for f in _lint(bad) if f.rule == "R005")
+    assert "emitted" in msgs      # returned but undocumented
+    assert "retired" in msgs      # documented but not returned
+
+
+# ---------------------------------------------------------------------------
+# waiver parsing
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, body):
+    p = tmp_path / "snippet.py"
+    p.write_text(body)
+    return str(p)
+
+
+WAIVABLE = """import jax
+
+@jax.jit
+def f(x):
+    print(x){waiver}
+    return x
+"""
+
+
+def test_waiver_same_line(tmp_path):
+    path = _write(tmp_path, WAIVABLE.format(
+        waiver="  # graftlint: disable=R001 trace-time debug aid"))
+    (f,) = _lint(path)
+    assert f.rule == "R001" and f.waived
+    assert f.waiver_reason == "trace-time debug aid"
+
+
+def test_waiver_next_line(tmp_path):
+    body = WAIVABLE.format(waiver="").replace(
+        "    print(x)",
+        "    # graftlint: disable-next-line=R001 warmup print only\n"
+        "    print(x)")
+    (f,) = _lint(_write(tmp_path, body))
+    assert f.waived and f.waiver_reason == "warmup print only"
+
+
+def test_waiver_without_reason_is_rejected(tmp_path):
+    path = _write(tmp_path, WAIVABLE.format(
+        waiver="  # graftlint: disable=R001"))
+    findings = _lint(path)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["R001", "W001"]      # finding stays active...
+    assert all(not f.waived for f in findings)
+
+
+def test_waiver_wrong_rule_does_not_apply(tmp_path):
+    path = _write(tmp_path, WAIVABLE.format(
+        waiver="  # graftlint: disable=R005 mismatched rule id"))
+    (f,) = _lint(path)
+    assert f.rule == "R001" and not f.waived
+
+
+def test_multi_rule_waiver(tmp_path):
+    path = _write(tmp_path, WAIVABLE.format(
+        waiver="  # graftlint: disable=R001,R003 shared justification"))
+    (f,) = _lint(path)
+    assert f.waived and f.waiver_reason == "shared justification"
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + JSON schema
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_exit_1_on_findings_and_json_schema():
+    out = _cli(os.path.join(CORPUS, "r001_bad.py"), "--json")
+    assert out.returncode == 1, out.stderr
+    data = json.loads(out.stdout)
+    assert data["version"] == 1
+    assert data["files_scanned"] == 1
+    assert set(data["counts"]) == {"total", "waived", "active"}
+    assert data["counts"]["active"] > 0
+    for f in data["findings"]:
+        assert set(f) == {"rule", "file", "line", "col", "message",
+                          "waived", "waiver_reason"}
+        assert f["rule"] in set(ALL_RULES) | {"W001", "E999"}
+
+
+def test_cli_exit_0_on_clean():
+    out = _cli(os.path.join(CORPUS, "r001_clean.py"))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_cli_exit_2_on_bad_path_and_unknown_rule():
+    assert _cli("definitely/not/a/path.py").returncode == 2
+    assert _cli(os.path.join(CORPUS, "r001_clean.py"),
+                "--select", "R999").returncode == 2
+
+
+def test_cli_select_limits_rules():
+    out = _cli(os.path.join(CORPUS, "r001_bad.py"), "--json",
+               "--select", "R002")
+    assert out.returncode == 0    # only R001 findings live in that file
+    assert json.loads(out.stdout)["counts"]["total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# sentinel <-> registry agreement (the ISSUE's bugfix satellite)
+# ---------------------------------------------------------------------------
+
+def _registered_watch_names():
+    """Watch names armed with registered=True, read from the source of
+    every file in the compile-once registry."""
+    names = set()
+    for rel in scopes.COMPILE_ONCE_JITS:
+        with open(os.path.join(REPO, rel)) as fh:
+            tree = ast.parse(fh.read())
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "watch"):
+                continue
+            if not any(k.arg == "registered"
+                       and isinstance(k.value, ast.Constant)
+                       and k.value.value is True
+                       for k in node.keywords):
+                continue
+            assert node.args and isinstance(node.args[0], ast.Constant)
+            names.add(node.args[0].value)
+    return names
+
+
+def test_sentinel_watches_match_registry():
+    armed = _registered_watch_names()
+    assert armed == set(scopes.RETRACE_WATCHES), (
+        "RetraceSentinel registered watches and graftlint's "
+        "COMPILE_ONCE_JITS inventory drifted apart: "
+        f"armed-only={armed - scopes.RETRACE_WATCHES}, "
+        f"registry-only={set(scopes.RETRACE_WATCHES) - armed}")
+
+
+def test_registered_watch_rejects_unknown_path():
+    from ray_tpu.util.telemetry import RetraceSentinel
+    s = RetraceSentinel("t-registry")
+    with pytest.raises(ValueError, match="not a registered"):
+        s.watch("definitely_not_a_jit_path", lambda: 0, cap=1,
+                registered=True)
+    # registered names pass; ad-hoc names stay fine unregistered
+    s.watch("decode", lambda: 0, cap=1, registered=True)
+    s.watch("my_test_path", lambda: 0, cap=1)
+
+
+def test_registry_watch_names_only_from_inventory():
+    # every non-None watch name in the inventory is exported
+    from_inventory = {n for per in scopes.COMPILE_ONCE_JITS.values()
+                      for n in per.values() if n is not None}
+    assert from_inventory == set(scopes.RETRACE_WATCHES)
+
+
+# ---------------------------------------------------------------------------
+# R004 fixes: the blocking work really happens outside the locks
+# ---------------------------------------------------------------------------
+
+def test_engine_swap_releases_scheduler_lock_during_placement():
+    """update_params must hold the scheduler lock only for snapshot and
+    commit: while the (slow) host->device placement runs, ticks keep
+    going. Regression for the R004 finding this PR fixed."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import InferenceEngine
+
+    cfg = gpt.GPTConfig(vocab_size=128, d_model=32, n_layers=1,
+                        n_heads=2, d_ff=64, max_seq_len=64,
+                        dtype="float32")
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, slots=2, max_len=32,
+                          prefill_buckets=(8, 16))
+    fresh = jax.tree.map(lambda a: a + 1, gpt.init_params(
+        jax.random.PRNGKey(1), cfg))
+
+    placing = threading.Event()
+    release = threading.Event()
+    orig_place = eng._place_tree
+
+    def slow_place(old, new, what):
+        placing.set()
+        assert release.wait(10), "test deadlock"
+        return orig_place(old, new, what)
+
+    eng._place_tree = slow_place
+    errs = []
+
+    def do_swap():
+        try:
+            eng.update_params(fresh)
+        except Exception as exc:      # surface in the main thread
+            errs.append(exc)
+
+    t = threading.Thread(target=do_swap)
+    t.start()
+    assert placing.wait(10)
+    # mid-placement the scheduler lock must be FREE: a tick (or this
+    # acquire) must not wait behind the weight upload
+    acquired = eng._lock.acquire(timeout=2)
+    assert acquired, "scheduler lock held during weight placement"
+    eng._lock.release()
+    assert eng.params_version == 0    # commit hasn't happened yet
+    release.set()
+    t.join(10)
+    assert not t.is_alive() and not errs, errs
+    assert eng.params_version == 1
+    assert eng.stats()["swaps"] == 1
+
+
+def test_controller_shutdown_kills_outside_lock():
+    """graceful_shutdown snapshots-and-clears under the lock and kills
+    outside it: status()-style RPCs must not stall behind teardown."""
+    from ray_tpu.serve import controller as controller_mod
+
+    class _QuietController(controller_mod.ServeController):
+        def _reconcile_loop(self):
+            return                     # no reconcile thread activity
+
+    ctl = _QuietController()
+    st = controller_mod._DeploymentState("d", "app",
+                                         {"num_replicas": 2})
+    st.replicas = ["fake-r1", "fake-r2"]
+    ctl._deployments[("app", "d")] = st
+    ctl._graveyard.append(["fake-r3"])
+
+    killing = threading.Event()
+    release = threading.Event()
+    killed = []
+
+    def fake_kill(replicas):
+        killed.append(list(replicas))
+        killing.set()
+        assert release.wait(10), "test deadlock"
+
+    ctl._kill_replicas = fake_kill
+    t = threading.Thread(target=ctl.graceful_shutdown)
+    t.start()
+    assert killing.wait(10)
+    acquired = ctl._lock.acquire(timeout=2)
+    assert acquired, "controller lock held during replica kill"
+    # state was already cleared under the lock before any kill ran
+    assert ctl._deployments == {} and ctl._graveyard == []
+    ctl._lock.release()
+    release.set()
+    t.join(10)
+    assert not t.is_alive()
+    assert killed == [["fake-r1", "fake-r2"], ["fake-r3"]]
+
+
+# ---------------------------------------------------------------------------
+# engine jit index sanity (guards the registry against silent decay)
+# ---------------------------------------------------------------------------
+
+def test_engine_jit_anchors_match_inventory():
+    rel = "ray_tpu/serve/engine.py"
+    with open(os.path.join(REPO, rel)) as fh:
+        tree = ast.parse(fh.read())
+    astutil.add_parents(tree)
+    anchors = set(astutil.build_jit_index(tree).by_anchor)
+    assert anchors == set(scopes.COMPILE_ONCE_JITS[rel])
