@@ -14,7 +14,6 @@ from __future__ import annotations
 import glob
 import os
 import threading
-from multiprocessing import connection
 
 from ray_tpu._private import protocol
 from ray_tpu._private.constants import SESSION_PREFIX
@@ -56,9 +55,10 @@ class AttachClient:
             if authkey is None:
                 with open(os.path.join(session_dir, "authkey"), "rb") as f:
                     authkey = f.read()
-            self._conn = connection.Client(
-                os.path.join(session_dir, "node.sock"),
-                family="AF_UNIX", authkey=authkey)
+            # via netaddr.client so the fault-injection wrap (delay/drop
+            # of control messages) covers UDS attach channels too
+            self._conn = netaddr.client(
+                os.path.join(session_dir, "node.sock"), authkey)
         # unique per client, not per process: two AttachClients in one
         # process must not collide on the server's worker table
         import uuid
@@ -111,9 +111,14 @@ class AttachClient:
             ok = self._have.wait_for(
                 lambda: rid in self._replies or -1 in self._replies,
                 timeout=timeout)
-            if not ok or -1 in self._replies and rid not in self._replies:
-                raise ConnectionError(
-                    "session control channel closed or timed out")
+            if -1 in self._replies and rid not in self._replies:
+                raise ConnectionError("session control channel closed")
+            if not ok:
+                # typed: a lost/unanswered control message is a timeout,
+                # not a dead channel — callers can retry on it
+                from ray_tpu.exceptions import GetTimeoutError
+                raise GetTimeoutError(
+                    f"control({method!r}) got no reply within {timeout}s")
             reply = self._replies.pop(rid)
         if reply.error:
             raise RuntimeError(reply.error)

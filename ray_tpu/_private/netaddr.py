@@ -38,7 +38,12 @@ def fmt(address) -> str:
 def client(address, authkey: bytes):
     addr = parse(address)
     family = "AF_INET" if isinstance(addr, tuple) else "AF_UNIX"
-    return connection.Client(addr, family=family, authkey=authkey)
+    conn = connection.Client(addr, family=family, authkey=authkey)
+    # Fault injection seam: while a FaultPlan with netaddr.* sites is
+    # installed, new outbound channels get the delay/drop proxy (the
+    # authkey handshake above always runs on the raw socket).
+    from ray_tpu.util import faults
+    return faults.maybe_wrap_connection(conn, "netaddr")
 
 
 def listener(address, authkey: bytes):

@@ -82,6 +82,12 @@ class TaskCancelledError(RayTpuError):
     """The task was cancelled before/while running."""
 
 
+class OverloadedError(RayTpuError):
+    """The serving layer shed this request under overload (queue bound
+    or block-pool high-water mark) instead of queueing it unboundedly.
+    Back off and retry later — the HTTP proxy maps it to 429."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Preparing a task/actor runtime environment failed."""
 

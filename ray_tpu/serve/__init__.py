@@ -18,8 +18,9 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.exceptions import OverloadedError
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.handle import DeploymentHandle, token_resume
 from ray_tpu.serve.http_proxy import Request
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import StreamingResponse
@@ -30,7 +31,7 @@ __all__ = [
     "shutdown", "delete", "set_route", "get_deployment_handle",
     "DeploymentHandle", "batch", "Request", "StreamingResponse",
     "multiplexed", "get_multiplexed_model_id", "apply_config",
-    "build_app_from_config",
+    "build_app_from_config", "OverloadedError", "token_resume",
     "InferenceEngine", "InferenceReplica",
 ]
 

@@ -16,6 +16,7 @@ mutated concurrently and a mid-flight redeploy cannot leak actors.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -27,9 +28,15 @@ logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 from ray_tpu._private.constants import (
+    SERVE_BREAKER_COOLDOWN_S,
+    SERVE_BREAKER_PROBE_S,
+    SERVE_BREAKER_THRESHOLD,
+    SERVE_BREAKER_WINDOW_S,
     SERVE_DOWNSCALE_DELAY_S,
     SERVE_DRAIN_POLL_S,
     SERVE_DRAIN_TIMEOUT_S,
+    SERVE_HEALTH_FAILURE_THRESHOLD,
+    SERVE_HEALTH_STARTUP_GRACE_S,
     SERVE_RECONCILE_PERIOD_S as _RECONCILE_PERIOD_S,
     SERVE_STATS_TIMEOUT_S,
 )
@@ -51,6 +58,14 @@ class _DeploymentState:
         # autoscaling smoothing (reference: autoscaling_policy.py
         # downscale_delay_s): scale down only after sustained low demand.
         self._downscale_candidate_since: float | None = None
+        # circuit breaker over replica deaths: closed (normal restarts)
+        # -> open (quarantine: deaths stop triggering restarts) ->
+        # half_open (one probe replica) -> closed on probe survival.
+        self.breaker = "closed"
+        self.breaker_opened_at = 0.0
+        self.death_times: collections.deque = collections.deque(maxlen=64)
+        self.probe_id = None
+        self.probe_since = 0.0
 
 
 class ServeController:
@@ -59,6 +74,26 @@ class ServeController:
         self._graveyard: list = []        # replica lists awaiting drain
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
+        # health plane knobs — instance state (seeded from constants) so
+        # configure_fault_tolerance can tune a live controller
+        self.health_failure_threshold = SERVE_HEALTH_FAILURE_THRESHOLD
+        self.health_startup_grace_s = SERVE_HEALTH_STARTUP_GRACE_S
+        self.breaker_threshold = SERVE_BREAKER_THRESHOLD
+        self.breaker_window_s = SERVE_BREAKER_WINDOW_S
+        self.breaker_cooldown_s = SERVE_BREAKER_COOLDOWN_S
+        self.breaker_probe_s = SERVE_BREAKER_PROBE_S
+        # per-replica health records (reconcile thread is sole writer)
+        self._strikes: dict = {}          # actor_id -> consecutive fails
+        self._born: dict = {}             # actor_id -> creation ts
+        self._healthy: set = set()        # actor_ids that ever passed
+        # fault-tolerance counters (stats() -> Prometheus bridge)
+        self._breaker_trips = 0
+        self._replicas_restarted = 0
+        self._health_check_failures = 0
+        from ray_tpu.util import telemetry as _telemetry
+        self._telemetry_name = _telemetry.register_stats_source(
+            _telemetry.next_name("serve_controller#"), self,
+            kind="serve_controller")
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile")
         self._thread.start()
@@ -120,9 +155,62 @@ class ServeController:
                     "message": st.message,
                     "replicas": len(st.replicas),
                     "target_replicas": st.target_num,
+                    "breaker": st.breaker,
                 }
                 for (app, name), st in self._deployments.items()
             }
+
+    def stats(self) -> dict:
+        """Serve-plane fault-tolerance counters, published to /metrics
+        through the stats->Prometheus bridge as ``serve_controller_*``
+        series (see util/telemetry.py).
+
+        - ``breaker_trips``: circuit-breaker open transitions across all
+          deployments (closed->open and half_open->open both count).
+        - ``replicas_restarted``: crashed/struck-out replicas replaced
+          by reconcile (quarantined deaths are NOT restarted, so they
+          don't count).
+        - ``health_check_failures``: individual failed health pings,
+          including transient strikes that did not kill the replica.
+        - ``quarantined``: deployments whose breaker is currently open.
+        - ``deployments``: deployments under management.
+        """
+        with self._lock:
+            return {
+                "breaker_trips": self._breaker_trips,
+                "replicas_restarted": self._replicas_restarted,
+                "health_check_failures": self._health_check_failures,
+                "quarantined": sum(
+                    1 for st in self._deployments.values()
+                    if st.breaker == "open"),
+                "deployments": len(self._deployments),
+            }
+
+    def configure_fault_tolerance(self, **knobs) -> dict:
+        """Tune the live health plane (tests shrink windows; the
+        RAY_TPU_SERVE_* env constants are read at import time, so a
+        per-test override needs this RPC). Accepts any of:
+        health_failure_threshold, health_startup_grace_s,
+        breaker_threshold, breaker_window_s, breaker_cooldown_s,
+        breaker_probe_s. Returns the effective settings."""
+        allowed = ("health_failure_threshold", "health_startup_grace_s",
+                   "breaker_threshold", "breaker_window_s",
+                   "breaker_cooldown_s", "breaker_probe_s")
+        for k, v in knobs.items():
+            if k not in allowed:
+                raise ValueError(f"unknown fault-tolerance knob: {k!r}")
+            setattr(self, k, type(getattr(self, k))(v))
+        return {k: getattr(self, k) for k in allowed}
+
+    def inject_faults(self, plan) -> bool:
+        """Install a `util.faults.FaultPlan` in the CONTROLLER process
+        (sites like ``controller.health_ping``); None clears it."""
+        from ray_tpu.util import faults
+        if plan is None:
+            faults.clear()
+        else:
+            faults.install(plan)
+        return True
 
     def graceful_shutdown(self) -> bool:
         self._shutdown.set()
@@ -199,7 +287,7 @@ class ServeController:
         opts.setdefault("num_cpus", 0.1)
         opts["max_concurrency"] = st.spec.get("max_concurrent_queries", 8)
         actor_cls = ray_tpu.remote(**opts)(Replica)
-        return actor_cls.remote({
+        r = actor_cls.remote({
             "callable": st.spec["callable"],
             "init_args": st.spec.get("init_args", ()),
             "init_kwargs": st.spec.get("init_kwargs", {}),
@@ -207,27 +295,136 @@ class ServeController:
             "max_concurrent_queries":
                 st.spec.get("max_concurrent_queries", 8),
         })
+        self._born[r._actor_id] = time.time()
+        return r
 
-    def _health_check(self, replicas: list) -> list:
-        """Parallel health checks; returns the live subset."""
-        futs = {}
-        for r in replicas:
-            try:
-                futs[r.check_health.remote()] = r
-            except _exc.RayTpuError:
-                pass
-        if not futs:
-            return []
-        ready, not_ready = ray_tpu.wait(
-            list(futs), num_returns=len(futs), timeout=10)
+    def _health_check(self, replicas: list) -> tuple[list, list]:
+        """Parallel, strike-based health checks.
+
+        Returns ``(alive, deaths)``. A replica only moves to ``deaths``
+        when its death is authoritative (the actor table says so:
+        ActorDiedError / WorkerCrashedError) or it has failed
+        ``health_failure_threshold`` CONSECUTIVE pings — one transient
+        blip (GC pause, long engine tick) no longer kills a warm
+        replica. Replicas that never passed a ping get a startup grace
+        window (``health_startup_grace_s``) during which soft failures
+        don't strike; real crashes still count immediately.
+        """
+        from ray_tpu.util import faults
+        now = time.time()
+        round_down = False
+        try:
+            # fault site: the CONTROLLER's probe fan-out fails this round
+            # (e.g. a partitioned control plane) — every replica looks
+            # unreachable at once; strikes must absorb it.
+            faults.check("controller.health_ping")
+        except faults.FaultInjected:
+            round_down = True
+        futs, dead, soft = {}, [], []
+        if not round_down:
+            for r in replicas:
+                try:
+                    futs[r.check_health.remote()] = r
+                except _exc.RayTpuError:
+                    dead.append(r)     # can't even submit: authoritative
         alive = []
-        for fut in ready:
-            try:
-                ray_tpu.get(fut)
-                alive.append(futs[fut])
-            except _exc.RayTpuError:
-                logger.warning("replica failed health check")
-        return alive
+        if futs:
+            ready, not_ready = ray_tpu.wait(
+                list(futs), num_returns=len(futs), timeout=10)
+            for fut in ready:
+                r = futs[fut]
+                try:
+                    ray_tpu.get(fut)
+                    aid = r._actor_id
+                    self._strikes.pop(aid, None)
+                    self._healthy.add(aid)
+                    alive.append(r)
+                except (_exc.ActorDiedError, _exc.WorkerCrashedError):
+                    dead.append(r)     # actor table: authoritative
+                except _exc.RayTpuError:
+                    soft.append(r)     # user check_health raised: strike
+            for fut in not_ready:
+                soft.append(futs[fut])  # ping timed out: strike
+        else:
+            soft.extend(replicas)
+        for r in soft:
+            aid = r._actor_id
+            self._health_check_failures += 1
+            if aid not in self._healthy and \
+                    now - self._born.get(aid, now) < \
+                    self.health_startup_grace_s:
+                alive.append(r)        # still starting up: probation
+                continue
+            strikes = self._strikes.get(aid, 0) + 1
+            self._strikes[aid] = strikes
+            if strikes >= self.health_failure_threshold:
+                logger.warning(
+                    "replica %s failed %d consecutive health checks",
+                    aid, strikes)
+                dead.append(r)
+            else:
+                logger.warning(
+                    "replica %s failed health check (strike %d/%d)",
+                    aid, strikes, self.health_failure_threshold)
+                alive.append(r)
+        for r in dead:
+            aid = r._actor_id
+            self._strikes.pop(aid, None)
+            self._born.pop(aid, None)
+            self._healthy.discard(aid)
+        return alive, dead
+
+    def _trip_breaker(self, st: _DeploymentState, now: float) -> None:
+        with self._lock:
+            st.breaker = "open"
+            st.breaker_opened_at = now
+            st.probe_id = None
+            st.probe_since = 0.0
+            self._breaker_trips += 1
+            st.message = (f"circuit breaker open: {len(st.death_times)} "
+                          f"replica deaths within {self.breaker_window_s}s")
+        logger.warning("deployment %s:%s quarantined (%s)",
+                       st.app_name, st.name, st.message)
+
+    def _update_breaker(self, st: _DeploymentState, deaths: list,
+                        now: float) -> None:
+        """Advance the per-deployment circuit breaker.
+
+        closed: deaths within ``breaker_window_s`` accumulate; at
+        ``breaker_threshold`` the breaker opens (replacements stop — a
+        crash-looping deployment must not burn the cluster respawning).
+        open: after ``breaker_cooldown_s`` move to half_open.
+        half_open: reconcile creates exactly ONE probe replica; if it
+        stays healthy for ``breaker_probe_s`` the breaker closes and the
+        death history clears, if it dies the breaker re-opens.
+        """
+        probe_died = st.probe_id is not None and any(
+            r._actor_id == st.probe_id for r in deaths)
+        if st.breaker == "closed":
+            recent = [t for t in st.death_times
+                      if now - t <= self.breaker_window_s]
+            if len(recent) >= self.breaker_threshold:
+                self._trip_breaker(st, now)
+        elif st.breaker == "open":
+            if now - st.breaker_opened_at >= self.breaker_cooldown_s:
+                with self._lock:
+                    st.breaker = "half_open"
+                    st.probe_id = None
+                    st.probe_since = 0.0
+        elif st.breaker == "half_open":
+            if probe_died:
+                self._trip_breaker(st, now)
+            elif (st.probe_id is not None and st.probe_since
+                  and st.probe_id in self._healthy
+                  and now - st.probe_since >= self.breaker_probe_s):
+                with self._lock:
+                    st.breaker = "closed"
+                    st.death_times.clear()
+                    st.probe_id = None
+                    st.probe_since = 0.0
+                    st.message = ""
+                logger.info("deployment %s:%s breaker closed after "
+                            "healthy probe", st.app_name, st.name)
 
     def _reconcile_one(self, st: _DeploymentState) -> None:
         # adopt a pending redeploy: retire every old replica
@@ -246,8 +443,16 @@ class ServeController:
                 st.replicas = []
                 st.version += 1
 
-        alive = self._health_check(st.replicas)
+        alive, deaths = self._health_check(st.replicas)
         changed = len(alive) != len(st.replicas)
+        now = time.time()
+        if deaths:
+            st.death_times.extend(now for _ in deaths)
+            # struck-out replicas may still be live processes wedged in a
+            # bad state — reap them so they can't linger half-attached
+            # (authoritative-dead ones make this a fast no-op)
+            self._kill_replicas(deaths)
+        self._update_breaker(st, deaths, now)
 
         replica_stats = None
         if st.autoscaling and alive:
@@ -284,9 +489,27 @@ class ServeController:
             except _exc.RayTpuError:
                 pass
 
-        while len(alive) < st.target_num:
-            alive.append(self._make_replica(st))
+        # breaker gates replacement: open = no new replicas at all
+        # (quarantine), half_open = at most one probe beyond survivors
+        allow = st.target_num
+        if st.breaker == "open":
+            allow = len(alive)
+        elif st.breaker == "half_open":
+            allow = min(st.target_num,
+                        len(alive) + (0 if st.probe_id is not None else 1))
+        n_created = 0
+        while len(alive) < allow:
+            r = self._make_replica(st)
+            alive.append(r)
             changed = True
+            n_created += 1
+            if st.breaker == "half_open" and st.probe_id is None:
+                with self._lock:
+                    st.probe_id = r._actor_id
+                    st.probe_since = time.time()
+        if deaths and n_created:
+            with self._lock:
+                self._replicas_restarted += min(len(deaths), n_created)
         if len(alive) > st.target_num:
             if replica_stats and len(replica_stats) == len(alive):
                 order = sorted(range(len(alive)),
@@ -317,7 +540,8 @@ class ServeController:
             st.replicas = alive
             if changed:
                 st.version += 1
-            st.status = ("RUNNING" if len(alive) == st.target_num
+            st.status = ("QUARANTINED" if st.breaker == "open"
+                         else "RUNNING" if len(alive) == st.target_num
                          else "UPDATING")
 
     def _reconcile_once(self) -> None:
@@ -331,6 +555,15 @@ class ServeController:
                 self._reconcile_one(st)
             except Exception:
                 logger.exception("reconcile of %s failed", st.name)
+        # drop health records for replicas retired by scale-down/redeploy
+        # (death-path records are cleaned inline by _health_check)
+        with self._lock:
+            live = {r._actor_id for s in self._deployments.values()
+                    for r in s.replicas}
+        for rec in (self._strikes, self._born):
+            for aid in [a for a in rec if a not in live]:
+                rec.pop(aid, None)
+        self._healthy &= live
 
     def _reconcile_loop(self) -> None:
         while not self._shutdown.is_set():

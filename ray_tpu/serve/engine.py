@@ -67,11 +67,17 @@ blocks and queues automatically (generator finalization cancels it).
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ray_tpu.exceptions import OverloadedError
+from ray_tpu.util import faults as _faults
+
+logger = logging.getLogger("ray_tpu.serve")
 
 
 class TokenEvent(int):
@@ -444,7 +450,10 @@ class InferenceEngine:
                  draft_params=None, draft_cfg=None,
                  draft_cache_blocks: int | None = None,
                  mesh=None, seed: int = 0,
-                 telemetry_sample: float | None = None):
+                 telemetry_sample: float | None = None,
+                 max_queue: int | None = None,
+                 shed_high_water: float | None = None,
+                 watchdog_s: float | None = None):
         import jax
         import jax.numpy as jnp
         from ray_tpu.models import gpt
@@ -696,6 +705,30 @@ class InferenceEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
 
+        # --- graceful degradation: admission shedding + tick watchdog.
+        # Both OFF by default: an engine with no bounds queues exactly as
+        # before (the autoscaler's queue_depth signal depends on queues
+        # being allowed to form). Opt in per deployment via
+        # engine_kwargs.
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if shed_high_water is not None and not 0.0 < shed_high_water <= 1.0:
+            raise ValueError("shed_high_water must be in (0, 1]")
+        self.max_queue = max_queue
+        self.shed_high_water = shed_high_water
+        self._sheds = 0
+        self._watchdog_s = watchdog_s
+        self._watchdog_stalls = 0
+        self._tick_seq = 0
+        self._tick_started: float | None = None
+        self._watchdog_stop = threading.Event()
+        if watchdog_s is not None:
+            if watchdog_s <= 0:
+                raise ValueError("watchdog_s must be > 0")
+            t = threading.Thread(target=self._watchdog_loop, daemon=True,
+                                 name="engine-watchdog")
+            t.start()
+
         # --- RL flywheel: in-place donated weight hot-swap ------------
         # update_params() copies a new pytree INTO the old params'
         # device buffers (donation lets XLA alias input->output leaf by
@@ -755,6 +788,48 @@ class InferenceEngine:
         self._sentinel.arm()
 
     # ------------------------------------------------------------------
+    # watchdog + admission shedding
+    # ------------------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Detect a stuck scheduler tick: sample the in-progress tick's
+        start time (lock-free reads — the watchdog must keep working
+        precisely when the lock holder is wedged) and count + WARN once
+        per tick that overruns the budget."""
+        flagged = -1
+        while not self._watchdog_stop.wait(self._watchdog_s / 4):
+            started, seq = self._tick_started, self._tick_seq
+            if (started is not None and seq != flagged
+                    and time.perf_counter() - started > self._watchdog_s):
+                flagged = seq
+                self._watchdog_stalls += 1
+                logger.warning(
+                    "engine %s: scheduler tick %d stuck for > %.2fs",
+                    getattr(self, "name", "?"), seq, self._watchdog_s)
+
+    def _shed_verdict(self, n_blocks: int) -> str | None:
+        """Overload decision for one admission of `n_blocks` footprint;
+        called under the lock. None = admit; else the reason string."""
+        if self.max_queue is not None and \
+                len(self._pending) >= self.max_queue:
+            return (f"queue full ({len(self._pending)} >= "
+                    f"max_queue {self.max_queue})")
+        if self.shed_high_water is not None:
+            # Projected utilization: live blocks + the committed
+            # footprints already queued + this request. Using the
+            # projection (not just instantaneous usage) keeps a burst of
+            # submits between two ticks from overshooting the mark.
+            queued = sum(
+                self._blocks_for(q.prompt.size, q.max_new_tokens)
+                for q in self._pending)
+            projected = (self._alloc.used + queued + n_blocks) \
+                / max(self.cache_blocks, 1)
+            if projected > self.shed_high_water:
+                return (f"projected block utilization {projected:.2f} > "
+                        f"high water {self.shed_high_water:.2f}")
+        return None
+
+    # ------------------------------------------------------------------
     # request side
     # ------------------------------------------------------------------
 
@@ -792,6 +867,14 @@ class InferenceEngine:
                 f"request footprint exceeds draft cache blocks "
                 f"{self.draft_cache_blocks}")
         with self._lock:
+            if self.max_queue is not None or \
+                    self.shed_high_water is not None:
+                reason = self._shed_verdict(
+                    self._blocks_for(prompt.size, max_new_tokens))
+                if reason is not None:
+                    self._sheds += 1
+                    raise OverloadedError(
+                        f"engine overloaded, request shed: {reason}")
             rid = self._rid
             self._rid += 1
             self._out[rid] = collections.deque()
@@ -1168,6 +1251,10 @@ class InferenceEngine:
         """Route one generated token (as a `TokenEvent` carrying its
         logprob and params_version); retire the slot (releasing its
         blocks) when finished."""
+        # fault site: 'kill' here is the deterministic
+        # kill-replica-at-step — the process dies between token N and
+        # N+1, exactly what mid-stream failover must survive
+        _faults.check("engine.emit")
         ev = TokenEvent(tok, logp,
                         self._params_version if ver is None else ver)
         if self._swap_pending_ts is not None:
@@ -1195,28 +1282,41 @@ class InferenceEngine:
         resident sequence. Returns True if any device work happened."""
         with self._lock:
             t_tick = time.perf_counter()
-            had_decoders = any(s.phase == "decode" for s in self._slots)
-            admitted = self._admit_pending()
-            chunked = self._prefill_tick(had_decoders)
-            if had_decoders and (admitted or chunked):
-                self._max_admission_stall = max(
-                    self._max_admission_stall,
-                    time.perf_counter() - t_tick)
-            active = [i for i, s in enumerate(self._slots) if s.active]
-            self._occupancy.append(len(active) / self.num_slots)
-            self._block_util.append(
-                self._alloc.used / max(self.cache_blocks, 1))
-            decoding = [i for i, s in enumerate(self._slots)
-                        if s.phase == "decode"]
-            if not decoding:   # idle, or every admission finished early
+            # watchdog window: seq first, then start ts, cleared in the
+            # finally — a fault-failed tick must not read as stuck forever
+            self._tick_seq += 1
+            self._tick_started = t_tick
+            try:
+                # fault site: 'fail' surfaces FaultInjected to the
+                # pumping consumer; 'delay' wedges the tick (what the
+                # watchdog exists to catch)
+                _faults.check("engine.tick")
+                had_decoders = any(
+                    s.phase == "decode" for s in self._slots)
+                admitted = self._admit_pending()
+                chunked = self._prefill_tick(had_decoders)
+                if had_decoders and (admitted or chunked):
+                    self._max_admission_stall = max(
+                        self._max_admission_stall,
+                        time.perf_counter() - t_tick)
+                active = [i for i, s in enumerate(self._slots)
+                          if s.active]
+                self._occupancy.append(len(active) / self.num_slots)
+                self._block_util.append(
+                    self._alloc.used / max(self.cache_blocks, 1))
+                decoding = [i for i, s in enumerate(self._slots)
+                            if s.phase == "decode"]
+                if not decoding:  # idle, or admissions finished early
+                    self._sentinel.check()
+                    return admitted or chunked
+                if self.spec is not None:
+                    self._spec_tick(decoding)
+                else:
+                    self._decode_tick(decoding)
                 self._sentinel.check()
-                return admitted or chunked
-            if self.spec is not None:
-                self._spec_tick(decoding)
-            else:
-                self._decode_tick(decoding)
-            self._sentinel.check()
-            return True
+                return True
+            finally:
+                self._tick_started = None
 
     def _dev(self, name: str, arr):
         """Host array -> device, through the replicated per-step input
@@ -1427,6 +1527,8 @@ class InferenceEngine:
             self._spec_proposed = self._spec_accepted = 0
             self._swaps = 0
             self._last_swap_ms = 0.0
+            self._sheds = 0
+            self._watchdog_stalls = 0
 
     def stats(self) -> dict:
         """The engine's one stats contract — this dict feeds the serve
@@ -1496,6 +1598,15 @@ class InferenceEngine:
           ``weight_swap_ms`` — last measured update_params-call to
           first-post-swap-token latency (0.0 until a post-swap token
           lands).
+
+        Fault tolerance (serve-plane robustness counters):
+          ``sheds`` — admissions refused with `OverloadedError` because
+          the pending queue hit ``max_queue`` or projected block-pool
+          utilization crossed ``shed_high_water`` (both 0 when the
+          knobs are off — the default).
+          ``watchdog_stalls`` — scheduler ticks the watchdog thread saw
+          overrun ``watchdog_s`` (always present; 0 with the watchdog
+          disabled). Each stall also logs one WARN.
         """
         with self._lock:
             self._sentinel.check()   # surface retraces since last tick
@@ -1576,6 +1687,9 @@ class InferenceEngine:
                 "swaps": self._swaps,
                 "weight_swap_ms": self._last_swap_ms,
                 "swap_traces": self.swap_traces,
+                # fault tolerance
+                "sheds": self._sheds,
+                "watchdog_stalls": self._watchdog_stalls,
             }
 
 

@@ -6,6 +6,14 @@ assignment, `_try_assign_replica` :747). The handle keeps a local view of
 the replica set (refreshed from the controller, the reference's long-poll
 `LongPollClient` :69) and routes each call to the less-loaded of two
 random replicas, tracking in-flight counts client-side.
+
+Fault tolerance (see README "Serve fault tolerance"): `call` retries
+replica-death failures with capped exponential backoff + jitter under an
+optional cross-attempt deadline budget, and `stream` can fail over
+mid-stream — on replica loss it resubmits the prompt plus the
+already-emitted tokens to a healthy replica as a fresh prefill
+(`token_resume`) and splices the continuation, so a greedy decode stream
+completes token-identical to an unkilled run.
 """
 
 from __future__ import annotations
@@ -20,7 +28,68 @@ from ray_tpu import exceptions as _exc
 
 from ray_tpu._private.constants import (
     SERVE_HANDLE_REFRESH_S as _REFRESH_PERIOD_S,
+    SERVE_RETRY_BASE_S,
+    SERVE_RETRY_CAP_S,
+    SERVE_RETRY_MAX_ATTEMPTS,
+    SERVE_STREAM_FAILOVERS,
 )
+
+
+class _HandleStats:
+    """Process-wide resilience counters for every handle in this process,
+    published through the stats->Prometheus bridge as `serve_handle_*`
+    series (util/telemetry.py)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._retries = 0
+        self._failovers = 0
+        from ray_tpu.util import telemetry as _telemetry
+        _telemetry.register_stats_source(
+            _telemetry.next_name("serve_handle#"), self,
+            kind="serve_handle")
+
+    def bump(self, key: str) -> None:
+        with self._mu:
+            setattr(self, f"_{key}", getattr(self, f"_{key}") + 1)
+
+    def stats(self) -> dict:
+        """Resilience counters: ``retries`` is replica-death call
+        reattempts, ``failovers`` is mid-stream replica replacements."""
+        with self._mu:
+            return {"retries": self._retries,
+                    "failovers": self._failovers}
+
+
+HANDLE_STATS = _HandleStats()
+
+
+def token_resume(args, kwargs, emitted):
+    """Default `DeploymentHandle.stream` failover policy for token
+    generation: rebuild the submission so a fresh replica prefills
+    `prompt + emitted` and decodes only the remainder. Greedy decode over
+    replicas with identical weights makes the spliced stream
+    token-identical to an unkilled run.
+
+    Returns `(args, kwargs)` for the resubmission, or None when the
+    token budget is already exhausted (the stream is simply complete).
+    Raises TypeError/ValueError when the stream's chunks are not token
+    ids — the caller then re-raises the original replica death, since a
+    generic byte stream cannot be replayed safely."""
+    if not args:
+        raise TypeError("token_resume needs the prompt as args[0]")
+    prompt = list(args[0]) + [int(t) for t in emitted]
+    if "max_new_tokens" in kwargs:
+        remaining = int(kwargs["max_new_tokens"]) - len(emitted)
+        if remaining <= 0:
+            return None
+        return (prompt, *args[1:]), {**kwargs, "max_new_tokens": remaining}
+    if len(args) >= 2:
+        remaining = int(args[1]) - len(emitted)
+        if remaining <= 0:
+            return None
+        return (prompt, remaining, *args[2:]), kwargs
+    return (prompt,), kwargs
 
 
 class _RouterState:
@@ -34,6 +103,9 @@ class _RouterState:
         self.outstanding: dict = {}
         self.last_refresh = 0.0
         self.lock = threading.Lock()
+        # serializes controller round-trips; the router lock above must
+        # stay block-free (routing hot path), so the RPC happens here
+        self.refresh_lock = threading.Lock()
         self.version = -1
 
 
@@ -111,22 +183,30 @@ class DeploymentHandle:
         now = time.time()
         if not force and now - self._last_refresh < _REFRESH_PERIOD_S:
             return
-        with self._lock:
-            if not force and now - self._last_refresh < _REFRESH_PERIOD_S:
-                return
+        # Controller RPC under the dedicated (blocking-ok) refresh lock;
+        # the router lock only brackets the snapshot and the commit, so
+        # routing never stalls behind a slow controller round-trip.
+        with self._router.refresh_lock:
+            with self._lock:
+                if not force and \
+                        now - self._last_refresh < _REFRESH_PERIOD_S:
+                    return
+                known = self._version
             info = ray_tpu.get(
                 self._controller().get_replicas.remote(
-                    self.deployment_name, self.app_name, self._version),
+                    self.deployment_name, self.app_name, known),
                 timeout=30)
-            if info is not None:
-                version, replicas = info
-                self._version = version
-                self._replicas = list(replicas)
-                live_ids = {r._actor_id for r in replicas}
-                self._outstanding = {
-                    aid: refs for aid, refs in self._outstanding.items()
-                    if aid in live_ids}
-            self._last_refresh = now
+            with self._lock:
+                if info is not None:
+                    version, replicas = info
+                    self._version = version
+                    self._replicas = list(replicas)
+                    live_ids = {r._actor_id for r in replicas}
+                    self._outstanding = {
+                        aid: refs
+                        for aid, refs in self._outstanding.items()
+                        if aid in live_ids}
+                self._last_refresh = now
 
     def _load(self, actor_id) -> int:
         """In-flight count for one replica: prune completed refs
@@ -160,18 +240,23 @@ class DeploymentHandle:
             if len(refs) > self._MAX_TRACKED:
                 del refs[:-self._MAX_TRACKED]
 
-    def _pick_replica(self):
+    def _pick_replica(self, exclude: frozenset = frozenset()):
         """Power-of-two-choices on client-side in-flight counts
-        (reference: router.py _try_assign_replica)."""
+        (reference: router.py _try_assign_replica). `exclude` drops
+        replicas known-dead to this caller (mid-stream failover must not
+        resubmit to the corpse before the controller notices it)."""
         self._refresh()
-        replicas = self._replicas
+        replicas = [r for r in self._replicas
+                    if r._actor_id not in exclude]
         if not replicas:
-            # cold start: block until the deployment has replicas
+            # cold start (or every replica excluded): block until the
+            # deployment has a usable replica
             deadline = time.time() + 60
             while time.time() < deadline:
                 self._refresh(force=True)
-                if self._replicas:
-                    replicas = self._replicas
+                replicas = [r for r in self._replicas
+                            if r._actor_id not in exclude]
+                if replicas:
                     break
                 time.sleep(0.1)
             else:
@@ -197,11 +282,12 @@ class DeploymentHandle:
         """-> ObjectRef of the user callable's result."""
         return self.remote_detailed(*args, **kwargs)[0]
 
-    def remote_detailed(self, *args, **kwargs):
+    def remote_detailed(self, *args, _exclude: frozenset = frozenset(),
+                        **kwargs):
         """-> (ObjectRef, replica_handle). The replica identity lets a
         caller continue a replica-side streaming session (the proxy's
         chunk drain) against the replica that holds the generator."""
-        replica = self._pick_replica()
+        replica = self._pick_replica(_exclude)
         if self._model_id:
             kwargs = {**kwargs,
                       "__multiplexed_model_id__": self._model_id}
@@ -209,44 +295,142 @@ class DeploymentHandle:
         self._record(replica._actor_id, ref)
         return ref, replica
 
-    def stream(self, *args, timeout: Optional[float] = 120.0, **kwargs):
+    def stream(self, *args, timeout: Optional[float] = 120.0,
+               deadline_s: Optional[float] = None,
+               failover=token_resume,
+               max_failovers: Optional[int] = None, **kwargs):
         """Python-side streaming consumption: yields chunks of a
-        generator/StreamingResponse deployment result."""
-        import ray_tpu
-        from ray_tpu.serve.replica import STREAM_MARKER
-        ref, replica = self.remote_detailed(*args, **kwargs)
-        result = ray_tpu.get(ref, timeout=timeout)
-        if not (isinstance(result, dict) and STREAM_MARKER in result):
-            yield result
-            return
-        sid = result[STREAM_MARKER]
-        try:
-            while True:
-                chunks, done = ray_tpu.get(
-                    replica.next_chunks.remote(sid), timeout=timeout)
-                if chunks is None:
-                    raise RuntimeError(
-                        f"stream {sid} expired on the replica (idle TTL)")
-                yield from chunks
-                if done:
-                    return
-        except GeneratorExit:
-            try:
-                replica.cancel_stream.remote(sid)
-            except Exception:
-                pass
-            raise
+        generator/StreamingResponse deployment result.
 
-    def call(self, *args, timeout: Optional[float] = 60.0, **kwargs):
-        """Synchronous convenience: remote + get."""
-        last_err = None
-        for _ in range(3):      # retry through replica death (rollouts)
+        Resilience: when the serving replica dies mid-stream and a
+        `failover` policy is set (default `token_resume`), the handle
+        resubmits `failover(args, kwargs, emitted_chunks)` to a healthy
+        replica (the dead one excluded) and splices the continuation —
+        up to `max_failovers` times. Chunks the policy can't replay
+        (non-token streams) re-raise the original death. On ANY abnormal
+        exit — abandoned generator, timeout, error — the replica-side
+        stream is cancelled so its generator can't leak until the idle
+        TTL. `deadline_s` caps total wall time across failovers."""
+        from ray_tpu.exceptions import GetTimeoutError
+        from ray_tpu.serve.replica import STREAM_MARKER
+        if max_failovers is None:
+            max_failovers = SERVE_STREAM_FAILOVERS
+        deadline = (time.monotonic() + deadline_s) if deadline_s else None
+
+        def left():
+            if deadline is None:
+                return timeout
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                raise GetTimeoutError(
+                    f"stream deadline of {deadline_s}s exhausted")
+            return rem if timeout is None else min(timeout, rem)
+
+        emitted: list = []
+        failovers = 0
+        cur_args, cur_kwargs = args, kwargs
+        exclude: set = set()
+        while True:     # one iteration per (re)submission
+            ref, replica = self.remote_detailed(
+                *cur_args, _exclude=frozenset(exclude), **cur_kwargs)
+            sid = None
+            finished = False
             try:
-                return ray_tpu.get(self.remote(*args, **kwargs),
-                                   timeout=timeout)
+                result = ray_tpu.get(ref, timeout=left())
+                if not (isinstance(result, dict)
+                        and STREAM_MARKER in result):
+                    finished = True
+                    yield result
+                    return
+                sid = result[STREAM_MARKER]
+                while True:
+                    chunks, done = ray_tpu.get(
+                        replica.next_chunks.remote(sid), timeout=left())
+                    if chunks is None:
+                        raise RuntimeError(
+                            f"stream {sid} expired on the replica "
+                            "(idle TTL)")
+                    for c in chunks:
+                        emitted.append(c)
+                        yield c
+                    if done:
+                        finished = True
+                        return
+            except (_exc.ActorDiedError, _exc.WorkerCrashedError) as death:
+                sid = None      # replica gone: nothing left to cancel
+                if failover is None or failovers >= max_failovers:
+                    raise
+                try:
+                    resume = failover(args, kwargs, tuple(emitted))
+                except (TypeError, ValueError):
+                    raise death from None    # chunks aren't replayable
+                failovers += 1
+                HANDLE_STATS.bump("failovers")
+                exclude.add(replica._actor_id)
+                self._refresh(force=True)
+                if resume is None:
+                    return      # budget exhausted at death: complete
+                cur_args, cur_kwargs = resume
+            finally:
+                # leak fix: cancel the replica-side stream on ANY
+                # abnormal exit (GeneratorExit from an abandoning
+                # caller, timeouts, errors) — not just GeneratorExit
+                if sid is not None and not finished:
+                    try:
+                        replica.cancel_stream.remote(sid)
+                    except Exception:
+                        pass
+
+    def call(self, *args, timeout: Optional[float] = 60.0,
+             deadline_s: Optional[float] = None,
+             max_retries: Optional[int] = None, **kwargs):
+        """Synchronous convenience: remote + get, with bounded retry.
+
+        Only replica-death failures are retried (the result can never
+        materialize; resubmission is the only way forward), with capped
+        exponential backoff + jitter between attempts. `deadline_s` is a
+        total wall-time budget ACROSS attempts — each retry's get
+        timeout and backoff shrink to fit what remains."""
+        if max_retries is None:
+            max_retries = SERVE_RETRY_MAX_ATTEMPTS
+        attempts = max(1, max_retries)
+        deadline = (time.monotonic() + deadline_s) if deadline_s else None
+        last_err = None
+        exclude: set = set()
+        for attempt in range(attempts):
+            t = timeout
+            if deadline is not None:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                t = rem if timeout is None else min(timeout, rem)
+            replica = None
+            try:
+                # exclusion: the router snapshot keeps a corpse listed
+                # until the controller's next reconcile — a retry must
+                # not land on a replica this caller just saw die
+                ref, replica = self.remote_detailed(
+                    *args, _exclude=frozenset(exclude), **kwargs)
+                return ray_tpu.get(ref, timeout=t)
             except (_exc.ActorDiedError, _exc.WorkerCrashedError) as e:
                 last_err = e
+                if replica is not None:
+                    exclude.add(replica._actor_id)
+                if attempt + 1 >= attempts:
+                    break
+                HANDLE_STATS.bump("retries")
                 self._refresh(force=True)
+                backoff = min(SERVE_RETRY_CAP_S,
+                              SERVE_RETRY_BASE_S * (2 ** attempt))
+                backoff *= 0.5 + random.random() / 2    # jitter
+                if deadline is not None:
+                    backoff = min(backoff,
+                                  max(0.0, deadline - time.monotonic()))
+                time.sleep(backoff)
+        if last_err is None:
+            from ray_tpu.exceptions import GetTimeoutError
+            raise GetTimeoutError(
+                f"call() deadline of {deadline_s}s exhausted")
         raise last_err
 
     # reference-API sugar: handle.method.remote(...)

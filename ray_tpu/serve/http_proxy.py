@@ -21,9 +21,15 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import json
+import random
 import threading
 from dataclasses import dataclass, field
 
+from ray_tpu._private.constants import (
+    SERVE_RETRY_BASE_S,
+    SERVE_RETRY_CAP_S,
+    SERVE_RETRY_MAX_ATTEMPTS,
+)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.replica import STREAM_MARKER
 
@@ -145,12 +151,34 @@ class HTTPProxy:
             headers=dict(request.headers),
             body=body)
         loop = asyncio.get_event_loop()
+        from ray_tpu import exceptions as _exc
+        attempts = max(1, SERVE_RETRY_MAX_ATTEMPTS)
         try:
-            ref, replica = await loop.run_in_executor(
-                self._pool, handle.remote_detailed, req)
-            result = await self._aget(ref)
+            for attempt in range(attempts):
+                try:
+                    ref, replica = await loop.run_in_executor(
+                        self._pool, handle.remote_detailed, req)
+                    result = await self._aget(ref)
+                    break
+                except (_exc.ActorDiedError,
+                        _exc.WorkerCrashedError):
+                    # safely retryable: nothing has been written to the
+                    # client yet and a dead replica can never deliver
+                    # the result. (Deaths mid-STREAM abort the chunked
+                    # response instead — the proxy can't rewind bytes
+                    # already on the wire; token-level failover lives in
+                    # DeploymentHandle.stream.)
+                    if attempt + 1 >= attempts:
+                        raise
+                    await loop.run_in_executor(
+                        self._pool,
+                        lambda: handle._refresh(force=True))
+                    delay = min(SERVE_RETRY_CAP_S,
+                                SERVE_RETRY_BASE_S * (2 ** attempt))
+                    await asyncio.sleep(
+                        delay * (0.5 + random.random() / 2))
         except Exception as e:
-            return web.Response(status=500, text=str(e))
+            return self._error_response(e)
         if isinstance(result, dict) and STREAM_MARKER in result:
             return await self._stream_out(request, replica, result)
         if isinstance(result, bytes):
@@ -160,6 +188,21 @@ class HTTPProxy:
         else:
             body, ctype = json.dumps(result).encode(), "application/json"
         return web.Response(status=200, body=body, content_type=ctype)
+
+    def _error_response(self, e: BaseException):
+        """Typed failure mapping: overload shedding surfaces as 429 with
+        a Retry-After hint (clients back off instead of hammering a full
+        engine), timeouts as 504; everything else stays 500."""
+        from aiohttp import web
+        from ray_tpu.exceptions import GetTimeoutError, OverloadedError
+        if isinstance(e, OverloadedError):
+            return web.json_response(
+                {"error": "overloaded", "detail": str(e)},
+                status=429, headers={"Retry-After": "1"})
+        if isinstance(e, (GetTimeoutError, TimeoutError,
+                          asyncio.TimeoutError)):
+            return web.Response(status=504, text=str(e))
+        return web.Response(status=500, text=str(e))
 
     async def _stream_out(self, request, replica, marker: dict):
         """Drain a replica-side generator into a chunked HTTP response

@@ -85,9 +85,24 @@ class Replica:
     def check_health(self) -> bool:
         """Reference: user-defined check_health on the deployment class
         (deployment_state.py health checks)."""
+        from ray_tpu.util import faults
+        # fault site: 'fail' = a missed ping (controller strikes it),
+        # 'kill' = the replica dies during the ping (a flap)
+        faults.check("replica.health_ping")
         fn = getattr(self.callable, "check_health", None)
         if fn is not None:
             fn()
+        return True
+
+    def install_faults(self, plan) -> bool:
+        """Install a `util.faults.FaultPlan` in THIS replica's process —
+        the chaos tests' lever for killing/failing one specific replica
+        at a deterministic point. Pass None to clear."""
+        from ray_tpu.util import faults
+        if plan is None:
+            faults.clear()
+        else:
+            faults.install(plan)
         return True
 
     def _enter(self):
@@ -235,7 +250,11 @@ class Replica:
         fields are merged in — the replica-level counters win on
         collision. `streams` counts still-registered response streams,
         which the controller's scale-down drain waits on alongside
-        `inflight`."""
+        `inflight` (and which the stream-leak regression test pins to 0
+        after handles abandon/time out). Engine-backed deployments also
+        merge the fault-tolerance counters (``sheds``,
+        ``watchdog_stalls`` — see `InferenceEngine.stats`), which the
+        telemetry bridge republishes as `replica_*` series."""
         with self._lock:
             out = {"inflight": self._inflight, "total": self._total,
                    "streams": len(self._streams),

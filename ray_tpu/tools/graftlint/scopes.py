@@ -22,8 +22,10 @@ SPMD = "ray_tpu/train/spmd.py"
 PREDICTOR = "ray_tpu/train/predictor.py"
 CONTROLLER = "ray_tpu/serve/controller.py"
 REPLICA = "ray_tpu/serve/replica.py"
+HANDLE = "ray_tpu/serve/handle.py"
 TELEMETRY = "ray_tpu/util/telemetry.py"
 METRICS = "ray_tpu/util/metrics.py"
+FAULTS = "ray_tpu/util/faults.py"
 
 # --- R001: functions whose bodies are latency-critical host code. A
 # host sync here stalls the device queue (or the scheduler tick).
@@ -130,12 +132,24 @@ LOCKS: dict[str, dict[str, LockSpec]] = {
     REPLICA: {
         "self._lock": LockSpec("serve.replica"),
     },
+    HANDLE: {
+        # router lock brackets routing state only — the failover/retry
+        # work (controller RPCs, backoff sleeps) must never run under it
+        "self._lock": LockSpec("serve.handle.router"),
+        "self._router.lock": LockSpec("serve.handle.router"),
+        "self._router.refresh_lock": LockSpec(
+            "serve.handle.refresh", blocking_ok=True),
+        "self._mu": LockSpec("serve.handle.stats"),
+    },
     TELEMETRY: {
         "_lock": LockSpec("telemetry.registry"),
     },
     METRICS: {
         "self.lock": LockSpec("metrics.registry"),
         "self._lock": LockSpec("metrics.series"),
+    },
+    FAULTS: {
+        "_lock": LockSpec("faults.registry"),
     },
 }
 
@@ -147,4 +161,7 @@ LOCK_ORDER: frozenset[tuple[str, str]] = frozenset({
     ("engine.scheduler", "telemetry.registry"),
     ("telemetry.registry", "metrics.registry"),
     ("metrics.registry", "metrics.series"),
+    # handle refresh: controller RPC under the blocking-ok refresh lock,
+    # snapshot/commit under the router lock
+    ("serve.handle.refresh", "serve.handle.router"),
 })

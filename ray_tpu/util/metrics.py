@@ -206,6 +206,14 @@ def flush() -> None:
     _registry.flush_now()
 
 
+def ensure_flusher() -> None:
+    """Start the worker→driver flush loop even if no Metric exists in
+    this process yet. Collect-hook-only sources (register_stats_source)
+    create their metrics lazily at the first snapshot — which only the
+    flusher takes in a worker, so they must be able to start it."""
+    _registry._ensure_flusher()
+
+
 def merge_snapshots(snapshots: list[list[dict]]) -> list[dict]:
     """Aggregate per-process snapshots (driver side): counters/histograms
     sum across processes; gauges keep the last writer."""

@@ -437,6 +437,9 @@ COUNTER_KEYS = frozenset({
     "prefix_hit_tokens", "cow_copies", "evicted_blocks", "cancelled",
     "swaps", "spec_steps", "total", "snapshots", "commits", "stalls",
     "fetches", "iterations",
+    # serve-plane fault tolerance (handle/engine/controller stats)
+    "retries", "failovers", "sheds", "watchdog_stalls",
+    "breaker_trips", "replicas_restarted", "health_check_failures",
 })
 
 _sources: dict[str, tuple] = {}          # name -> (weakref, kind)
@@ -462,6 +465,9 @@ def register_stats_source(name: str, obj, kind: str = "engine") -> str:
         if not _hook_installed:
             _metrics.add_collect_hook(_collect)
             _hook_installed = True
+    # In a worker process the hook only runs when the flusher snapshots;
+    # make sure one is running even if no Metric exists here yet.
+    _metrics.ensure_flusher()
     return final
 
 

@@ -1,0 +1,398 @@
+"""Serve-plane fault tolerance under seeded chaos (ISSUE 13 tentpole):
+mid-stream replica failover splices a token-identical continuation,
+the strike-based health plane survives transient ping failures, a
+crash-looping deployment gets quarantined by the circuit breaker,
+overload sheds typed errors instead of queueing unboundedly, and every
+resilience counter reaches /metrics through the stats bridge."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import GetTimeoutError, OverloadedError
+from ray_tpu.serve.handle import HANDLE_STATS
+from ray_tpu.util.faults import FaultPlan
+
+CFG = dict(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+           d_ff=64, max_seq_len=64, dtype="float32")
+
+
+@pytest.fixture
+def serve_session(ray_session):
+    yield serve
+    serve.shutdown()
+
+
+def _controller():
+    from ray_tpu.serve.controller import get_controller
+    return get_controller()
+
+
+def _replicas(dep, app):
+    c = _controller()
+    _, reps = ray_tpu.get(c.get_replicas.remote(dep, app, -1), timeout=30)
+    return reps
+
+
+# ---------------------------------------------------------------------------
+# tentpole proof: mid-stream failover is token-identical
+# ---------------------------------------------------------------------------
+
+def test_midstream_kill_failover_token_identical(serve_session):
+    """Two same-seed replicas serve greedy decode; the serving replica is
+    killed (deterministically, via a FaultPlan shipped into its process)
+    after 20 tokens have been consumed. handle.stream must resubmit
+    prompt + emitted tokens to the surviving replica and splice the
+    continuation so the full stream equals an unkilled run."""
+    from ray_tpu.serve.engine import InferenceReplica
+    app = serve.deployment(InferenceReplica, num_replicas=2).bind(
+        CFG, slots=2, max_len=64, seed=0)
+    h = serve.run(app, name="t_chaos")
+    prompt, n_tok = [5, 9, 3], 40          # 40 > SERVE_STREAM_BATCH (16)
+
+    # control run: no faults, full stream
+    expected = list(h.stream(list(prompt), n_tok))
+    assert len(expected) == n_tok
+
+    # chaos run: consume 20 tokens, then kill the serving replica at its
+    # next emit tick
+    before = HANDLE_STATS.stats()["failovers"]
+    it = h.stream(list(prompt), n_tok)
+    got = [next(it) for _ in range(20)]
+    serving = [r for r in _replicas("InferenceReplica", "t_chaos")
+               if ray_tpu.get(r.stats.remote(), timeout=30)
+               .get("streams", 0) > 0]
+    assert len(serving) == 1, "exactly one replica should hold the stream"
+    ray_tpu.get(serving[0].install_faults.remote(
+        FaultPlan(seed=13).kill("engine.emit", at=0)), timeout=30)
+    got.extend(it)                         # drains through the failover
+    assert got == expected
+    assert HANDLE_STATS.stats()["failovers"] >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: stream-handle leak — abandon and timeout both cancel
+# ---------------------------------------------------------------------------
+
+def _streams_of(dep, app):
+    return sum(ray_tpu.get(r.stats.remote(), timeout=30)
+               .get("streams", 0) for r in _replicas(dep, app))
+
+
+def _assert_no_leaked_streams(dep, app):
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if _streams_of(dep, app) == 0:      # cancel_stream is async
+            return
+        time.sleep(0.2)
+    pytest.fail("replica still holds a registered stream (leak)")
+
+
+def test_stream_abandon_and_timeout_cancel_replica_stream(serve_session):
+    @serve.deployment(num_replicas=1)
+    class Leaky:
+        def __call__(self, mode):
+            def infinite():
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+
+            def stall():
+                yield 0
+                time.sleep(8)
+                yield 1
+            return infinite() if mode == "infinite" else stall()
+
+    h = serve.run(Leaky.bind(), name="t_leak")
+
+    # abandoned generator: close() must release the replica-side stream
+    s = h.stream("infinite")
+    assert next(s) == 0
+    s.close()
+    _assert_no_leaked_streams("Leaky", "t_leak")
+
+    # timed-out drain: the regression this PR fixes — a GetTimeoutError
+    # used to exit the generator WITHOUT cancel_stream, pinning the
+    # producer on the replica until the idle TTL
+    with pytest.raises(GetTimeoutError):
+        list(h.stream("stall", timeout=1.5))
+    _assert_no_leaked_streams("Leaky", "t_leak")
+
+
+# ---------------------------------------------------------------------------
+# satellite: health plane — strikes, probation, fault-injected rounds
+# ---------------------------------------------------------------------------
+
+def test_one_transient_health_failure_does_not_kill_replica(serve_session):
+    """Regression for the one-strike health check: a single failed ping
+    (transient GC pause, slow tick) must strike, not replace."""
+    @serve.deployment(num_replicas=1)
+    class Blip:
+        def __init__(self):
+            self.pings = 0
+
+        def check_health(self):
+            self.pings += 1
+            if self.pings == 2:        # first ping passes (replica is
+                raise RuntimeError("transient blip")   # healthy), 2nd blips
+
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Blip.bind(), name="t_blip")
+    assert h.call(1) == 2
+    aid = _replicas("Blip", "t_blip")[0]._actor_id
+    time.sleep(5)                      # >= 4 reconcile/health rounds
+    survivors = _replicas("Blip", "t_blip")
+    assert [r._actor_id for r in survivors] == [aid], \
+        "a single transient health failure replaced the replica"
+    assert h.call(2) == 3
+    st = ray_tpu.get(_controller().stats.remote(), timeout=30)
+    assert st["health_check_failures"] >= 1
+    assert st["replicas_restarted"] == 0
+
+
+def test_controller_side_ping_fault_round_strikes_not_kills(serve_session):
+    """controller.health_ping chaos: one round where the controller's
+    whole probe fan-out fails (partitioned control plane) must strike
+    every replica once — and kill none."""
+    @serve.deployment(num_replicas=1)
+    class Ok:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Ok.bind(), name="t_round")
+    assert h.call(7) == 7
+    aid = _replicas("Ok", "t_round")[0]._actor_id
+    c = _controller()
+    try:
+        ray_tpu.get(c.inject_faults.remote(
+            FaultPlan().fail("controller.health_ping", at=0, times=1)),
+            timeout=30)
+        time.sleep(4)
+        assert [r._actor_id for r in _replicas("Ok", "t_round")] == [aid]
+        assert h.call(8) == 8
+    finally:
+        ray_tpu.get(c.inject_faults.remote(None), timeout=30)
+
+
+def test_breaker_quarantines_crash_looping_deployment(serve_session):
+    """A deployment whose replicas die shortly after start must trip the
+    circuit breaker: restarts STOP (quarantine) instead of burning the
+    cluster respawning forever."""
+    @serve.deployment(num_replicas=1)
+    class CrashLoop:
+        def __init__(self):
+            import os
+            import threading
+            threading.Timer(1.0, lambda: os._exit(1)).start()
+
+        def __call__(self, x):
+            return x
+
+    serve.run(CrashLoop.bind(), name="t_loop")
+    c = _controller()
+    ray_tpu.get(c.configure_fault_tolerance.remote(
+        breaker_threshold=2, breaker_window_s=60.0,
+        breaker_cooldown_s=300.0), timeout=30)
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = serve.status().get("t_loop:CrashLoop", {})
+        if st.get("breaker") == "open":
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"breaker never opened: {serve.status()}")
+    assert st["status"] == "QUARANTINED"
+
+    stats = ray_tpu.get(c.stats.remote(), timeout=30)
+    assert stats["breaker_trips"] >= 1
+    assert stats["quarantined"] == 1
+    # quarantine means NO further replacements: the restart counter
+    # freezes while the breaker stays open (cooldown is 300s)
+    restarted = stats["replicas_restarted"]
+    time.sleep(3)
+    stats2 = ray_tpu.get(c.stats.remote(), timeout=30)
+    assert stats2["replicas_restarted"] == restarted
+
+
+# ---------------------------------------------------------------------------
+# overload shedding: typed errors at the engine and 429 at the proxy
+# ---------------------------------------------------------------------------
+
+def test_engine_overload_sheds_typed_error():
+    """Queue-bound admission: past max_queue, submit raises
+    OverloadedError (typed, counted) instead of queueing unboundedly —
+    and draining the queue reopens admission."""
+    import jax
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import InferenceEngine
+    cfg = gpt.small(**CFG)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, slots=1, max_len=64, max_queue=2)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(OverloadedError):
+        eng.submit([1, 2, 3], max_new_tokens=2)
+    assert eng.stats()["sheds"] == 1
+    assert len(list(eng.tokens_for(r1))) == 2    # queue drains fine
+    # block-pool high water: a tiny budget sheds on projected usage
+    eng2 = InferenceEngine(params, cfg, slots=1, max_len=64,
+                           shed_high_water=0.01)
+    with pytest.raises(OverloadedError):
+        eng2.submit(list(range(32)), max_new_tokens=16)
+    assert eng2.stats()["sheds"] == 1
+
+
+def test_engine_watchdog_counts_stuck_ticks():
+    import jax
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import InferenceEngine
+    cfg = gpt.small(**CFG)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, slots=1, max_len=64,
+                          watchdog_s=0.2)
+    assert eng.stats()["watchdog_stalls"] == 0
+    # simulate a tick wedged past the watchdog window
+    eng._tick_seq += 1
+    eng._tick_started = time.perf_counter() - 1.0
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            eng.stats()["watchdog_stalls"] == 0:
+        time.sleep(0.05)
+    eng._tick_started = None
+    assert eng.stats()["watchdog_stalls"] >= 1
+
+
+def test_proxy_maps_overload_to_429_and_timeout_to_504(serve_session):
+    @serve.deployment
+    class Full:
+        def __call__(self, req):
+            raise OverloadedError("synthetic: engine full")
+
+    serve.run(Full.bind(), name="t_shed")
+    proxy = serve.start(http_options={"port": 0})
+    info = ray_tpu.get(proxy.ready.remote(), timeout=30)
+    serve.set_route("/full", "Full", "t_shed")
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{info['port']}/full", timeout=30)
+        pytest.fail("expected HTTP 429")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+        assert e.headers.get("Retry-After") == "1"
+        assert json.loads(e.read())["error"] == "overloaded"
+    # the timeout mapping, unit-level (a real 300s proxy-side get
+    # timeout has no place in a test)
+    from ray_tpu.serve.http_proxy import HTTPProxy
+    resp = HTTPProxy._error_response(
+        object.__new__(HTTPProxy), GetTimeoutError("slow"))
+    assert resp.status == 504
+
+
+# ---------------------------------------------------------------------------
+# acceptance: resilience counters reach /metrics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dashboard_port(ray_session):
+    from ray_tpu.dashboard import start_dashboard
+    return start_dashboard(0)
+
+
+def _scrape(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_fault_counters_reach_metrics(serve_session, dashboard_port):
+    """retries / failovers / sheds / breaker_trips series on /metrics,
+    fed by the handle (driver), a driver-side engine, and the
+    controller (worker process -> carried by the metrics flusher)."""
+    import jax
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.engine import InferenceEngine
+
+    # a real retry: kill the only replica, then call through the death
+    @serve.deployment(num_replicas=1)
+    class Svc:
+        def __call__(self, x):
+            return x * 2
+
+    h = serve.run(Svc.bind(), name="t_metrics")
+    assert h.call(3) == 6
+    before = HANDLE_STATS.stats()["retries"]
+    ray_tpu.kill(_replicas("Svc", "t_metrics")[0])
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if h.call(4, timeout=10) == 8:
+                break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        pytest.fail("replica never recovered")
+    assert HANDLE_STATS.stats()["retries"] >= before + 1
+
+    # a real shed on a driver-local engine (direct scrape path)
+    cfg = gpt.small(**CFG)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, slots=1, max_len=64, max_queue=1)
+    eng.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(OverloadedError):
+        eng.submit([1, 2], max_new_tokens=2)
+
+    want = ("ray_tpu_serve_handle_retries",
+            "ray_tpu_serve_handle_failovers",
+            "ray_tpu_engine_sheds",
+            "ray_tpu_serve_controller_breaker_trips")
+    deadline = time.time() + 20        # controller series ride the 5s
+    missing = want                     # metrics flusher from its worker
+    while time.time() < deadline:
+        text = _scrape(dashboard_port)
+        missing = tuple(w for w in want if w not in text)
+        if not missing:
+            break
+        time.sleep(1)
+    assert not missing, f"series never appeared on /metrics: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# heavy chaos variant
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_double_failover_token_identical(serve_session):
+    """Two sequential mid-stream kills (the SERVE_STREAM_FAILOVERS=2
+    budget exactly) still complete token-identical."""
+    from ray_tpu.serve.engine import InferenceReplica
+    app = serve.deployment(InferenceReplica, num_replicas=3).bind(
+        CFG, slots=2, max_len=64, seed=0)
+    h = serve.run(app, name="t_chaos2")
+    prompt, n_tok = [7, 2], 48
+
+    expected = list(h.stream(list(prompt), n_tok))
+    assert len(expected) == n_tok
+
+    it = h.stream(list(prompt), n_tok)
+    got = [next(it) for _ in range(17)]
+    for consumed in (17, 34):
+        serving = [r for r in _replicas("InferenceReplica", "t_chaos2")
+                   if ray_tpu.get(r.stats.remote(), timeout=30)
+                   .get("streams", 0) > 0]
+        assert len(serving) == 1
+        ray_tpu.get(serving[0].install_faults.remote(
+            FaultPlan(seed=consumed).kill("engine.emit", at=0)),
+            timeout=30)
+        if consumed == 17:
+            got.extend(next(it) for _ in range(17))
+    got.extend(it)
+    assert got == expected
