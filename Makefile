@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint test test-fast
+.PHONY: lint test test-fast trace-smoke
 
 # Static invariant checks (R001-R005): exits non-zero on any
 # non-waived finding. tests/test_graftlint.py::test_repo_is_clean runs
@@ -13,3 +13,10 @@ test:
 
 test-fast:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# Distributed-tracing smoke: one trace_id across >=3 processes in the
+# merged /api/timeline, for both entry paths (driver task chain and
+# HTTP proxy -> replica).
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tracing_distributed.py \
+		-q -k 'merged or proxy'

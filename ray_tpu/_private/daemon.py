@@ -349,6 +349,14 @@ class HostDaemon:
                                            or w.worker_id == msg.worker_id)]
             for w in targets:
                 w.send(msg)
+        elif isinstance(msg, protocol.SetTracing):
+            if msg.enabled:
+                from ray_tpu.util import tracing as _tracing
+                _tracing._enable_local()   # future spawns inherit the env
+            with self.lock:
+                targets = [w for w in self.workers.values() if w.alive]
+            for w in targets:
+                w.send(msg)
         elif isinstance(msg, protocol.KillActorOnNode):
             with self.lock:
                 w = self.actors.get(msg.actor_id)
@@ -675,7 +683,9 @@ class HostDaemon:
                 w.idle = True
         self._send_terminal(msg.task_id, protocol.NodeTaskDone(
             task_id=msg.task_id, return_descs=tagged, error=msg.error,
-            actor_ready=msg.actor_ready))
+            actor_ready=msg.actor_ready,
+            exec_start_ts=msg.exec_start_ts, exec_end_ts=msg.exec_end_ts,
+            spans=msg.spans))
         if retire is not None:
             retire.send(protocol.KillWorker())
             with self.lock:

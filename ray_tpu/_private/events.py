@@ -1,4 +1,4 @@
-"""Task lifecycle event recording (driver side).
+"""Task lifecycle event recording (driver side), with stage attribution.
 
 Counterpart of the reference's task-event pipeline: workers buffer task
 state transitions (`src/ray/core_worker/task_event_buffer.h:193`
@@ -8,6 +8,15 @@ them back (`dashboard/state_aggregator.py:141`, `ray timeline`). Here the
 driver process *is* the node, so transitions are recorded in place when the
 NodeServer mutates task state — no buffering hop needed; the bounded-ring
 retention policy is kept.
+
+Stage attribution: each record carries the full per-stage timestamp chain
+submitted→queued→dispatched→exec_start→exec_end→result_put→got, so the
+control-plane overhead between `node.submit` and the driver's `get` is
+attributable per stage instead of one opaque aggregate. Stage durations
+feed a `task_stage_ms` histogram (Prometheus bridge) and bounded sample
+rings for p50/p99 in `stage_breakdown()` / `summary()["__stages__"]`.
+exec_start/exec_end come from the executing worker (they ride `TaskDone`),
+all other clocks are the driver's.
 """
 
 from __future__ import annotations
@@ -20,6 +29,36 @@ import time
 # tasks; same order of magnitude here.
 MAX_TRACKED_TASKS = 100_000
 
+# Pipeline stages, in order. Each is the interval between two adjacent
+# timestamps of the chain; a stage is only observed when both ends exist.
+STAGES = ("submit", "queue", "dispatch", "execute", "result_put", "got")
+_STAGE_EDGES = (
+    ("submit", "submitted_ts", "queued_ts"),          # dep wait
+    ("queue", "queued_ts", "dispatched_ts"),          # scheduler queue
+    ("dispatch", "dispatched_ts", "exec_start_ts"),   # wire + worker pickup
+    ("execute", "exec_start_ts", "exec_end_ts"),      # user function
+    ("result_put", "exec_end_ts", "result_put_ts"),   # seal + report
+    ("got", "result_put_ts", "got_ts"),               # driver fetch lag
+)
+
+# Per-stage quantile window: enough for a stable p99 at bench scale
+# without unbounded growth on long-running drivers.
+STAGE_SAMPLE_CAP = 2048
+
+# Histogram buckets in milliseconds (control-plane hops are sub-ms to
+# seconds; the metrics default boundaries are tuned for seconds).
+_STAGE_MS_BOUNDARIES = [
+    0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+    1000, 5000]
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
 
 class TaskEventRecorder:
     """Bounded table of per-task lifecycle records + transition log."""
@@ -29,6 +68,14 @@ class TaskEventRecorder:
         # task_id -> record dict (insertion-ordered for FIFO trimming)
         self._tasks: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
+        self._stage_samples = {
+            s: collections.deque(maxlen=STAGE_SAMPLE_CAP) for s in STAGES}
+        self._stage_count = dict.fromkeys(STAGES, 0)
+        # return object id -> task id, so the driver-side `get` can close
+        # the chain ("got" stage). Bounded FIFO like the task table.
+        self._ret2task: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._hist = None
 
     def _rec(self, task_id: str) -> dict:
         r = self._tasks.get(task_id)
@@ -36,11 +83,59 @@ class TaskEventRecorder:
             r = {"task_id": task_id, "name": "", "state": "NIL",
                  "actor_id": None, "worker_id": None, "error": None,
                  "submitted_ts": None, "start_ts": None, "end_ts": None,
-                 "attempt": 0}
+                 "queued_ts": None, "dispatched_ts": None,
+                 "exec_start_ts": None, "exec_end_ts": None,
+                 "result_put_ts": None, "got_ts": None,
+                 "trace_id": None, "attempt": 0}
             self._tasks[task_id] = r
             while len(self._tasks) > MAX_TRACKED_TASKS:
                 self._tasks.popitem(last=False)
         return r
+
+    # -- stage plumbing ------------------------------------------------------
+
+    def _stage_hist(self):
+        """Lazily create the `task_stage_ms` histogram; the recorder must
+        stay importable (and cheap) when the metrics plane is unused."""
+        if self._hist is None:
+            from ray_tpu.util import metrics
+            self._hist = metrics.Histogram(
+                "task_stage_ms",
+                description=("Per-stage task latency (ms): "
+                             "submit|queue|dispatch|execute|result_put|got"),
+                boundaries=_STAGE_MS_BOUNDARIES,
+                tag_keys=("stage",))
+        return self._hist
+
+    def _collect_stages_locked(self, r: dict,
+                               only: str | None = None) -> list:
+        """Durations (stage, ms) newly completed for record `r`; buffers
+        quantile samples under the recorder lock, returns the list so the
+        caller can feed the histogram AFTER releasing it (metrics hold
+        their own lock; never nest it under ours)."""
+        out = []
+        for stage, a, b in _STAGE_EDGES:
+            if only is not None and stage != only:
+                continue
+            ta, tb = r.get(a), r.get(b)
+            if ta is None or tb is None:
+                continue
+            ms = max(0.0, (tb - ta) * 1e3)
+            out.append((stage, ms))
+            self._stage_samples[stage].append(ms)
+            self._stage_count[stage] += 1
+        return out
+
+    def _observe(self, durations: list) -> None:
+        """Feed collected durations to the histogram (outside the lock)."""
+        if not durations:
+            return
+        try:
+            hist = self._stage_hist()
+            for stage, ms in durations:
+                hist.observe(ms, tags={"stage": stage})
+        except Exception:
+            pass   # metrics plane unavailable; samples still recorded
 
     # -- transitions (called by NodeServer under its own lock) --------------
 
@@ -52,6 +147,18 @@ class TaskEventRecorder:
             r["state"] = ("PENDING_ARGS_AVAIL" if waiting_args
                           else "PENDING_NODE_ASSIGNMENT")
             r["submitted_ts"] = time.time()
+            if not waiting_args:
+                r["queued_ts"] = r["submitted_ts"]   # runnable immediately
+            ctx = getattr(spec, "trace_ctx", None)
+            if ctx:
+                r["trace_id"] = ctx.get("trace_id")
+
+    def queued(self, task_id: str) -> None:
+        """Dependencies resolved; the task entered the runnable queue."""
+        with self._lock:
+            r = self._tasks.get(task_id)
+            if r is not None and r["queued_ts"] is None:
+                r["queued_ts"] = time.time()
 
     def running(self, spec, worker_id: str) -> None:
         with self._lock:
@@ -59,19 +166,56 @@ class TaskEventRecorder:
             r["state"] = "RUNNING"
             r["worker_id"] = worker_id
             r["start_ts"] = time.time()
+            r["dispatched_ts"] = r["start_ts"]
 
     def requeued(self, spec) -> None:
         with self._lock:
             r = self._rec(spec.task_id)
             r["state"] = "PENDING_NODE_ASSIGNMENT"
             r["attempt"] += 1
+            # the old dispatch/exec clocks belong to the failed attempt
+            r["dispatched_ts"] = None
+            r["exec_start_ts"] = None
+            r["exec_end_ts"] = None
 
-    def finished(self, task_id: str, error: str | None = None) -> None:
+    def finished(self, task_id: str, error: str | None = None,
+                 exec_start_ts: float | None = None,
+                 exec_end_ts: float | None = None,
+                 return_ids=None) -> None:
         with self._lock:
             r = self._rec(task_id)
             r["state"] = "FAILED" if error else "FINISHED"
             r["error"] = error
             r["end_ts"] = time.time()
+            r["result_put_ts"] = r["end_ts"]
+            if exec_start_ts is not None:
+                r["exec_start_ts"] = exec_start_ts
+            if exec_end_ts is not None:
+                r["exec_end_ts"] = exec_end_ts
+            durations = self._collect_stages_locked(r)
+            if error is None and return_ids:
+                for oid in return_ids:
+                    self._ret2task[oid] = task_id
+                while len(self._ret2task) > MAX_TRACKED_TASKS:
+                    self._ret2task.popitem(last=False)
+        self._observe(durations)
+
+    def mark_got(self, object_ids) -> None:
+        """Driver-side fetch observed: close the `got` stage for every
+        task whose return object is being located for a `get`."""
+        durations = []
+        now = time.time()
+        with self._lock:
+            for oid in object_ids:
+                task_id = self._ret2task.pop(oid, None)
+                if task_id is None:
+                    continue
+                r = self._tasks.get(task_id)
+                if r is None or r["got_ts"] is not None:
+                    continue
+                r["got_ts"] = now
+                durations += self._collect_stages_locked(r, only="got")
+        self._observe(durations)
 
     # -- reads --------------------------------------------------------------
 
@@ -90,19 +234,56 @@ class TaskEventRecorder:
                     break
             return out
 
+    def _stage_breakdown_locked(self) -> dict:
+        out = {}
+        for stage in STAGES:
+            vals = sorted(self._stage_samples[stage])
+            out[stage] = {
+                "count": self._stage_count[stage],
+                "p50_ms": _pct(vals, 0.50),
+                "p99_ms": _pct(vals, 0.99),
+                "mean_ms": (sum(vals) / len(vals)) if vals else 0.0,
+                "max_ms": vals[-1] if vals else 0.0,
+            }
+        return out
+
+    def stage_breakdown(self) -> dict:
+        """Per-stage latency quantiles over the recent sample window:
+        stage -> {count, p50_ms, p99_ms, mean_ms, max_ms}."""
+        with self._lock:
+            return self._stage_breakdown_locked()
+
     def summary(self) -> dict:
-        """Counts by (name, state) — `ray summary tasks` equivalent."""
+        """Counts by (name, state) — `ray summary tasks` equivalent — plus
+        a reserved ``__stages__`` key with the stage-latency breakdown."""
         with self._lock:
             counts: dict = {}
             for r in self._tasks.values():
                 key = r["name"]
                 per = counts.setdefault(key, {})
                 per[r["state"]] = per.get(r["state"], 0) + 1
+            counts["__stages__"] = self._stage_breakdown_locked()
             return counts
+
+    def stats(self) -> dict:
+        """Recorder occupancy counters.
+
+        - ``tasks_tracked``: task records currently retained
+        - ``stage_samples``: stage durations observed since start
+        - ``got_pending``: finished tasks whose results were never fetched
+        """
+        with self._lock:
+            return {
+                "tasks_tracked": len(self._tasks),
+                "stage_samples": sum(self._stage_count.values()),
+                "got_pending": len(self._ret2task),
+            }
 
     def chrome_trace(self) -> list[dict]:
         """Task spans in chrome://tracing 'complete event' format
-        (`ray timeline` counterpart)."""
+        (`ray timeline` counterpart). Lanes are real process identities —
+        pid = the executing worker (or "driver") — so merging with
+        `tracing.spans_to_chrome_trace` output separates correctly."""
         now = time.time()
         with self._lock:
             events = []
@@ -114,8 +295,9 @@ class TaskEventRecorder:
                     "name": r["name"], "cat": "task", "ph": "X",
                     "ts": r["start_ts"] * 1e6,
                     "dur": (end - r["start_ts"]) * 1e6,
-                    "pid": "node", "tid": r["worker_id"] or "driver",
+                    "pid": r["worker_id"] or "driver", "tid": "tasks",
                     "args": {"task_id": r["task_id"], "state": r["state"],
-                             "actor_id": r["actor_id"]},
+                             "actor_id": r["actor_id"],
+                             "trace_id": r["trace_id"]},
                 })
             return events

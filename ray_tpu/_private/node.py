@@ -268,6 +268,14 @@ class NodeServer:
         from ray_tpu._private.events import TaskEventRecorder
         self.task_events = TaskEventRecorder()
         self.metrics_by_proc: dict[str, list] = {}
+        # the head's lane in merged chrome-trace exports
+        from ray_tpu.util import tracing as _tracing
+        _tracing.set_process_label("driver")
+        # recorder occupancy counters on /metrics (events_tasks_tracked,
+        # events_stage_samples, events_got_pending)
+        from ray_tpu.util import telemetry as _telemetry
+        _telemetry.register_stats_source("task_events", self.task_events,
+                                         kind="events")
         self._shutdown = False
         self._spawning = 0      # generic workers currently starting up
         self._spawn_failures = 0  # consecutive startup failures
@@ -1249,13 +1257,20 @@ class NodeServer:
             return self.task_events.summary()
         if method == "timeline":
             # ONE merged chrome://tracing view: task events (cat="task")
-            # interleaved with the driver-side telemetry plane — per-
-            # request engine flight-recorder spans (cat="request") and
-            # application tracing spans (cat="span"). All three use
-            # epoch-µs timestamps, so they line up on the same axis.
+            # interleaved with the telemetry plane — per-request engine
+            # flight-recorder spans (cat="request") and application
+            # tracing spans (cat="span"), including every span workers
+            # drained up to this ring. All use epoch-µs timestamps, so
+            # they line up on the same axis. Optional payload
+            # {"trace": <trace_id>} narrows to one distributed trace.
             from ray_tpu.util import telemetry as _telemetry
-            return (self.task_events.chrome_trace()
-                    + _telemetry.chrome_trace_events())
+            events = (self.task_events.chrome_trace()
+                      + _telemetry.chrome_trace_events())
+            trace = (payload or {}).get("trace")
+            if trace:
+                events = [e for e in events
+                          if (e.get("args") or {}).get("trace_id") == trace]
+            return events
         if method == "list_actors":
             with self.lock:
                 return [{
@@ -1354,6 +1369,15 @@ class NodeServer:
             with self.lock:
                 self.metrics_by_proc[wid] = snap
             return True
+        if method == "push_spans":
+            # worker→head span drain (piggybacked on the metrics flush)
+            _wid, spans = payload
+            from ray_tpu.util import tracing as _tracing
+            return _tracing.ingest(spans)
+        if method == "stage_breakdown":
+            return self.task_events.stage_breakdown()
+        if method == "enable_tracing":
+            return self.enable_tracing_broadcast()
         if method == "dashboard_snapshot":
             return self.dashboard_snapshot()
         if method == "free_objects":
@@ -1373,6 +1397,23 @@ class NodeServer:
                 return {"ready": a.ready, "dead": a.dead,
                         "cause": a.death_cause}
         raise ValueError(f"unknown control method {method}")
+
+    def enable_tracing_broadcast(self) -> bool:
+        """Turn span recording on in every live process of the session:
+        this one, the head's workers, and remote daemons (which fan the
+        protocol.SetTracing on to their workers). Future spawns inherit
+        the RAY_TPU_TRACING env var instead."""
+        from ray_tpu.util import tracing as _tracing
+        _tracing._enable_local()
+        msg = protocol.SetTracing(enabled=True)
+        with self.lock:
+            workers = [w for w in self.workers.values() if w.alive]
+            nodes = [n for n in self.nodes.values() if n.alive]
+        for w in workers:
+            w.send(msg)
+        for n in nodes:
+            n.send(msg)
+        return True
 
     # ------------------------------------------------------------------
     # object directory
@@ -1518,6 +1559,9 @@ class NodeServer:
         waiting = self.obj_waiting_tasks.pop(object_id, ())
         for t in waiting:
             t.deps.discard(object_id)
+            if not t.deps:
+                # last dependency resolved: the task is now runnable
+                self.task_events.queued(t.spec.task_id)
         for waiter in self._get_waiters.pop(object_id, ()):
             waiter["n"] -= 1
         self.cv.notify_all()
@@ -1620,6 +1664,7 @@ class NodeServer:
                 # loop back: re-verify everything under the same lock
                 # (an object may have been freed between registration
                 # and this read — the outer while handles it)
+        self.task_events.mark_got(object_ids)   # close the `got` stage
         if localize:
             locs = self._localize(locs, deadline=deadline)
         return locs
@@ -1845,6 +1890,10 @@ class NodeServer:
     # ------------------------------------------------------------------
 
     def _on_node_task_done(self, node: _RemoteNode, msg: protocol.NodeTaskDone):
+        if msg.spans:
+            # merge the remote host's drained spans (relayed by its daemon)
+            from ray_tpu.util import tracing as _tracing
+            _tracing.ingest(msg.spans)
         with self.lock:
             t = node.inflight.pop(msg.task_id, None)
             if t is None:
@@ -1868,7 +1917,10 @@ class NodeServer:
             else:
                 self.task_events.finished(
                     msg.task_id,
-                    error="application_error" if msg.error else None)
+                    error="application_error" if msg.error else None,
+                    exec_start_ts=msg.exec_start_ts,
+                    exec_end_ts=msg.exec_end_ts,
+                    return_ids=spec.return_ids)
                 self._release_task_args(spec)
                 for oid, desc in zip(spec.return_ids, msg.return_descs):
                     self._register_locked(oid, desc,
@@ -3044,6 +3096,10 @@ class NodeServer:
     # ------------------------------------------------------------------
 
     def _on_task_done(self, w: _WorkerConn, msg: protocol.TaskDone):
+        if msg.spans:
+            # merge the worker's drained spans before taking the node lock
+            from ray_tpu.util import tracing as _tracing
+            _tracing.ingest(msg.spans)
         retire = None
         with self.lock:
             t = w.current if (w.current and w.current.spec.task_id ==
@@ -3073,7 +3129,9 @@ class NodeServer:
                 self._requeue_after_failure(w, t, a)
                 return
             self.task_events.finished(
-                msg.task_id, error="application_error" if msg.error else None)
+                msg.task_id, error="application_error" if msg.error else None,
+                exec_start_ts=msg.exec_start_ts, exec_end_ts=msg.exec_end_ts,
+                return_ids=spec.return_ids)
             self._release_task_args(spec)
             for oid, desc in zip(spec.return_ids, msg.return_descs):
                 self._register_locked(oid, desc, origin=w.worker_id)

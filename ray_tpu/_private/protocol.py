@@ -77,6 +77,13 @@ class TaskSpec:
     scheduling_strategy: object = None
     # Name shown in state API / dashboards.
     name: str = ""
+    # Distributed tracing: the submitter's `util.tracing`
+    # propagation_context() — {"trace_id", "span_id"} — stamped by the
+    # client submit paths (_private/worker.py) when a trace is active.
+    # The executing worker attaches it and opens a `task.execute` span,
+    # so one trace id survives every process hop (the reference carries
+    # the OTel context in TaskSpec the same way).
+    trace_ctx: dict | None = None
 
 
 # ---- driver -> worker -----------------------------------------------------
@@ -95,6 +102,14 @@ class PushTask:
 @dataclass
 class KillWorker:
     graceful: bool = True
+
+
+@dataclass
+class SetTracing:
+    """Head -> worker/daemon broadcast: flip span recording in processes
+    that were already running when the driver called
+    `tracing.enable_tracing()` (later spawns inherit the env var)."""
+    enabled: bool = True
 
 
 @dataclass
@@ -124,6 +139,16 @@ class TaskDone:
     error: bool = False
     # For actor creation tasks: advertises readiness.
     actor_ready: bool = False
+    # Worker-side execution timestamps (epoch seconds): the head's
+    # TaskEventRecorder turns dispatched→start→end into the dispatch /
+    # execute stage latencies (worker-buffered task events in the
+    # reference carry the same state timestamps).
+    exec_start_ts: float | None = None
+    exec_end_ts: float | None = None
+    # Tracing spans drained from this worker's ring, piggybacked so the
+    # head's merged timeline is current the moment the task completes
+    # (long gaps between completions are covered by the metrics flush).
+    spans: list | None = None
 
 
 @dataclass
@@ -278,11 +303,16 @@ class LeaseTask:
 @dataclass
 class NodeTaskDone:
     """Daemon -> head: a leased task finished; returns are sealed in the
-    daemon's store (descriptors tagged with its node id)."""
+    daemon's store (descriptors tagged with its node id). Carries the
+    worker's execution timestamps and drained tracing spans up the relay
+    (TaskDone -> daemon -> head) unchanged."""
     task_id: str
     return_descs: list
     error: bool = False
     actor_ready: bool = False
+    exec_start_ts: float | None = None
+    exec_end_ts: float | None = None
+    spans: list | None = None
 
 
 @dataclass

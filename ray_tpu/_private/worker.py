@@ -158,6 +158,17 @@ class ObjectRef:
         return fut
 
 
+def _stamp_trace_ctx(spec) -> None:
+    """Stamp the submitter's trace context onto the outgoing TaskSpec.
+    Central for BOTH client shapes, so driver submits, nested worker
+    submits, and every actor-method call (serve handle→replica included)
+    propagate the same way. The no-trace path is one ContextVar read —
+    no env lookup, nothing recorded."""
+    if spec.trace_ctx is None:
+        from ray_tpu.util import tracing as _tracing
+        spec.trace_ctx = _tracing.propagation_context()
+
+
 class BaseClient:
     mode = "none"
 
@@ -215,6 +226,7 @@ class DriverClient(BaseClient):
         return self.node.wait_objects(object_ids, num_returns, timeout)
 
     def submit(self, spec):
+        _stamp_trace_ctx(spec)
         self.node.submit(spec)
 
     def control(self, method, payload=None, timeout=None):
@@ -245,6 +257,7 @@ class WorkerClient(BaseClient):
                                     fetch_local)
 
     def submit(self, spec):
+        _stamp_trace_ctx(spec)
         self.rt.submit_spec(spec)
 
     def control(self, method, payload=None, timeout=None):

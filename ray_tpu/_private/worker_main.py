@@ -28,6 +28,7 @@ from multiprocessing import connection
 from ray_tpu._private import netaddr, protocol, serialization
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu.exceptions import RayTpuError, TaskError
+from ray_tpu.util import tracing as _tracing
 
 import contextvars
 
@@ -139,6 +140,10 @@ class WorkerRuntime:
                 for ln in msg.lines or ():
                     print(f"({msg.source}, node={nid}) {ln}",
                           file=sys.stderr)
+            elif isinstance(msg, protocol.SetTracing):
+                # driver enabled tracing after this worker spawned
+                if msg.enabled:
+                    _tracing._enable_local()
             elif isinstance(msg, protocol.KillWorker):
                 self.shutdown = True
                 self.task_queue.put(None)
@@ -293,10 +298,26 @@ class WorkerRuntime:
                 raise _DepFailed(v)
         return args, kwargs
 
+    def _start_task_span(self, spec: protocol.TaskSpec):
+        """Attach the submitter's trace context and open `task.execute`.
+        Gated on the stamped ctx, not on local enablement: a stamped spec
+        proves the trace is live even if this worker predates the
+        driver's enable_tracing() broadcast. Returns (span, token)."""
+        if spec.trace_ctx is None:
+            return None
+        return _tracing.start_span(
+            "task.execute",
+            {"task_id": spec.task_id,
+             "name": spec.name or spec.function_desc,
+             "worker_id": self.worker_id},
+            parent=spec.trace_ctx)
+
     def run_task(self, push: protocol.PushTask):
         spec = push.spec
         chips = os.environ.get("TPU_VISIBLE_CHIPS")
         self._current_task_ids.task_id = spec.task_id
+        sp = self._start_task_span(spec)
+        exec_start = time.time()
         try:
             is_actor_method = (spec.actor_id is not None
                                and not spec.actor_creation)
@@ -326,9 +347,24 @@ class WorkerRuntime:
             error = True
         finally:
             self._current_task_ids.task_id = None
-        self._seal_and_send(spec, values, error)
+        exec_end = time.time()
+        if sp is not None:
+            _tracing.end_span(sp[0], sp[1],
+                              error="task_error" if error else None)
+        self._seal_and_send(spec, values, error, exec_start, exec_end)
 
-    def _seal_and_send(self, spec, values, error):
+    def _drain_spans_for_push(self):
+        """This process's buffered tracing spans (plus any worker-resident
+        FlightRecorder spans), to piggyback on the next TaskDone. Cheap
+        when tracing never ran: one deque emptiness check."""
+        spans = _tracing.drain_spans()
+        if "ray_tpu.util.telemetry" in sys.modules:
+            from ray_tpu.util import telemetry as _telemetry
+            spans += _telemetry.drain_recorder_spans()
+        return spans or None
+
+    def _seal_and_send(self, spec, values, error,
+                       exec_start=None, exec_end=None):
         descs = []
         for oid, value in zip(spec.return_ids, values):
             try:
@@ -341,7 +377,9 @@ class WorkerRuntime:
                 error = True
         self.send(protocol.TaskDone(
             task_id=spec.task_id, return_descs=descs, error=error,
-            actor_ready=spec.actor_creation and not error))
+            actor_ready=spec.actor_creation and not error,
+            exec_start_ts=exec_start, exec_end_ts=exec_end,
+            spans=self._drain_spans_for_push()))
 
     @staticmethod
     def _split_returns(result, num_returns):
@@ -389,8 +427,11 @@ class WorkerRuntime:
         loop = asyncio.get_running_loop()
         async with self._async_sem:
             # each asyncio task has its own context, so the current-task
-            # id survives interleaving (a thread-local cannot)
+            # id — and the attached trace context — survive interleaving
+            # (a thread-local cannot)
             _ASYNC_TASK_ID.set(spec.task_id)
+            sp = self._start_task_span(spec)
+            exec_start = time.time()
             try:
                 args, kwargs = await loop.run_in_executor(
                     self._io_executor, self._resolve_args, spec,
@@ -409,9 +450,13 @@ class WorkerRuntime:
                 te = TaskError(type(e).__name__, str(e), tb, cause=e)
                 values = [te] * spec.num_returns
                 error = True
+            exec_end = time.time()
+            if sp is not None:
+                _tracing.end_span(sp[0], sp[1],
+                                  error="task_error" if error else None)
             await loop.run_in_executor(
                 self._io_executor, self._seal_and_send, spec, values,
-                error)
+                error, exec_start, exec_end)
 
     def main_loop(self):
         import asyncio
@@ -469,12 +514,18 @@ def run(address: str, worker_id: str):
     instead of re-parsing argv."""
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     rt = WorkerRuntime(address, worker_id, authkey)
+    _tracing.set_process_label(f"worker:{worker_id}")
     rt.send(protocol.RegisterWorker(worker_id, os.getpid()))
 
     # Install this runtime as the process-global client so user code can call
     # ray_tpu.get/put/remote/... inside tasks (nested submission).
     from ray_tpu._private import worker as worker_mod
     worker_mod.connect_worker_mode(rt)
+
+    # Span drain must not depend on the process ever registering a
+    # metric (the proxy records spans but owns no counters).
+    from ray_tpu.util import metrics as _metrics
+    _metrics.ensure_flusher()
 
     threading.Thread(target=rt.reader_loop, daemon=True,
                      name="ray_tpu-worker-reader").start()

@@ -84,7 +84,8 @@ def cmd_summary(args):
 
 
 def cmd_timeline(args):
-    events = _attach(args).control("timeline")
+    payload = {"trace": args.trace} if getattr(args, "trace", None) else None
+    events = _attach(args).control("timeline", payload)
     with open(args.output, "w") as f:
         json.dump(events, f)
     # The merged view carries task events, engine request spans, and
@@ -594,6 +595,8 @@ def main(argv=None):
 
     tp = sub.add_parser("timeline")
     tp.add_argument("output", nargs="?", default="timeline.json")
+    tp.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="only events of one distributed trace")
     tp.set_defaults(fn=cmd_timeline)
 
     sub.add_parser("metrics").set_defaults(fn=cmd_metrics)

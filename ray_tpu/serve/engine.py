@@ -1601,12 +1601,12 @@ class InferenceEngine:
 
         Fault tolerance (serve-plane robustness counters):
           ``sheds`` — admissions refused with `OverloadedError` because
-          the pending queue hit ``max_queue`` or projected block-pool
-          utilization crossed ``shed_high_water`` (both 0 when the
-          knobs are off — the default).
+          the pending queue hit the `max_queue` knob or projected
+          block-pool utilization crossed `shed_high_water` (both 0 when
+          the knobs are off — the default).
           ``watchdog_stalls`` — scheduler ticks the watchdog thread saw
-          overrun ``watchdog_s`` (always present; 0 with the watchdog
-          disabled). Each stall also logs one WARN.
+          overrun the `watchdog_s` budget (always present; 0 with the
+          watchdog disabled). Each stall also logs one WARN.
         """
         with self._lock:
             self._sentinel.check()   # surface retraces since last tick

@@ -291,7 +291,13 @@ class DeploymentHandle:
         if self._model_id:
             kwargs = {**kwargs,
                       "__multiplexed_model_id__": self._model_id}
-        ref = replica.handle_request.remote(args, kwargs)
+        from ray_tpu.util import tracing as _tracing
+        with _tracing.span("handle.call",
+                           {"deployment": self.deployment_name,
+                            "app": self.app_name}):
+            # the submit inside nests under this span, so the replica's
+            # task.execute span attributes the handle hop
+            ref = replica.handle_request.remote(args, kwargs)
         self._record(replica._actor_id, ref)
         return ref, replica
 

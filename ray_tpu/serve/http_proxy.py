@@ -150,44 +150,77 @@ class HTTPProxy:
             query=dict(request.query),
             headers=dict(request.headers),
             body=body)
+        from ray_tpu.util import tracing as _tracing
+        root = token = None
+        if _tracing.tracing_enabled():
+            # Root span of the distributed trace: everything downstream —
+            # handle → replica → engine flight recorder — joins this
+            # trace_id via TaskSpec stamping + contextvars.
+            root, token = _tracing.start_span(
+                "http.request",
+                {"method": request.method, "path": request.path,
+                 "deployment": dep})
         loop = asyncio.get_event_loop()
         from ray_tpu import exceptions as _exc
         attempts = max(1, SERVE_RETRY_MAX_ATTEMPTS)
         try:
-            for attempt in range(attempts):
-                try:
-                    ref, replica = await loop.run_in_executor(
-                        self._pool, handle.remote_detailed, req)
-                    result = await self._aget(ref)
-                    break
-                except (_exc.ActorDiedError,
-                        _exc.WorkerCrashedError):
-                    # safely retryable: nothing has been written to the
-                    # client yet and a dead replica can never deliver
-                    # the result. (Deaths mid-STREAM abort the chunked
-                    # response instead — the proxy can't rewind bytes
-                    # already on the wire; token-level failover lives in
-                    # DeploymentHandle.stream.)
-                    if attempt + 1 >= attempts:
-                        raise
-                    await loop.run_in_executor(
-                        self._pool,
-                        lambda: handle._refresh(force=True))
-                    delay = min(SERVE_RETRY_CAP_S,
-                                SERVE_RETRY_BASE_S * (2 ** attempt))
-                    await asyncio.sleep(
-                        delay * (0.5 + random.random() / 2))
-        except Exception as e:
-            return self._error_response(e)
-        if isinstance(result, dict) and STREAM_MARKER in result:
-            return await self._stream_out(request, replica, result)
-        if isinstance(result, bytes):
-            body, ctype = result, "application/octet-stream"
-        elif isinstance(result, str):
-            body, ctype = result.encode(), "text/plain"
-        else:
-            body, ctype = json.dumps(result).encode(), "application/json"
-        return web.Response(status=200, body=body, content_type=ctype)
+            try:
+                for attempt in range(attempts):
+                    try:
+                        ref, replica = await loop.run_in_executor(
+                            self._pool, self._call_in_ctx, handle, req,
+                            root)
+                        result = await self._aget(ref)
+                        break
+                    except (_exc.ActorDiedError,
+                            _exc.WorkerCrashedError):
+                        # safely retryable: nothing has been written to
+                        # the client yet and a dead replica can never
+                        # deliver the result. (Deaths mid-STREAM abort
+                        # the chunked response instead — the proxy can't
+                        # rewind bytes already on the wire; token-level
+                        # failover lives in DeploymentHandle.stream.)
+                        if attempt + 1 >= attempts:
+                            raise
+                        await loop.run_in_executor(
+                            self._pool,
+                            lambda: handle._refresh(force=True))
+                        delay = min(SERVE_RETRY_CAP_S,
+                                    SERVE_RETRY_BASE_S * (2 ** attempt))
+                        await asyncio.sleep(
+                            delay * (0.5 + random.random() / 2))
+            except Exception as e:
+                if root is not None:
+                    root["status"] = "ERROR"
+                    root["attributes"]["exception"] = repr(e)
+                return self._error_response(e)
+            if isinstance(result, dict) and STREAM_MARKER in result:
+                return await self._stream_out(request, replica, result)
+            if isinstance(result, bytes):
+                body, ctype = result, "application/octet-stream"
+            elif isinstance(result, str):
+                body, ctype = result.encode(), "text/plain"
+            else:
+                body, ctype = (json.dumps(result).encode(),
+                               "application/json")
+            return web.Response(status=200, body=body, content_type=ctype)
+        finally:
+            if root is not None:
+                _tracing.end_span(root, token)
+
+    def _call_in_ctx(self, handle, req, span):
+        """Run the handle call on the pool WITH the request's trace
+        context: `loop.run_in_executor` does not propagate contextvars,
+        so the submit-side TaskSpec stamping would otherwise never see
+        the proxy's root span."""
+        if span is None:
+            return handle.remote_detailed(req)
+        from ray_tpu.util import tracing as _tracing
+        token = _tracing.attach_context(span)
+        try:
+            return handle.remote_detailed(req)
+        finally:
+            _tracing.detach_context(token)
 
     def _error_response(self, e: BaseException):
         """Typed failure mapping: overload shedding surfaces as 429 with

@@ -26,6 +26,9 @@ HANDLE = "ray_tpu/serve/handle.py"
 TELEMETRY = "ray_tpu/util/telemetry.py"
 METRICS = "ray_tpu/util/metrics.py"
 FAULTS = "ray_tpu/util/faults.py"
+TRACING = "ray_tpu/util/tracing.py"
+EVENTS = "ray_tpu/_private/events.py"
+WORKER_MAIN = "ray_tpu/_private/worker_main.py"
 
 # --- R001: functions whose bodies are latency-critical host code. A
 # host sync here stalls the device queue (or the scheduler tick).
@@ -54,6 +57,19 @@ HOT_SCOPES: dict[str, frozenset[str]] = {
     }),
     FLYWHEEL: frozenset({
         "FlywheelLoop._publish",
+    }),
+    # span-drain path: runs on every TaskDone seal / metrics flush, and
+    # _record sits inside span() on every traced hot-path operation
+    TRACING: frozenset({
+        "_record",
+        "drain_spans",
+        "ingest",
+    }),
+    EVENTS: frozenset({
+        "TaskEventRecorder._collect_stages_locked",
+    }),
+    WORKER_MAIN: frozenset({
+        "WorkerRuntime._drain_spans_for_push",
     }),
 }
 
@@ -150,6 +166,15 @@ LOCKS: dict[str, dict[str, LockSpec]] = {
     },
     FAULTS: {
         "_lock": LockSpec("faults.registry"),
+    },
+    TRACING: {
+        "_lock": LockSpec("tracing.ring"),
+    },
+    EVENTS: {
+        # stage histograms are observed OUTSIDE this lock (durations are
+        # collected under it, fed to metrics after release) — keep it
+        # leaf-level: no metrics/tracing edges
+        "self._lock": LockSpec("events.recorder"),
     },
 }
 

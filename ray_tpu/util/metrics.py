@@ -62,6 +62,7 @@ class _Registry:
                     # module-level snapshot(): runs collect hooks so a
                     # worker-resident engine's gauges refresh per flush
                     client.control("push_metrics", (wid, snapshot()))
+                    _push_spans(client, wid)
                 except Exception:
                     return  # driver gone; session over
 
@@ -75,8 +76,25 @@ class _Registry:
             try:
                 wid = getattr(client.rt, "worker_id", "worker")
                 client.control("push_metrics", (wid, snapshot()))
+                _push_spans(client, wid)
             except Exception:
                 pass
+
+
+def _push_spans(client, wid: str) -> None:
+    """Piggyback the tracing span drain on the metrics flush — the
+    worker→head collection hop for spans that are not tied to a task
+    completion (actor-resident engines, long-lived replicas)."""
+    from ray_tpu.util import tracing as _tracing
+    if not _tracing.tracing_enabled():
+        return
+    spans = _tracing.drain_spans()
+    import sys as _sys
+    if "ray_tpu.util.telemetry" in _sys.modules:
+        from ray_tpu.util import telemetry as _telemetry
+        spans += _telemetry.drain_recorder_spans()
+    if spans:
+        client.control("push_spans", (wid, spans))
 
 
 _registry = _Registry()
@@ -210,7 +228,11 @@ def ensure_flusher() -> None:
     """Start the worker→driver flush loop even if no Metric exists in
     this process yet. Collect-hook-only sources (register_stats_source)
     create their metrics lazily at the first snapshot — which only the
-    flusher takes in a worker, so they must be able to start it."""
+    flusher takes in a worker, so they must be able to start it. Span
+    collection piggybacks on the same loop, and a process can produce
+    spans without ever creating a metric (the HTTP proxy opens request
+    spans but owns no counters) — worker_main calls this at startup so
+    every worker has a drain heartbeat."""
     _registry._ensure_flusher()
 
 

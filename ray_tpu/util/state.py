@@ -59,11 +59,19 @@ def prometheus_metrics() -> str:
     return _metrics.render_prometheus(get_metrics())
 
 
-def timeline(filename: str | None = None):
+def timeline(filename: str | None = None, trace: str | None = None):
     """Chrome-trace task timeline (`ray timeline` CLI counterpart). Returns
-    the event list; also writes JSON to `filename` when given."""
-    events = _control("timeline")
+    the event list; also writes JSON to `filename` when given. `trace`
+    narrows the merged view to one distributed trace id."""
+    events = _control("timeline", {"trace": trace} if trace else None)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def stage_breakdown() -> dict:
+    """Per-stage control-plane latency quantiles
+    (submit→queue→dispatch→execute→result_put→got), p50/p99/mean/max ms
+    over the recent sample window."""
+    return _control("stage_breakdown")

@@ -158,8 +158,17 @@ class FlightRecorder:
                                   and self._rng.random() >= self.sample):
             return
         now = _now_ns()
-        trace_id = uuid.uuid4().hex
-        root = self._span("engine.request", trace_id, None, now,
+        # Join the distributed trace when the submitting context carries
+        # one (proxy → replica → engine: the replica's context flows into
+        # this caller thread via contextvars), else start a fresh trace.
+        parent = _tracing.capture_context()
+        if parent is not None:
+            trace_id = parent["trace_id"]
+            parent_sid = parent["span_id"]
+        else:
+            trace_id = uuid.uuid4().hex
+            parent_sid = None
+        root = self._span("engine.request", trace_id, parent_sid, now,
                           {"rid": rid, "engine": self.name,
                            "prompt_len": int(prompt_len)})
         queue = self._span("queue_wait", trace_id, root["span_id"], now,
@@ -273,6 +282,25 @@ class FlightRecorder:
 
     def get_spans(self) -> list[dict]:
         return list(self._spans)
+
+    def drain_spans(self) -> list[dict]:
+        """Atomically pop the ring (worker side of cluster-wide span
+        collection: drained spans ride the TaskDone / metrics-flush hop
+        to the head's tracing ring). Spans are tagged with this
+        recorder's category/lane/process so the head's merged chrome
+        view keeps the per-request lanes."""
+        out = []
+        while True:
+            try:
+                s = self._spans.popleft()
+            except IndexError:
+                break
+            rid = s["attributes"].get("rid", 0)
+            s.setdefault("cat", "request")
+            s.setdefault("lane", f"{self.name}/r{rid}")
+            s.setdefault("proc", _tracing.process_label())
+            out.append(s)
+        return out
 
     def live_requests(self) -> int:
         return len(self._live)
@@ -440,6 +468,8 @@ COUNTER_KEYS = frozenset({
     # serve-plane fault tolerance (handle/engine/controller stats)
     "retries", "failovers", "sheds", "watchdog_stalls",
     "breaker_trips", "replicas_restarted", "health_check_failures",
+    # task-event recorder (stage-attribution observations)
+    "stage_samples",
 })
 
 _sources: dict[str, tuple] = {}          # name -> (weakref, kind)
@@ -581,6 +611,30 @@ def chrome_trace_events() -> list[dict]:
         out.extend(rec.chrome_events())
     out.extend(_tracing.spans_to_chrome_trace())
     return out
+
+
+def drain_recorder_spans() -> list[dict]:
+    """Pop every live recorder's span ring — the worker side of cluster
+    span collection (`worker_main._drain_spans_for_push` and the metrics
+    flusher call this). Head-resident recorders are never drained: their
+    rings are read in place by `chrome_trace_events()`, and draining
+    them too would double-count once the head ingests its own ring."""
+    out = []
+    for rec in list(_recorders):
+        out.extend(rec.drain_spans())
+    return out
+
+
+def _tracing_gauges() -> None:
+    """Collect hook: surface the tracing ring's drop counter on /metrics
+    so a truncated cluster trace is observable at scrape time."""
+    g = _metric(_metrics.Gauge, "tracing_dropped_spans",
+                "spans evicted from the in-process tracing ring")
+    if g is not None:
+        g.set(_tracing.dropped_spans(), tags={"source": "tracing"})
+
+
+_metrics.add_collect_hook(_tracing_gauges)
 
 
 def summary() -> dict:
