@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint test test-fast trace-smoke
+.PHONY: lint test test-fast trace-smoke scale-smoke
 
 # Static invariant checks (R001-R005): exits non-zero on any
 # non-waived finding. tests/test_graftlint.py::test_repo_is_clean runs
@@ -20,3 +20,9 @@ test-fast:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tracing_distributed.py \
 		-q -k 'merged or proxy'
+
+# Trimmed scale_bench parity run: channel batching + pipelined
+# submission ON vs OFF must produce bit-identical task results and
+# object bytes (timing may differ, values may not).
+scale-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scale_smoke.py -q

@@ -70,6 +70,27 @@ def get(name: str) -> Any:
     return OPTIONS[name].current()
 
 
+# Modules that memoize a derived value of a config option (e.g. the
+# advertised host in netaddr) register an invalidation hook here;
+# anything that changes an override mid-process (tests flipping
+# RAY_TPU_NODE_IP, an operator re-pointing the node IP) calls
+# reset_caches() to flush every derived value at once.
+_reset_hooks: list[Callable[[], None]] = []
+
+
+def on_reset(fn: Callable[[], None]) -> Callable[[], None]:
+    """Register an invalidation hook run by reset_caches(); returns the
+    hook so it can double as a decorator."""
+    _reset_hooks.append(fn)
+    return fn
+
+
+def reset_caches() -> None:
+    """Invalidate every registered config-derived cache."""
+    for fn in _reset_hooks:
+        fn()
+
+
 def describe() -> list:
     """Rows for `ray_tpu config list`: (name, type, default, current,
     overridden, doc)."""

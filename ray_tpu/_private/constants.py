@@ -472,3 +472,47 @@ RUNTIME_ENV_PIP_INSTALL_TIMEOUT_S = define(
     "RUNTIME_ENV_PIP_INSTALL_TIMEOUT_S", int, 600,
     "Timeout for installing a pip runtime-env's requirements "
     "(reference: pip runtime env install timeout).")
+# --- control-plane throughput (channel framing + pipelined submission) ---
+
+CHANNEL_BATCHING = define(
+    "CHANNEL_BATCHING", bool, True,
+    "Coalesce control-plane messages into one wire frame per channel "
+    "flush (netaddr.BatchedConnection). Each logical message keeps its "
+    "own identity for fault injection and FIFO order; turning this off "
+    "restores one pickle per send (the parity smoke test runs both).")
+
+CHANNEL_QUEUE_CAP = define(
+    "CHANNEL_QUEUE_CAP", int, 65536,
+    "Backpressure bound on a batched channel's outbound queue: past "
+    "this many queued logical messages send() blocks until the flusher "
+    "drains, matching the blocking a raw full pipe would impose.")
+
+SUBMIT_PIPELINE = define(
+    "SUBMIT_PIPELINE", bool, True,
+    "Workers stream nested task submissions without a per-task ack, "
+    "under a windowed credit scheme with sequence-numbered nack/replay "
+    "(reference: Ray's pipelined task submission to the raylet). Off "
+    "restores one blocking SubmitRequest/SubmitReply round trip each.")
+
+SUBMIT_WINDOW = define(
+    "SUBMIT_WINDOW", int, 1024,
+    "Max unacknowledged pipelined submissions per worker channel before "
+    "submit_spec blocks waiting for credit.")
+
+SUBMIT_RESYNC_S = define(
+    "SUBMIT_RESYNC_S", float, 1.0,
+    "With unacked pipelined submissions and no credit progress for this "
+    "long, the worker replays its unacked ring (the head dedupes by "
+    "seq and re-credits, so a lost tail message cannot stall forever).")
+
+SCHEDULER_FREED_BATCH = define(
+    "SCHEDULER_FREED_BATCH", int, 16,
+    "How many queued plain tasks the completion fast path may dispatch "
+    "under ONE scheduler-lock acquisition when workers free up.")
+
+LINK_GROUPS = define(
+    "LINK_GROUPS", str, "",
+    "Comma-separated interconnect link-group ids (ICI ring / DCN pod) "
+    "this host hangs off, advertised in RegisterNode for the "
+    "contention-aware gang placement model (2207.07817). Empty = no "
+    "topology information; contention scoring is a no-op.")

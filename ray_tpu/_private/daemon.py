@@ -40,6 +40,14 @@ def _env_trivial(spec) -> bool:
     from ray_tpu._private.runtime_env import is_trivial
     return is_trivial(spec.runtime_env)
 
+
+def _local_link_groups() -> list:
+    """Interconnect link-group ids this host hangs off (ICI ring / DCN
+    pod), advertised in RegisterNode for contention-aware gang
+    placement. Read per registration: set by the provisioner's env."""
+    from ray_tpu._private import config
+    return [s for s in config.get("LINK_GROUPS").split(",") if s]
+
 logger = logging.getLogger("ray_tpu.daemon")
 
 
@@ -55,6 +63,12 @@ class _DWorker:
     known_functions: set = field(default_factory=set)
     inflight: dict = field(default_factory=dict)   # task_id -> TaskSpec
     send_lock: threading.Lock = field(default_factory=threading.Lock)
+    # Pipelined-submission receive state (touched only by this worker's
+    # reader thread): next expected seq + outstanding-nack flag. The
+    # daemon dedupes the worker's stream here, then relays each
+    # submission ONCE on the reliable NodeSeq channel to the head.
+    sub_next: int = 0
+    sub_nacked: bool = False
 
     def send(self, msg) -> bool:
         return protocol.safe_send(self.conn, self.send_lock, msg)
@@ -107,8 +121,7 @@ class HostDaemon:
         if os.path.exists(self.address):
             # leftover socket of a dead daemon that reused this node dir
             os.unlink(self.address)
-        self._listener = connection.Listener(
-            family="AF_UNIX", address=self.address, authkey=self.authkey)
+        self._listener = netaddr.listener(self.address, self.authkey)
         self._head = netaddr.client(head_address, self.authkey)
         self._head_lock = threading.Lock()
         # Reliable-delivery state for head-bound messages: a blip can
@@ -146,7 +159,8 @@ class HostDaemon:
             self._head.send(protocol.RegisterNode(
                 node_id=node_id, pid=os.getpid(), resources=resources,
                 num_tpu_chips=num_tpu_chips,
-                address=self.advertised_address))
+                address=self.advertised_address,
+                link_groups=_local_link_groups()))
         except (OSError, ValueError, BrokenPipeError):
             logger.warning("initial register send failed; deferring to "
                            "the reconnect path")
@@ -286,7 +300,8 @@ class HostDaemon:
                 node_id=self.node_id, pid=os.getpid(),
                 resources=self.resources, num_tpu_chips=self.num_tpu_chips,
                 address=self.advertised_address, actors=live_actors,
-                objects=objects, leases=leases)
+                objects=objects, leases=leases,
+                link_groups=_local_link_groups())
             # RegisterNode must be the FIRST message on the new channel
             # (the head classifies connections by it); then the retained
             # seq ring replays in order — the head drops already-seen
@@ -456,6 +471,9 @@ class HostDaemon:
                 self._head_send(protocol.NodeWorkerBlocked(task_id, True))
             self._head_send(protocol.GetRequest(
                 hreq, msg.object_ids, msg.timeout))
+        elif (isinstance(msg, protocol.SubmitRequest)
+                and msg.seq is not None):
+            self._on_pipelined_submit(w, msg)
         elif isinstance(msg, (protocol.WaitRequest, protocol.SubmitRequest,
                               protocol.ActorCallRequest)):
             hreq = next(self._req)
@@ -470,6 +488,29 @@ class HostDaemon:
             self._head_send(fwd)
         else:
             logger.warning("unknown worker message %r", type(msg))
+
+    _SUBMIT_CREDIT_EVERY = max(1, constants.SUBMIT_WINDOW // 4)
+
+    def _on_pipelined_submit(self, w: _DWorker, msg) -> None:
+        """Worker->daemon leg of the pipelined submit stream: the same
+        seq state machine the head runs for local workers (in-order:
+        apply; duplicate: drop + re-credit; gap: nack once). "Apply"
+        here means relay ONCE on the reliable seq-wrapped head channel
+        — NodeSeq replay gives daemon->head exactly-once, so the
+        worker-side ring never needs to survive a daemon hop."""
+        seq = msg.seq
+        if seq == w.sub_next:
+            w.sub_next = seq + 1
+            w.sub_nacked = False
+            self._head_send(replace(msg, req_id=-1, seq=None,
+                                    submitter=w.worker_id))
+            if w.sub_next % self._SUBMIT_CREDIT_EVERY == 0:
+                w.send(protocol.SubmitCredit(w.sub_next - 1))
+        elif seq < w.sub_next:
+            w.send(protocol.SubmitCredit(w.sub_next - 1))
+        elif not w.sub_nacked:
+            w.sub_nacked = True
+            w.send(protocol.SubmitNack(w.sub_next))
 
     def _head_control(self, method, payload=None,
                       timeout: float | None = None):

@@ -45,6 +45,7 @@ from ray_tpu.exceptions import (
     ObjectLostError,
     PlacementGroupError,
     SchedulingError,
+    RayTpuError,
     TaskCancelledError,
     WorkerCrashedError,
 )
@@ -76,6 +77,90 @@ def _sub(avail: dict, req: dict) -> None:
 def _add(avail: dict, req: dict) -> None:
     for k, v in req.items():
         avail[k] = avail.get(k, 0.0) + v
+
+
+def plan_gang_placement(pools, bundles, strategy, *, links=None,
+                        link_load=None, bandwidth=0.0):
+    """Pure bundle-placement planner (no NodeServer state): pick a pool
+    for every bundle under `strategy`, contention-aware for bandwidth-
+    tagged gangs.
+
+    pools      ordered [(pool_id, available_resources)] — first entry is
+               the preferred pool (the head's own ledger).
+    links      pool_id -> iterable of interconnect link-group ids the
+               pool hangs off (ICI ring / DCN pod, RAY_TPU_LINK_GROUPS).
+    link_load  link id -> number of bandwidth-tagged gangs already
+               placed on that link.
+    bandwidth  this gang's declared appetite (GB/s); 0 keeps the legacy
+               ordering exactly (contention never enters the sort key).
+
+    Scoring follows the contention model of 2207.07817: a pool's cost is
+    the number of bandwidth-hungry gangs sharing any of its links, so a
+    tagged gang gets anti-affinity from links other tagged gangs load.
+    SPREAD ranks fitting pools by (bundle count so far, contention,
+    arrival order); PACK/STRICT_PACK rank by (contention, arrival
+    order). All keys are integers and the sort is stable, so placement
+    is deterministic for a given pool order and load map.
+
+    Returns a list of pool ids aligned with `bundles`, or None if the
+    gang is infeasible on the current free pools.
+    """
+    links = links or {}
+    link_load = link_load or {}
+    sim = {pid: dict(av) for pid, av in pools}
+    order = [pid for pid, _ in pools]
+    idx = {pid: i for i, pid in enumerate(order)}
+
+    if bandwidth:
+        cost = {pid: sum(link_load.get(l, 0) for l in links.get(pid, ()))
+                for pid in order}
+    else:
+        cost = dict.fromkeys(order, 0)
+
+    if strategy == "STRICT_PACK":
+        # every bundle on ONE pool; tagged gangs try quiet pools first
+        for pid in sorted(order, key=lambda p: (cost[p], idx[p])):
+            s = dict(sim[pid])
+            if all(_fits(s, b) and (_sub(s, b) or True) for b in bundles):
+                return [pid] * len(bundles)
+        return None
+    assignment = []
+    if strategy == "STRICT_SPREAD":
+        used = set()
+        for b in bundles:
+            ranked = sorted(order, key=lambda p: (cost[p], idx[p]))
+            pid = next((p for p in ranked
+                        if p not in used and _fits(sim[p], b)), None)
+            if pid is None:
+                return None
+            _sub(sim[pid], b)
+            used.add(pid)
+            assignment.append(pid)
+        return assignment
+    if strategy == "SPREAD":
+        # best-effort distinct: prefer the fitting pool with the fewest
+        # bundles so far, quietest links breaking the tie
+        counts = dict.fromkeys(order, 0)
+        for b in bundles:
+            ranked = sorted(order,
+                            key=lambda p: (counts[p], cost[p], idx[p]))
+            pid = next((p for p in ranked if _fits(sim[p], b)), None)
+            if pid is None:
+                return None
+            _sub(sim[pid], b)
+            counts[pid] += 1
+            assignment.append(pid)
+        return assignment
+    # PACK (default): first-fit in (contention, arrival) order — with no
+    # bandwidth tag that is exactly the legacy head-first scan
+    ranked = sorted(order, key=lambda p: (cost[p], idx[p]))
+    for b in bundles:
+        pid = next((p for p in ranked if _fits(sim[p], b)), None)
+        if pid is None:
+            return None
+        _sub(sim[pid], b)
+        assignment.append(pid)
+    return assignment
 
 
 @dataclass
@@ -117,6 +202,11 @@ class _WorkerConn:
     # True while a pool worker is converted into an actor host; lets a
     # failed constructor hand the (still healthy) worker back to the pool
     pooled_actor: bool = False
+    # Pipelined-submission receive state (only the per-worker reader
+    # thread touches these): next expected SubmitRequest.seq, and
+    # whether a nack for the current gap is already outstanding.
+    sub_next: int = 0
+    sub_nacked: bool = False
 
     def send(self, msg) -> bool:
         # conn is None between spawn and registration
@@ -152,6 +242,11 @@ class _PlacementGroup:
     strategy: str
     available: list = None   # per-bundle remaining resources
     bundle_nodes: list = None  # per-bundle node id (None = head)
+    # Declared interconnect appetite (GB/s, 0 = indifferent). Bandwidth-
+    # tagged gangs count toward per-link contention in the placement
+    # model (2207.07817): later tagged gangs steer away from links these
+    # bundles already load.
+    bandwidth: float = 0.0
 
     def __post_init__(self):
         if self.available is None:
@@ -172,6 +267,9 @@ class _RemoteNode:
     total: dict = field(default_factory=dict)
     available: dict = field(default_factory=dict)
     free_tpu_chips: list = field(default_factory=list)
+    # interconnect link-group ids this host hangs off (RegisterNode
+    # .link_groups, from RAY_TPU_LINK_GROUPS on the daemon's machine)
+    links: list = field(default_factory=list)
     alive: bool = True
     inflight: dict = field(default_factory=dict)  # task_id -> _TaskState
     last_seq: int = 0   # highest NodeSeq seen (dedupe for blip replays)
@@ -329,8 +427,7 @@ class NodeServer:
         self._free_event = threading.Event()
         threading.Thread(target=self._free_fanout_loop,
                          name="ray_tpu-free-fanout", daemon=True).start()
-        self._listener = connection.Listener(
-            family="AF_UNIX", address=self._address, authkey=self._authkey)
+        self._listener = netaddr.listener(self._address, self._authkey)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-accept", daemon=True)
         self._accept_thread.start()
@@ -712,7 +809,8 @@ class NodeServer:
                 }
             pgs = {pid: {"bundles": pg.bundles, "strategy": pg.strategy,
                          "available": pg.available,
-                         "bundle_nodes": pg.bundle_nodes}
+                         "bundle_nodes": pg.bundle_nodes,
+                         "bandwidth": pg.bandwidth}
                    for pid, pg in self.placement_groups.items()}
             return {
                 "named_actors": dict(self.named_actors),
@@ -785,7 +883,8 @@ class NodeServer:
         for pid, d in state.get("placement_groups", {}).items():
             self.placement_groups[pid] = _PlacementGroup(
                 pg_id=pid, bundles=d["bundles"], strategy=d["strategy"],
-                available=d["available"], bundle_nodes=d["bundle_nodes"])
+                available=d["available"], bundle_nodes=d["bundle_nodes"],
+                bandwidth=d.get("bandwidth", 0.0))
             # bundles reserved on the head itself are re-held now;
             # daemon-side bundles are re-held at re-registration
             for b, nid in zip(d["bundles"], d["bundle_nodes"]):
@@ -891,16 +990,49 @@ class NodeServer:
             threading.Thread(
                 target=self._serve_wait, args=(w, msg), daemon=True).start()
         elif isinstance(msg, protocol.SubmitRequest):
-            try:
-                self.submit(msg.spec, submitter=w)
-                w.send(protocol.SubmitReply(msg.req_id, ok=True))
-            except Exception as e:
-                w.send(protocol.SubmitReply(msg.req_id, ok=False,
-                                            error=repr(e)))
+            if msg.seq is not None:
+                self._on_pipelined_submit(w, msg)
+            else:
+                try:
+                    self.submit(msg.spec, submitter=w)
+                    w.send(protocol.SubmitReply(msg.req_id, ok=True))
+                except Exception as e:
+                    w.send(protocol.SubmitReply(msg.req_id, ok=False,
+                                                error=repr(e)))
         elif isinstance(msg, protocol.ActorCallRequest):
             self._dispatch_control(w, msg)
         else:
             logger.warning("unknown message %r", type(msg))
+
+    # Credit cadence for pipelined submissions: ack every quarter window
+    # so the sender's ring stays shallow without an ack per task.
+    _SUBMIT_CREDIT_EVERY = max(1, constants.SUBMIT_WINDOW // 4)
+
+    def _on_pipelined_submit(self, w: _WorkerConn, msg) -> None:
+        """Seq state machine for one worker's pipelined submit stream
+        (runs on that worker's reader thread, the only writer of
+        `sub_next`/`sub_nacked`). In-order: apply + periodic credit.
+        Duplicate (replay overlap): drop and re-credit, so the sender
+        prunes its ring and learns the watermark even when the original
+        credit was lost. Gap: nack once with the expected seq; the
+        sender replays from there in order."""
+        seq = msg.seq
+        if seq == w.sub_next:
+            w.sub_next = seq + 1
+            w.sub_nacked = False
+            try:
+                self.submit(msg.spec, submitter=w)
+            except Exception as e:
+                if not isinstance(e, RayTpuError):
+                    e = RayTpuError(f"submit failed: {e!r}")
+                self._store_error(msg.spec.return_ids, e, spec=msg.spec)
+            if w.sub_next % self._SUBMIT_CREDIT_EVERY == 0:
+                w.send(protocol.SubmitCredit(w.sub_next - 1))
+        elif seq < w.sub_next:
+            w.send(protocol.SubmitCredit(w.sub_next - 1))
+        elif not w.sub_nacked:
+            w.sub_nacked = True
+            w.send(protocol.SubmitNack(w.sub_next))
 
     # Control verbs that may block for a long time (autoscaler-waiting
     # placement groups) must not run inline on a connection's reader
@@ -932,6 +1064,7 @@ class NodeServer:
             pid=reg.pid, total=dict(reg.resources),
             available=dict(reg.resources),
             free_tpu_chips=list(range(reg.num_tpu_chips)),
+            links=list(reg.link_groups or ()),
             worker_id="node:" + reg.node_id)
         with self.lock:
             old = self.nodes.get(reg.node_id)
@@ -1136,13 +1269,24 @@ class NodeServer:
             threading.Thread(target=self._serve_wait, args=(node, msg),
                              daemon=True).start()
         elif isinstance(msg, protocol.SubmitRequest):
+            # req_id < 0 marks a pipelined submission the daemon already
+            # deduped and forwarded on the reliable (NodeSeq) channel:
+            # apply it, never reply — failures become error objects
+            # under the spec's return ids.
             try:
                 self.submit(msg.spec,
                             submitter=msg.submitter or node.worker_id)
-                node.send(protocol.SubmitReply(msg.req_id, ok=True))
+                if msg.req_id >= 0:
+                    node.send(protocol.SubmitReply(msg.req_id, ok=True))
             except Exception as e:
-                node.send(protocol.SubmitReply(msg.req_id, ok=False,
-                                               error=repr(e)))
+                if msg.req_id >= 0:
+                    node.send(protocol.SubmitReply(msg.req_id, ok=False,
+                                                   error=repr(e)))
+                else:
+                    if not isinstance(e, RayTpuError):
+                        e = RayTpuError(f"submit failed: {e!r}")
+                    self._store_error(msg.spec.return_ids, e,
+                                      spec=msg.spec)
         elif isinstance(msg, protocol.ActorCallRequest):
             self._dispatch_control(node, msg)
         else:
@@ -1311,6 +1455,7 @@ class NodeServer:
                 return [{
                     "placement_group_id": pg.pg_id,
                     "strategy": pg.strategy,
+                    "bandwidth": pg.bandwidth,
                     "bundles": [dict(b) for b in pg.bundles],
                     "available": [dict(b) for b in pg.available],
                 } for pg in itertools.islice(
@@ -1564,6 +1709,10 @@ class NodeServer:
                 self.task_events.queued(t.spec.task_id)
         for waiter in self._get_waiters.pop(object_id, ()):
             waiter["n"] -= 1
+            if waiter["n"] <= 0:
+                ev = waiter.get("ev")
+                if ev is not None:
+                    ev.set()
         self.cv.notify_all()
         return bool(waiting)
 
@@ -1585,6 +1734,9 @@ class NodeServer:
         registration wakeups can starve indefinitely). Caller holds lock."""
         for waiter in self._get_waiters.get(oid, ()):
             waiter["dirty"] = True
+            ev = waiter.get("ev")
+            if ev is not None:
+                ev.set()
         self.cv.notify_all()
 
     def register_object(self, object_id: str, desc: Descriptor,
@@ -1597,7 +1749,14 @@ class NodeServer:
     def put_value(self, value) -> str:
         oid = ids.new_object_id()
         desc = self.store.put(oid, value)
-        self.register_object(oid, desc)
+        # Owner fast path: a FRESH object id cannot have get/wait
+        # waiters, dependent tasks, or lost/reconstructing/dead-pending
+        # state (its ObjectRef does not exist until this returns), so a
+        # bare directory insert replaces the full registration sweep —
+        # no waiter walk, no notify_all herd, nothing to schedule.
+        with self.lock:
+            self.directory[oid] = desc
+            self.obj_origin[oid] = "driver"
         return oid
 
     def get_locations(self, object_ids, timeout=None, localize=True) -> dict:
@@ -1607,8 +1766,25 @@ class NodeServer:
         COUNTER waiter that registrations decrement — a get() over 100k
         refs costs O(ids), not O(ids) per wakeup."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Fast path: everything already registered (the common shape for
+        # put-then-get and for draining completed results) — one dict
+        # sweep under the lock, no waiter bookkeeping.
         with self.cv:
-            while True:
+            directory = self.directory
+            locs = {}
+            for o in object_ids:
+                d = directory.get(o)
+                if d is None:
+                    locs = None
+                    break
+                locs[o] = d
+        if locs is not None:
+            self.task_events.mark_got(object_ids)
+            if localize:
+                locs = self._localize(locs, deadline=deadline)
+            return locs
+        while True:
+            with self.lock:
                 missing = [o for o in object_ids
                            if o not in self.directory]
                 freed = [o for o in missing if o in self.freed_refs]
@@ -1624,34 +1800,39 @@ class NodeServer:
                 if not missing:
                     locs = {o: self.directory[o] for o in object_ids}
                     break
-                waiter = {"n": len(missing)}
+                # Private wakeup channel: registrations decrement the
+                # counter and set the event only when it reaches ZERO
+                # (free/loss paths set `dirty` + the event), so the
+                # per-completion notify herd never lands on a blocked
+                # get — draining N results wakes this thread once, not
+                # once per TaskDone. The 1s tick stays as the
+                # belt-and-braces re-check path.
+                waiter = {"n": len(missing), "ev": threading.Event()}
                 for o in missing:
                     self._get_waiters.setdefault(o, []).append(waiter)
-                try:
-                    while waiter["n"] > 0:
-                        if deadline is not None:
-                            rem = deadline - time.monotonic()
-                            if rem <= 0:
-                                raise GetTimeoutError(
-                                    f"get() timed out waiting for "
-                                    f"{missing[:3]}...")
-                            notified = self.cv.wait(min(rem, 1.0))
-                        else:
-                            notified = self.cv.wait(1.0)
-                        # freed/lost don't decrement the counter; the
-                        # free/lost paths set `dirty` on registered
-                        # waiters so we re-check on any wakeup without
-                        # an O(ids) scan per registration wakeup. The
-                        # 1s-tick scan stays as a belt-and-braces path.
-                        if waiter.get("dirty"):
+            ev = waiter["ev"]
+            try:
+                while True:
+                    if deadline is not None:
+                        rem = deadline - time.monotonic()
+                        if rem <= 0:
+                            raise GetTimeoutError(
+                                f"get() timed out waiting for "
+                                f"{missing[:3]}...")
+                        notified = ev.wait(min(rem, 1.0))
+                    else:
+                        notified = ev.wait(1.0)
+                    with self.lock:
+                        if waiter["n"] <= 0 or waiter.get("dirty"):
                             break
-                        if (not notified and waiter["n"] > 0 and any(
+                        if not notified and any(
                                 o in self.freed_refs
                                 or o in self.lost_objects
                                 for o in missing
-                                if o not in self.directory)):
+                                if o not in self.directory):
                             break
-                finally:
+            finally:
+                with self.lock:
                     for o in missing:
                         lst = self._get_waiters.get(o)
                         if lst is not None:
@@ -1661,9 +1842,9 @@ class NodeServer:
                                 pass
                             if not lst:
                                 self._get_waiters.pop(o, None)
-                # loop back: re-verify everything under the same lock
-                # (an object may have been freed between registration
-                # and this read — the outer while handles it)
+            # loop back: re-verify everything under the lock (an object
+            # may have been freed between registration and this read —
+            # the outer while handles it)
         self.task_events.mark_got(object_ids)   # close the `got` stage
         if localize:
             locs = self._localize(locs, deadline=deadline)
@@ -2457,8 +2638,18 @@ class NodeServer:
                 self.pending.append(t)
                 # pending may be deep with dep-BLOCKED tasks while
                 # capacity sits idle: the scheduler thread must still
-                # look at this task now, not at its 1 s safety tick
-                self._sched_event.set()
+                # look at this task now, not at its 1 s safety tick.
+                # But ONLY when the task could actually go somewhere —
+                # during a submit storm with the local pool saturated
+                # (the common saturated-bench shape) an unconditional
+                # wake keeps the scheduler thread scanning the backlog
+                # full-time, stealing the core from the submitters and
+                # executors. If the shape doesn't fit the local free
+                # pool and there are no remote nodes, no pass can
+                # dispatch or spawn for it now; the capacity-freeing
+                # event that changes that fires its own _schedule().
+                if self.nodes or _fits(self.available, spec.resources):
+                    self._sched_event.set()
                 return
             to_send = []
             if spec.actor_creation:
@@ -3134,8 +3325,9 @@ class NodeServer:
                 return_ids=spec.return_ids)
             self._release_task_args(spec)
             for oid, desc in zip(spec.return_ids, msg.return_descs):
+                # _register_locked already notifies waiters per oid; a
+                # second notify_all here was pure herd overhead
                 self._register_locked(oid, desc, origin=w.worker_id)
-            self.cv.notify_all()
             if a is not None:
                 if t in a.inflight:
                     a.inflight.remove(t)
@@ -3208,18 +3400,26 @@ class NodeServer:
         self._schedule()
 
     def _dispatch_freed_fastpath(self) -> bool:
-        """Hand the just-freed slot the head-of-line pending task.
-        Bounded: one dispatch (or a couple of cancelled-task pops);
-        anything trickier falls back to the scheduler pass. Returns True
-        iff the slot was cleanly filled (or there is nothing to run) so
-        the scheduler event can be skipped — the next completion
-        continues the chain."""
+        """Hand freed slots the head-of-line pending tasks. Batched:
+        dequeue -> match -> dispatch for up to SCHEDULER_FREED_BATCH
+        plain tasks under ONE lock acquisition — concurrent completions
+        free several slots at once, and the first reader through the
+        lock fills them all instead of paying an acquire/release per
+        task. Anything trickier (deps, actors, placement groups,
+        scheduling strategies) falls back to the scheduler pass.
+        Returns True iff the freed capacity was cleanly consumed (or
+        nothing is runnable) so the scheduler event can be skipped —
+        the next completion continues the chain."""
         to_send = []
         ok = False
+        need_pass = False
+        filled = 0
         with self.lock:
             if self._shutdown:
                 return True
-            for _ in range(64):        # bound: cancelled-task pops only
+            for _ in range(64):        # bound: pops + dispatch attempts
+                if filled >= constants.SCHEDULER_FREED_BATCH:
+                    break
                 if not self.pending:
                     ok = True          # nothing queued: slot stays free
                     break
@@ -3231,18 +3431,36 @@ class NodeServer:
                         or t.spec.actor_id is not None
                         or t.spec.placement_group_id
                         or t.spec.scheduling_strategy):
-                    break              # needs the real pass
+                    need_pass = True   # needs the real pass
+                    break
+                if (filled and not self.nodes
+                        and not _fits(self.available, t.spec.resources)):
+                    # freed slot(s) already refilled and the local pool
+                    # can't absorb another of this shape: stop before
+                    # paying a full placement scan that must fail
+                    break
                 self.pending.popleft()
+                n_before = len(to_send)
                 if self._try_dispatch_generic(t, to_send) is True:
                     # "consumed" is not "slot filled": infeasible tasks
                     # return True with nothing sent, and a remote
-                    # dispatch leaves the LOCAL slot idle — both need
-                    # the real pass to keep draining
-                    ok = any(isinstance(w, _WorkerConn)
-                             for w, _ in to_send)
+                    # dispatch leaves the LOCAL slot idle — keep going,
+                    # a later queued task may fill it
+                    if any(isinstance(w, _WorkerConn)
+                           for w, _ in to_send[n_before:]):
+                        filled += 1
+                        ok = True
                 else:
+                    # No capacity left (or needs localization). If we
+                    # already filled the freed slot(s), the backlog is
+                    # simply deeper than the capacity — the next
+                    # completion continues the chain and a full pass
+                    # would be pure overhead. Only an UNFILLED freed
+                    # slot needs the real pass.
                     self.pending.appendleft(t)
-                break
+                    if filled == 0:
+                        need_pass = True
+                    break
         for w, msg in to_send:
             if not w.send(msg):
                 if isinstance(w, _RemoteNode):
@@ -3250,7 +3468,7 @@ class NodeServer:
                 else:
                     self._on_worker_death(w)
                 ok = False
-        return ok
+        return ok and not need_pass
 
     def _requeue_after_failure(self, w, t, a):
         """Re-run a failed task (called under lock)."""
@@ -3527,66 +3745,55 @@ class NodeServer:
     # single resource ledger.
     # ------------------------------------------------------------------
 
-    def _assign_bundles(self, bundles, strategy):
+    def _pool_links_locked(self) -> dict:
+        """pool id -> link-group ids, for the contention model. The head's
+        own links come from its env; daemons advertised theirs in
+        RegisterNode."""
+        links = {"head": tuple(
+            s for s in config.get("LINK_GROUPS").split(",") if s)}
+        for nid, n in self.nodes.items():
+            if n.alive:
+                links[nid] = tuple(n.links)
+        return links
+
+    def _link_load_locked(self, pool_links: dict) -> dict:
+        """link id -> count of live bandwidth-tagged gangs touching it.
+        Recomputed from the placement-group table at gang-creation time
+        (rare), so the remove/failure paths carry no extra bookkeeping."""
+        load: dict = {}
+        for pg in self.placement_groups.values():
+            if not pg.bandwidth:
+                continue
+            touched = set()
+            for nid in pg.bundle_nodes:
+                touched.update(pool_links.get(
+                    "head" if nid is None else nid, ()))
+            for link in touched:
+                load[link] = load.get(link, 0) + 1
+        return load
+
+    def _assign_bundles(self, bundles, strategy, bandwidth=0.0):
         """Pick a node for every bundle. Returns list of node ids (None =
         head) or None if infeasible. Caller holds the lock. The head pool
         is keyed "head" internally so it can't collide with the "no
-        fitting pool" sentinel."""
+        fitting pool" sentinel; planning itself is the pure module-level
+        plan_gang_placement."""
         pools = [("head", self.available)]
         pools += [(nid, n.available) for nid, n in self.nodes.items()
                   if n.alive]
-        sim = {pid: dict(av) for pid, av in pools}
-        order = [pid for pid, _ in pools]
-
-        def out(assignment):
-            return [None if pid == "head" else pid for pid in assignment]
-
-        if strategy == "STRICT_PACK":
-            # every bundle on ONE node
-            for pid in order:
-                s = dict(sim[pid])
-                if all(_fits(s, b) and (_sub(s, b) or True)
-                       for b in bundles):
-                    return out([pid] * len(bundles))
+        pool_links = self._pool_links_locked()
+        assignment = plan_gang_placement(
+            pools, bundles, strategy, links=pool_links,
+            link_load=self._link_load_locked(pool_links),
+            bandwidth=bandwidth)
+        if assignment is None:
             return None
-        assignment = []
-        if strategy == "STRICT_SPREAD":
-            used = set()
-            for b in bundles:
-                pid = next((p for p in order
-                            if p not in used and _fits(sim[p], b)), None)
-                if pid is None:
-                    return None
-                _sub(sim[pid], b)
-                used.add(pid)
-                assignment.append(pid)
-            return out(assignment)
-        if strategy == "SPREAD":
-            # best-effort distinct: prefer the fitting node with the
-            # fewest bundles so far
-            counts = {p: 0 for p in order}
-            for b in bundles:
-                ranked = sorted(order, key=lambda p: counts[p])
-                pid = next((p for p in ranked if _fits(sim[p], b)), None)
-                if pid is None:
-                    return None
-                _sub(sim[pid], b)
-                counts[pid] += 1
-                assignment.append(pid)
-            return out(assignment)
-        # PACK (default): first-fit, head first
-        for b in bundles:
-            pid = next((p for p in order if _fits(sim[p], b)), None)
-            if pid is None:
-                return None
-            _sub(sim[pid], b)
-            assignment.append(pid)
-        return out(assignment)
+        return [None if pid == "head" else pid for pid in assignment]
 
-    def _try_reserve_pg_locked(self, bundles, strategy):
+    def _try_reserve_pg_locked(self, bundles, strategy, bandwidth=0.0):
         """Assign + debit atomically (caller holds the lock); returns the
         new pg_id or None if currently infeasible."""
-        assignment = self._assign_bundles(bundles, strategy)
+        assignment = self._assign_bundles(bundles, strategy, bandwidth)
         if assignment is None:
             return None
         for b, nid in zip(bundles, assignment):
@@ -3596,13 +3803,16 @@ class NodeServer:
                 _sub(self.nodes[nid].available, b)
         pg_id = ids.new_placement_group_id()
         self.placement_groups[pg_id] = _PlacementGroup(
-            pg_id, bundles, strategy, bundle_nodes=list(assignment))
+            pg_id, bundles, strategy, bundle_nodes=list(assignment),
+            bandwidth=float(bandwidth or 0.0))
         return pg_id
 
-    def create_placement_group(self, bundles, strategy="PACK", name=""):
+    def create_placement_group(self, bundles, strategy="PACK", name="",
+                               bandwidth=0.0):
         bundles = [dict(b) for b in bundles]
         with self.lock:
-            pg_id = self._try_reserve_pg_locked(bundles, strategy)
+            pg_id = self._try_reserve_pg_locked(bundles, strategy,
+                                                bandwidth)
         if pg_id is not None:
             return pg_id
         if getattr(self, "_autoscaler", None) is not None:
@@ -3619,8 +3829,8 @@ class NodeServer:
             try:
                 while True:
                     with self.cv:
-                        pg_id = self._try_reserve_pg_locked(bundles,
-                                                            strategy)
+                        pg_id = self._try_reserve_pg_locked(
+                            bundles, strategy, bandwidth)
                         if pg_id is not None:
                             return pg_id
                         rem = deadline - time.monotonic()

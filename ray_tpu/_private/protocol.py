@@ -202,10 +202,24 @@ class WaitReply:
 class SubmitRequest:
     """Nested task/actor submission from inside a worker. `submitter`
     carries the submitting worker's id when relayed through a HostDaemon
-    (implicit holds on the fresh return refs must be keyed by it)."""
+    (implicit holds on the fresh return refs must be keyed by it).
+
+    Two delivery modes share this type:
+
+    * classic (``seq is None``): one blocking round trip, the receiver
+      answers with a SubmitReply keyed by ``req_id``;
+    * pipelined (``seq >= 0``): the worker streams specs without
+      per-task acks under a credit window. ``seq`` is the per-channel
+      monotone sequence number; the receiver applies in-order arrivals,
+      drops duplicates (replays), nacks gaps (SubmitNack), and returns
+      flow-control credit (SubmitCredit). ``req_id`` is ``-1`` — no
+      reply is ever sent for a pipelined submission; failures surface
+      as error objects stored under the spec's return ids.
+    """
     req_id: int
     spec: TaskSpec
     submitter: str | None = None
+    seq: int | None = None
 
 
 @dataclass
@@ -213,6 +227,23 @@ class SubmitReply:
     req_id: int
     ok: bool = True
     error: str | None = None
+
+
+@dataclass
+class SubmitCredit:
+    """Head/daemon -> worker: every pipelined SubmitRequest with
+    ``seq <= ack_seq`` has been applied (or deduped); the worker prunes
+    its replay ring and opens the submit window."""
+    ack_seq: int
+
+
+@dataclass
+class SubmitNack:
+    """Head/daemon -> worker: a pipelined SubmitRequest arrived out of
+    order (a frame was lost); replay the ring from ``expected_seq`` in
+    order. Out-of-order arrivals past the gap are dropped, so replay
+    restores contiguity without reordering."""
+    expected_seq: int
 
 
 @dataclass
@@ -269,6 +300,11 @@ class RegisterNode:
     # inflight that is NOT listed was swallowed by the channel blip —
     # the head must re-dispatch it instead of waiting forever.
     leases: list | None = None
+    # Interconnect link groups (ICI ring / DCN pod ids) this node hangs
+    # off, from RAY_TPU_LINK_GROUPS — the contention-aware gang
+    # placement model (2207.07817) scores PACK/SPREAD candidates by
+    # per-link load from already-placed bandwidth-hungry gangs.
+    link_groups: list | None = None
 
 
 @dataclass

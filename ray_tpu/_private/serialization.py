@@ -30,6 +30,22 @@ def _align(n: int) -> int:
 
 def _dumps_with_buffers(value) -> tuple[bytes, list[pickle.PickleBuffer]]:
     buffers: list[pickle.PickleBuffer] = []
+    # Fast path: plain pickle (C pickler, no reducer_override dispatch) —
+    # this is most of the put() cost for small data values. Two escapes
+    # to cloudpickle: anything plain pickle can't handle (lambdas,
+    # closures, locally-defined classes) raises, and anything that
+    # pickled BY REFERENCE into __main__ would unpickle against the
+    # wrong __main__ in another process — cloudpickle serializes those
+    # by value. The b"__main__" scan is conservative: a false hit only
+    # costs the fallback.
+    try:
+        meta = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffers.append)
+        if b"__main__" not in meta:
+            return meta, buffers
+    except Exception:
+        pass
+    buffers.clear()
     # cloudpickle.dumps supports protocol 5 + buffer_callback and falls back to
     # pickling by value for interactively-defined functions/classes.
     meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)

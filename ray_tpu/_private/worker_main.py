@@ -80,6 +80,19 @@ class WorkerRuntime:
         # order and free an object with a live ref.
         self._ref_lock = threading.Lock()
         self._ref_pending: list[tuple[str, str]] = []
+        # Pipelined submission state (credit window + replay ring).
+        # Submissions stream without per-task acks; `_sub_ring` retains
+        # every spec past the last credit so a SubmitNack (the head saw
+        # a seq gap) or the resync timer can replay it. Guarded by
+        # `_sub_cv`'s lock; `_sub_next` is the next seq to assign,
+        # `_sub_acked` the highest credited seq.
+        from ray_tpu._private import config as _config
+        self._sub_pipelined = bool(_config.get("SUBMIT_PIPELINE"))
+        self._sub_cv = threading.Condition()
+        self._sub_ring: dict[int, object] = {}
+        self._sub_next = 0
+        self._sub_acked = -1
+        self._sub_last_progress = time.monotonic()
         threading.Thread(target=self._ref_flush_loop,
                          name="ref-flush", daemon=True).start()
 
@@ -149,6 +162,11 @@ class WorkerRuntime:
                 self.task_queue.put(None)
                 with self._reply_cv:
                     self._reply_cv.notify_all()
+            elif isinstance(msg, protocol.SubmitCredit):
+                self._on_submit_credit(msg.ack_seq)
+            elif isinstance(msg, protocol.SubmitNack):
+                with self._sub_cv:
+                    self._replay_submits_locked(msg.expected_seq)
             elif isinstance(msg, (protocol.GetReply, protocol.WaitReply,
                                   protocol.SubmitReply,
                                   protocol.ActorCallReply,
@@ -218,9 +236,62 @@ class WorkerRuntime:
         return reply.ready, reply.not_ready
 
     def submit_spec(self, spec):
-        reply = self.request(lambda rid: protocol.SubmitRequest(rid, spec))
-        if not reply.ok:
-            raise RayTpuError(f"submit failed: {reply.error}")
+        if not self._sub_pipelined:
+            reply = self.request(
+                lambda rid: protocol.SubmitRequest(rid, spec))
+            if not reply.ok:
+                raise RayTpuError(f"submit failed: {reply.error}")
+            return
+        # Pipelined: assign the next seq, retain the spec for replay,
+        # block only when the credit window is exhausted. No reply is
+        # awaited — submit failures surface as error objects stored
+        # under the spec's return ids (matching how the reference's
+        # async task submission reports scheduling errors).
+        from ray_tpu._private.constants import (SUBMIT_RESYNC_S,
+                                                SUBMIT_WINDOW)
+        with self._sub_cv:
+            while (self._sub_next - self._sub_acked > SUBMIT_WINDOW
+                   and not self.shutdown):
+                progressed = self._sub_cv.wait(SUBMIT_RESYNC_S)
+                if not progressed:
+                    self._replay_submits_locked(self._sub_acked + 1)
+            if self.shutdown:
+                raise RuntimeError("worker shutting down")
+            seq = self._sub_next
+            self._sub_next = seq + 1
+            self._sub_ring[seq] = spec
+        self.send(protocol.SubmitRequest(-1, spec, seq=seq))
+
+    def _replay_submits_locked(self, from_seq: int) -> None:
+        """Re-send every retained spec with seq >= from_seq in order
+        (caller holds _sub_cv). Duplicates are dropped by the receiver's
+        seq dedupe, which re-credits — so replay is idempotent and also
+        recovers a lost credit."""
+        for seq in sorted(self._sub_ring):
+            if seq >= from_seq:
+                self.send(protocol.SubmitRequest(
+                    -1, self._sub_ring[seq], seq=seq))
+        self._sub_last_progress = time.monotonic()
+
+    def _on_submit_credit(self, ack_seq: int) -> None:
+        with self._sub_cv:
+            if ack_seq > self._sub_acked:
+                self._sub_acked = ack_seq
+                for seq in [s for s in self._sub_ring if s <= ack_seq]:
+                    del self._sub_ring[seq]
+                self._sub_last_progress = time.monotonic()
+                self._sub_cv.notify_all()
+
+    def _submit_resync(self) -> None:
+        """Periodic (ref-flush cadence): with unacked submissions and no
+        credit progress for SUBMIT_RESYNC_S, replay the ring — covers a
+        lost tail message that no later gap would ever reveal."""
+        from ray_tpu._private.constants import SUBMIT_RESYNC_S
+        with self._sub_cv:
+            if (self._sub_ring
+                    and time.monotonic() - self._sub_last_progress
+                    > SUBMIT_RESYNC_S):
+                self._replay_submits_locked(self._sub_acked + 1)
 
     def control(self, method, payload=None):
         reply = self.request(lambda rid: protocol.ActorCallRequest(
@@ -253,6 +324,7 @@ class WorkerRuntime:
             time.sleep(REF_FLUSH_INTERVAL_S)
             _worker_mod._drain_decs()
             self._flush_ref_events()
+            self._submit_resync()
 
     # ---- execution --------------------------------------------------------
 

@@ -29,6 +29,7 @@ FAULTS = "ray_tpu/util/faults.py"
 TRACING = "ray_tpu/util/tracing.py"
 EVENTS = "ray_tpu/_private/events.py"
 WORKER_MAIN = "ray_tpu/_private/worker_main.py"
+NETADDR = "ray_tpu/_private/netaddr.py"
 
 # --- R001: functions whose bodies are latency-critical host code. A
 # host sync here stalls the device queue (or the scheduler tick).
@@ -176,6 +177,24 @@ LOCKS: dict[str, dict[str, LockSpec]] = {
         # leaf-level: no metrics/tracing edges
         "self._lock": LockSpec("events.recorder"),
     },
+    NETADDR: {
+        # outbound-queue condition: senders wait under it for
+        # backpressure credit, the flusher waits under it for work
+        "self._qcv": LockSpec("netaddr.batch.queue", blocking_ok=True),
+        # serializes wire writes; its whole job is to hold a (blocking)
+        # socket send away from the queue state
+        "self._wire_lock": LockSpec("netaddr.batch.wire",
+                                    blocking_ok=True),
+        # one-shot UDP interface probe memo
+        "_advertise_lock": LockSpec("netaddr.advertise",
+                                    blocking_ok=True),
+    },
+    WORKER_MAIN: {
+        # pipelined-submission window: submitters wait under it when
+        # the credit window is exhausted
+        "self._sub_cv": LockSpec("worker.submit_window",
+                                 blocking_ok=True),
+    },
 }
 
 # Declared lock-order edges (may-acquire-while-holding). Observed
@@ -189,4 +208,8 @@ LOCK_ORDER: frozenset[tuple[str, str]] = frozenset({
     # handle refresh: controller RPC under the blocking-ok refresh lock,
     # snapshot/commit under the router lock
     ("serve.handle.refresh", "serve.handle.router"),
+    # frame flusher / send_bytes: pop the outbound queue while holding
+    # the wire (send()'s opposite-direction wire probe is a
+    # non-blocking try-acquire, so it adds no queue->wire edge)
+    ("netaddr.batch.wire", "netaddr.batch.queue"),
 })

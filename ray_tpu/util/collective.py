@@ -166,15 +166,33 @@ class CollectiveGroup:
         actor_name = f"_rtpu_collective:{name}"
         try:
             self._actor = ray_tpu.get_actor(actor_name)
+            return
         except ValueError:
-            cls = ray_tpu.remote(_RendezvousActor)
+            pass
+        cls = ray_tpu.remote(_RendezvousActor)
+        try:
+            cls.options(
+                name=actor_name,
+                max_concurrency=max(2 * world_size, 4),
+            ).remote(world_size)
+        except Exception:
+            pass
+        # Ranks race to create the group actor, and under pipelined
+        # submission a lost naming race surfaces as an error object on
+        # the creation return — not as a raised exception here. The
+        # head's name table is the single authority either way: bind to
+        # whichever creation it registered, polling briefly until the
+        # winner's (possibly in-flight) registration lands.
+        import time as _time
+        deadline = _time.monotonic() + 30.0
+        while True:
             try:
-                self._actor = cls.options(
-                    name=actor_name,
-                    max_concurrency=max(2 * world_size, 4),
-                ).remote(world_size)
-            except Exception:
                 self._actor = ray_tpu.get_actor(actor_name)
+                return
+            except ValueError:
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.01)
 
     def allreduce(self, arr, op: str = "sum"):
         return ray_tpu.get(self._actor.allreduce.remote(

@@ -22,6 +22,7 @@ class PlacementGroup:
     id: str
     bundles: list = field(default_factory=list)
     strategy: str = "PACK"
+    bandwidth: float = 0.0
 
     def ready(self) -> ObjectRef:
         """ObjectRef resolving when the reservation is committed. Creation
@@ -36,19 +37,27 @@ class PlacementGroup:
         return True
 
 
-def placement_group(bundles, strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+def placement_group(bundles, strategy: str = "PACK", name: str = "",
+                    bandwidth: float = 0.0) -> PlacementGroup:
+    """`bandwidth` declares the gang's interconnect appetite in GB/s
+    (all-reduce-heavy training jobs). Tagged gangs participate in the
+    head's per-link contention model: their bundles steer away from ICI/
+    DCN link groups that other tagged gangs already load (2207.07817).
+    0 (default) keeps legacy placement exactly."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"invalid strategy {strategy!r}; "
                          f"one of {VALID_STRATEGIES}")
+    if bandwidth < 0:
+        raise ValueError("bandwidth must be >= 0")
     norm = []
     for b in bundles:
         if not isinstance(b, dict) or not b:
             raise ValueError("each bundle must be a non-empty dict")
         norm.append({k: float(v) for k, v in b.items()})
     pg_id = _worker.get_client().control(
-        "create_pg", {"bundles": norm, "strategy": strategy, "name": name})
-    return PlacementGroup(pg_id, norm, strategy)
+        "create_pg", {"bundles": norm, "strategy": strategy, "name": name,
+                      "bandwidth": float(bandwidth)})
+    return PlacementGroup(pg_id, norm, strategy, float(bandwidth))
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

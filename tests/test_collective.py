@@ -46,6 +46,24 @@ def test_collective_send_recv(ray_session):
     assert ray_tpu.get(s, timeout=120)
 
 
+def test_named_group_create_race_converges(ray_session):
+    """All ranks racing to create the group's rendezvous actor must bind
+    to the SAME actor. Under pipelined submission the losing create no
+    longer raises at `.remote()` (the name collision surfaces as an
+    error object), so the client must re-resolve through the head's name
+    table instead of trusting its own handle."""
+    def join(rank, world):
+        from ray_tpu.util import collective as col
+        g = col.init_collective_group(world, rank, group_name="race")
+        return g._actor._actor_id
+
+    world = 4
+    fn = ray_tpu.remote(join)
+    refs = [fn.remote(r, world) for r in range(world)]
+    ids = ray_tpu.get(refs, timeout=120)
+    assert len(set(ids)) == 1, ids
+
+
 def test_collective_refuses_big_tensors(ray_session):
     """The host-side group is a control-plane funnel (one rendezvous
     actor); model-state-sized payloads must be refused with a pointer at

@@ -162,3 +162,45 @@ def test_dropped_control_message_is_typed_timeout(ray_session):
     finally:
         faults.clear()
         client.close()
+
+
+def test_batched_frame_faults_stay_per_logical_message(tmp_path):
+    """A coalesced burst rides ONE wire frame, but the fault proxy sits
+    OUTSIDE the frame layer: seeded drop decisions hit individual
+    logical messages, and the survivors keep FIFO order."""
+    from ray_tpu._private import netaddr
+    faults.install(faults.FaultPlan(seed=11)
+                   .drop("netaddr.send", at=2)
+                   .drop("netaddr.send", at=5))
+    addr = str(tmp_path / "chan.sock")
+    lst = netaddr.listener(addr, b"k")
+    box = {}
+    t = threading.Thread(target=lambda: box.update(s=lst.accept()),
+                         daemon=True)
+    t.start()
+    client = netaddr.client(addr, b"k")
+    t.join(timeout=10)
+    server = box["s"]
+    bc = client._conn          # the BatchedConnection under the proxy
+    try:
+        # Hold the wire so the burst queues behind it — the flusher then
+        # drains all survivors into a single _Batch frame.
+        with bc._wire_lock:
+            for i in range(8):
+                client.send(i)
+        bc.flush(timeout=5.0)
+        got = []
+        while server.poll(1.0):
+            got.append(server.recv())
+            if server._in:
+                # unpacked siblings from the same wire frame: proof the
+                # burst really coalesced
+                box["framed"] = True
+        assert got == [0, 1, 3, 4, 6, 7]   # visits 2 and 5 vanished
+        assert box.get("framed"), "burst did not coalesce into a frame"
+        assert [(s, v) for s, v, a in faults.fired() if a == "drop"] \
+            == [("netaddr.send", 2), ("netaddr.send", 5)]
+    finally:
+        client.close()
+        server.close()
+        lst.close()

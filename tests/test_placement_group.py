@@ -39,3 +39,105 @@ def test_pg_ready(ray_session):
     pg = placement_group([{"CPU": 1}])
     assert ray_tpu.get(pg.ready(), timeout=10) is True
     remove_placement_group(pg)
+
+
+# ---------------------------------------------------------------------------
+# contention-aware gang placement (pure planner, 2207.07817's link model)
+# ---------------------------------------------------------------------------
+
+from ray_tpu._private.node import plan_gang_placement
+
+
+def _two_link_topology():
+    """Four equal nodes, two per interconnect link group."""
+    pools = [(n, {"CPU": 2.0}) for n in ("n1", "n2", "n3", "n4")]
+    links = {"n1": ("ici0",), "n2": ("ici0",),
+             "n3": ("ici1",), "n4": ("ici1",)}
+    return pools, links
+
+
+def test_spread_tagged_gangs_get_disjoint_links():
+    pools, links = _two_link_topology()
+    gang = [{"CPU": 1.0}, {"CPU": 1.0}]
+    first = plan_gang_placement(pools, gang, "SPREAD", links=links,
+                                link_load={}, bandwidth=10.0)
+    assert first == ["n1", "n2"]
+    # first gang now loads ici0; the second tagged gang must steer to
+    # the other link entirely
+    load = {"ici0": 1}
+    second = plan_gang_placement(pools, gang, "SPREAD", links=links,
+                                 link_load=load, bandwidth=10.0)
+    assert second == ["n3", "n4"]
+    first_links = {l for n in first for l in links[n]}
+    second_links = {l for n in second for l in links[n]}
+    assert first_links.isdisjoint(second_links)
+
+
+def test_untagged_gang_ignores_link_load():
+    pools, links = _two_link_topology()
+    gang = [{"CPU": 1.0}, {"CPU": 1.0}]
+    # heavy load on ici0 — an untagged gang must keep the legacy
+    # (bundle-count, arrival-order) placement regardless
+    got = plan_gang_placement(pools, gang, "SPREAD", links=links,
+                              link_load={"ici0": 7}, bandwidth=0.0)
+    assert got == ["n1", "n2"]
+
+
+def test_pack_tagged_gang_prefers_quiet_link():
+    pools, links = _two_link_topology()
+    gang = [{"CPU": 1.0}, {"CPU": 1.0}]
+    # PACK with no tag: first-fit in arrival order
+    assert plan_gang_placement(pools, gang, "PACK", links=links,
+                               link_load={"ici0": 1}) == ["n1", "n1"]
+    # tagged: the quiet link's first node wins, and PACK still packs
+    got = plan_gang_placement(pools, gang, "PACK", links=links,
+                              link_load={"ici0": 1}, bandwidth=2.0)
+    assert got == ["n3", "n3"]
+
+
+def test_strict_spread_tagged_ranks_by_contention():
+    pools, links = _two_link_topology()
+    gang = [{"CPU": 1.0}, {"CPU": 1.0}]
+    got = plan_gang_placement(pools, gang, "STRICT_SPREAD", links=links,
+                              link_load={"ici0": 3, "ici1": 1},
+                              bandwidth=1.0)
+    assert got == ["n3", "n4"]
+
+
+def test_contention_scoring_is_deterministic():
+    pools, links = _two_link_topology()
+    gang = [{"CPU": 1.0}] * 3
+    load = {"ici0": 2, "ici1": 1}
+    runs = [plan_gang_placement(pools, gang, strat, links=links,
+                                link_load=dict(load), bandwidth=4.0)
+            for strat in ("SPREAD", "PACK", "STRICT_SPREAD")
+            for _ in range(3)]
+    assert runs[0:3] == [runs[0]] * 3
+    assert runs[3:6] == [runs[3]] * 3
+    assert runs[6:9] == [runs[6]] * 3
+    # ties (equal contention) break on arrival order, never dict order
+    even = plan_gang_placement(pools, [{"CPU": 1.0}], "PACK", links=links,
+                               link_load={"ici0": 1, "ici1": 1},
+                               bandwidth=1.0)
+    assert even == ["n1"]
+
+
+def test_planner_infeasible_returns_none():
+    pools, links = _two_link_topology()
+    assert plan_gang_placement(pools, [{"CPU": 99.0}], "SPREAD",
+                               links=links, bandwidth=1.0) is None
+
+
+def test_bandwidth_tag_via_public_api(ray_session):
+    pg = placement_group([{"CPU": 1}], bandwidth=12.5)
+    assert pg.bandwidth == 12.5
+    from ray_tpu._private import worker as _worker
+    rows = _worker.get_client().control("list_placement_groups", {})
+    mine = [r for r in rows if r["placement_group_id"] == pg.id]
+    assert mine and mine[0]["bandwidth"] == 12.5
+    remove_placement_group(pg)
+
+
+def test_bandwidth_rejects_negative(ray_session):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], bandwidth=-1.0)

@@ -1,0 +1,45 @@
+"""Batching-on/off parity smoke (`make scale-smoke`, tier-1).
+
+Runs scale_bench's parity workload in two fresh sessions — coalescing
+frame layer + pipelined submission ON (the default) vs the legacy
+per-message, per-ack wire — and asserts the OUTPUTS are identical:
+every task result and the round-tripped object bytes. The batched
+control plane is allowed to change timing, never values."""
+
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir,
+                      "scale_bench.py")
+
+
+def _parity_run(batching: str, n_tasks: int = 600, n_puts: int = 60):
+    env = dict(os.environ,
+               RAY_TPU_CHANNEL_BATCHING=batching,
+               RAY_TPU_SUBMIT_PIPELINE=batching,
+               JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, _BENCH, "--parity-child",
+         str(n_tasks), str(n_puts)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_batching_on_off_output_parity():
+    on = _parity_run("1")
+    off = _parity_run("0")
+    # the flags really took in each child
+    assert on["channel_batching"] and on["submit_pipeline"]
+    assert not off["channel_batching"] and not off["submit_pipeline"]
+    # same task outputs, same object values
+    assert on["task_checksum"] == off["task_checksum"]
+    assert on["object_digest"] == off["object_digest"]
+    # both modes actually ran the full workload
+    assert on["tasks"] == off["tasks"] == 600
+    for doc in (on, off):
+        assert doc["end_to_end_per_s"] > 0
+        assert doc["put_get_per_s"] > 0
