@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint test test-fast trace-smoke scale-smoke
+.PHONY: lint test test-fast trace-smoke scale-smoke quant-smoke
 
 # Static invariant checks (R001-R005): exits non-zero on any
 # non-waived finding. tests/test_graftlint.py::test_repo_is_clean runs
@@ -20,6 +20,15 @@ test-fast:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tracing_distributed.py \
 		-q -k 'merged or proxy'
+
+# Quantization CPU parity + JSON-contract subset: int8 KV token
+# identity vs f32 (incl. COW / spec-decode), kernel dequant parity,
+# fused-prefill parity, the quantized fuzz tier, and the bench fields
+# (capacity_vs_f32, quality_logprob_delta) pinned end to end.
+quant-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
+		tests/test_spec_decode.py tests/test_bench_infer_smoke.py \
+		-q -m 'not slow' -k 'quant or Quant or FusedPrefill'
 
 # Trimmed scale_bench parity run: channel batching + pipelined
 # submission ON vs OFF must produce bit-identical task results and

@@ -37,6 +37,20 @@ extras ride alongside:
                            sampled at 1.0 vs 0.0. Only measured when
                            RAY_TPU_INFER_BENCH_TRACE_OVERHEAD=1 (it
                            doubles the run); 0.0 otherwise
+  kv_dtype / weight_dtype  the quantization knobs this run used
+  pool_bytes               device bytes of the preallocated KV block
+                           pool(s), scale arrays included
+  capacity_streams_per_gb  concurrent mean-context streams one GiB of
+                           pool budget holds (1 GiB / kv_bytes_per_token
+                           / mean context) — the capacity lever
+                           kv_dtype="int8" pulls
+  capacity_vs_f32          kv-bytes-per-token ratio vs a full-precision
+                           f32 pool of the same geometry (2.0 for the
+                           default bf16 pool, >3x for int8+scales)
+  quality_logprob_delta    quantization quality proxy: mean |per-token
+                           greedy logprob delta| vs an f32-pool f32-
+                           weight engine on the same prompts (0.0 when
+                           nothing is quantized — nothing to compare)
 
 Knobs (env vars, platform-tuned defaults in main()):
   RAY_TPU_INFER_BENCH_SLOTS          resident decode slots (cache batch)
@@ -63,6 +77,11 @@ Knobs (env vars, platform-tuned defaults in main()):
                                      unchanged baseline headline
   RAY_TPU_INFER_BENCH_SPEC_K         speculated tokens per step (k)
   RAY_TPU_INFER_BENCH_DRAFT_LAYERS   draft model depth for SPEC=draft
+  RAY_TPU_INFER_BENCH_KV_DTYPE       "f32" | "int8": paged KV pool
+                                     element type (int8 = per-row-scale
+                                     quantized pool, models/gpt.py)
+  RAY_TPU_INFER_BENCH_WEIGHT_DTYPE   "f32" | "int8": weight-only decode
+                                     matmul precision
 
 Baseline: single-token decode is HBM-bandwidth-bound — every step
 streams the full parameter set plus the live KV prefix through the chip
@@ -110,14 +129,27 @@ def _env_int(name: str, default: int) -> int:
 def decode_roofline_tokens_per_sec(cfg, slots: int, mean_ctx: float,
                                    device) -> float:
     """Bandwidth-bound decode ceiling: one step reads all params once
-    plus each slot's live K/V prefix, and emits `slots` tokens."""
+    plus each slot's live K/V prefix, and emits `slots` tokens.
+
+    Quantization rescales the denominator — that is the whole point of
+    the int8 paths: `weight_dtype="int8"` reads the layer matmuls at 1
+    byte/param (embed/norms stay full precision), and `kv_dtype="int8"`
+    reads each cached position at H*(Dh + 4) bytes per K or V row (int8
+    payload + one f32 scale per (position, head)) instead of
+    H*Dh*bpe."""
     # param count straight from config (no tracing needed):
     d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
-    n_params = v * d + cfg.max_seq_len * d + d + L * (
-        2 * d + 4 * d * d + 3 * d * f)
+    matmul_params = L * (4 * d * d + 3 * d * f)
+    full_params = v * d + cfg.max_seq_len * d + d + L * 2 * d
     bpe = 2 if "bfloat16" in cfg.dtype else 4
-    kv_bytes = slots * mean_ctx * 2 * cfg.n_heads * cfg.head_dim * bpe
-    bytes_per_step = n_params * bpe + kv_bytes
+    w_bpe = 1 if cfg.weight_dtype == "int8" else bpe
+    if cfg.kv_dtype == "int8":
+        kv_row = cfg.n_heads * (cfg.head_dim + 4)
+    else:
+        kv_row = cfg.n_heads * cfg.head_dim * bpe
+    kv_bytes = slots * mean_ctx * 2 * kv_row
+    bytes_per_step = (full_params * bpe + matmul_params * w_bpe
+                      + kv_bytes)
     return hbm_bandwidth(device) * slots / bytes_per_step
 
 
@@ -149,6 +181,13 @@ def main():
     spec = os.environ.get("RAY_TPU_INFER_BENCH_SPEC", "")
     spec_k = _env_int("RAY_TPU_INFER_BENCH_SPEC_K", 4)
     draft_layers = _env_int("RAY_TPU_INFER_BENCH_DRAFT_LAYERS", 1)
+    kv_dtype = os.environ.get("RAY_TPU_INFER_BENCH_KV_DTYPE", "f32")
+    weight_dtype = os.environ.get(
+        "RAY_TPU_INFER_BENCH_WEIGHT_DTYPE", "f32")
+    if kv_dtype != "f32" or weight_dtype != "f32":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                  weight_dtype=weight_dtype)
     if spec not in ("", "ngram", "draft"):
         raise SystemExit("SPEC must be '', 'ngram' or 'draft'")
     if prompt_len + new_tokens > max_len:
@@ -212,6 +251,28 @@ def main():
         _, _, wall_off = run_engine({"telemetry_sample": 0.0})
         trace_overhead_pct = ((wall_on - wall_off)
                               / max(wall_off, 1e-9) * 100.0)
+
+    # --- quantization quality proxy ------------------------------------
+    # Greedy-decode the same prompts through the (warm, pre-swap)
+    # quantized engine and a fresh full-precision one, and report the
+    # mean absolute per-token logprob drift — the pinned bound for
+    # "int8 is tight-allclose to f32". 0.0 when nothing is quantized.
+    quality_logprob_delta = 0.0
+    if cfg.kv_dtype != "f32" or cfg.weight_dtype != "f32":
+        import dataclasses
+        fcfg = dataclasses.replace(cfg, kv_dtype="f32",
+                                   weight_dtype="f32")
+        feng = InferenceEngine(params, fcfg, slots=slots,
+                               max_len=max_len, block_size=block_size,
+                               prefill_chunk=chunk or None)
+        deltas = []
+        for p in [make_prompt() for _ in range(min(requests, slots))]:
+            a = [t.logprob for t in
+                 eng.generate(p, max_new_tokens=new_tokens)]
+            b = [t.logprob for t in
+                 feng.generate(p, max_new_tokens=new_tokens)]
+            deltas.extend(abs(x - y) for x, y in zip(a, b))
+        quality_logprob_delta = float(np.mean(deltas))
 
     # --- RL flywheel probe: in-place weight hot-swap + engine rollout --
     # Reuses the warm baseline engine: update_params must not retrigger
@@ -281,6 +342,16 @@ def main():
         "block_size": s["block_size"],
         "cache_blocks": s["cache_blocks"],
         "shared_prefix": shared_prefix,
+        # quantization / capacity
+        "kv_dtype": cfg.kv_dtype,
+        "weight_dtype": cfg.weight_dtype,
+        "pool_bytes": s["pool_bytes"],
+        "capacity_streams_per_gb": round(
+            (1 << 30) / (s["kv_bytes_per_token"] * mean_ctx), 1),
+        "capacity_vs_f32": round(
+            (cfg.n_layers * 2 * cfg.n_heads * cfg.head_dim * 4)
+            / s["kv_bytes_per_token"], 3),
+        "quality_logprob_delta": round(quality_logprob_delta, 5),
         # speculative decoding (zeros / 1.0-neutral when SPEC is off)
         "spec": spec,
         "spec_k": spec_k if spec else 0,

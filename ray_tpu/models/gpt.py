@@ -83,6 +83,25 @@ class GPTConfig:
     # pure-JAX fallback elsewhere; both share the same math
     # (ops/decode_attention.py).
     decode_attn_impl: str = "auto"   # auto | pallas | jax
+    # Paged KV pool element type. "f32" keeps the pool in the activation
+    # dtype (full precision — the bitwise-default path); "int8" stores
+    # symmetric absmax int8 payloads with one f32 scale per
+    # (position, head) row (ops/quant.py), quantized at write inside
+    # prefill/decode/verify and dequantized inside the paged attention
+    # kernels — the block table / COW / radix machinery never sees the
+    # dtype. ~3-4x KV bytes/token vs an f32 pool (2x vs bf16).
+    kv_dtype: str = "f32"            # f32 | int8
+    # Weight precision for the paged inference forwards (prefill/decode/
+    # verify — training and the unpaged path always run full precision).
+    # "int8" expects params through `quantize_params` (per-output-channel
+    # scales; dequant folds into each matmul's rhs read, accumulation
+    # stays f32 via preferred_element_type).
+    weight_dtype: str = "f32"        # f32 | int8
+    # Attention implementation for chunked paged prefill. "auto" picks
+    # the fused Pallas multi-query kernel on TPU (chunk scores stay
+    # blockwise in VMEM) and the dense gather+einsum elsewhere; "jax" is
+    # the legacy dense math, bit-identical to the pre-fused inline path.
+    prefill_attn_impl: str = "auto"  # auto | pallas | jax
 
     @property
     def head_dim(self) -> int:
@@ -499,48 +518,156 @@ def decode_step(params, tokens, cache, pos, cfg: GPTConfig,
 # exactly once, while the host (serve/engine.py) is free to share,
 # copy-on-write, and recycle blocks between requests.
 
-def kv_pool_logical_axes():
+def check_quant_cfg(cfg: GPTConfig) -> bool:
+    """Trace-time validation of the quantization knobs (the
+    check_loss_impl idiom: a typo'd config fails the first trace, not
+    some later step). Returns True when the KV pool is int8."""
+    if cfg.kv_dtype not in ("f32", "int8"):
+        raise ValueError(
+            f"unknown kv_dtype {cfg.kv_dtype!r} (expected 'f32' | "
+            "'int8')")
+    if cfg.weight_dtype not in ("f32", "int8"):
+        raise ValueError(
+            f"unknown weight_dtype {cfg.weight_dtype!r} (expected "
+            "'f32' | 'int8')")
+    if cfg.prefill_attn_impl not in ("auto", "pallas", "jax"):
+        raise ValueError(
+            f"unknown prefill_attn_impl {cfg.prefill_attn_impl!r} "
+            "(expected 'auto' | 'pallas' | 'jax')")
+    return cfg.kv_dtype == "int8"
+
+
+# The per-layer matmul weights the int8 weight-only path quantizes.
+# Norm scales, embed and pos_embed stay f32 — they are O(d) reads, not
+# the bandwidth, and the unembed shares `embed`.
+QUANTIZED_WEIGHTS = ("wq", "wk", "wv", "wo", "w_up", "w_gate", "w_down")
+
+
+def quantize_params(params):
+    """Per-output-channel int8 copy of a GPT param tree for the
+    `weight_dtype="int8"` inference path: every `QUANTIZED_WEIGHTS`
+    leaf ``[L, In, Out]`` becomes an int8 leaf plus an
+    ``"<name>_scale"`` f32 ``[L, Out]`` sibling
+    (`ops.quant.quantize_channels`). Embed/pos_embed/norm scales pass
+    through untouched. Pure and jittable — the engine wraps it in a
+    donating jit so the RL flywheel's swap path republishes f32 masters
+    and quantization rides the swap."""
+    from ray_tpu.ops import quant
+    layers = dict(params["layers"])
+    for name in QUANTIZED_WEIGHTS:
+        q, s = quant.quantize_channels(layers[name])
+        layers[name] = q
+        layers[name + "_scale"] = s
+    return {**params, "layers": layers}
+
+
+def _w(lp, name, adt):
+    """Resolve one per-layer matmul weight: dequantize (f32 scale per
+    output channel, then cast to the activation dtype) when the layer
+    dict carries a ``"<name>_scale"`` sibling, plain cast otherwise —
+    a static dict-key check, so f32 configs trace byte-identical code."""
+    w = lp[name]
+    s = lp.get(name + "_scale")
+    if s is None:
+        return w.astype(adt)
+    return (w.astype(jnp.float32) * s[..., None, :]).astype(adt)
+
+
+def kv_pool_logical_axes(quantized: bool = False):
     """Logical-axis tuples for the paged block pool {"k", "v"} of
     [L, n_blocks, block_size, H, Dh]. Heads stay tensor-parallel
     (matching the wq/wk/wv column split, exactly like the unpaged
     cache); the block axis is replicated — any block must be assignable
     to any sequence, so it cannot ride the data axes the way dedicated
-    slot rows could."""
+    slot rows could. With ``quantized`` the dict grows
+    {"k_scale", "v_scale"} of [L, n_blocks, block_size, H] — heads
+    sharded with their payload rows, blocks replicated the same way."""
     axes = (None, None, None, "heads", None)
-    return {"k": axes, "v": axes}
+    pool = {"k": axes, "v": axes}
+    if quantized:
+        scale_axes = (None, None, None, "heads")
+        pool["k_scale"] = scale_axes
+        pool["v_scale"] = scale_axes
+    return pool
 
 
 def init_kv_pool(cfg: GPTConfig, n_blocks: int, block_size: int,
                  mesh: Mesh | None = None):
     """Preallocated paged cache {"k", "v"} of
-    [L, n_blocks, block_size, H, Dh] in cfg.dtype, zero-filled, placed
-    with its sharding annotation when a mesh is given. Block 0 is
-    conventionally the engine's trash block (idle decode rows scatter
-    there), but nothing here enforces that — allocation policy is the
-    host's job."""
+    [L, n_blocks, block_size, H, Dh], zero-filled, placed with its
+    sharding annotation when a mesh is given. `cfg.kv_dtype="f32"`
+    stores cfg.dtype payloads; "int8" stores int8 payloads plus
+    {"k_scale", "v_scale"} f32 [L, n_blocks, block_size, H] per-row
+    scales (zero rows dequantize to exact zeros, so the zero-init is
+    inert either way). Block 0 is conventionally the engine's trash
+    block (idle decode rows scatter there), but nothing here enforces
+    that — allocation policy is the host's job."""
+    quantized = check_quant_cfg(cfg)
     shape = (cfg.n_layers, n_blocks, block_size, cfg.n_heads,
              cfg.head_dim)
-    pool = {"k": jnp.zeros(shape, cfg.activation_dtype()),
-            "v": jnp.zeros(shape, cfg.activation_dtype())}
+    payload_dt = jnp.int8 if quantized else cfg.activation_dtype()
+    pool = {"k": jnp.zeros(shape, payload_dt),
+            "v": jnp.zeros(shape, payload_dt)}
+    if quantized:
+        pool["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        pool["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
     if mesh is not None:
         from ray_tpu.parallel.sharding import kv_pool_shardings
-        sh = kv_pool_shardings(mesh)
+        sh = kv_pool_shardings(mesh, quantized=quantized)
         pool = {name: jax.device_put(arr, sh[name])
                 for name, arr in pool.items()}
     return pool
 
 
 def copy_block(cache, src, dst):
-    """Copy physical block `src` onto `dst` in every layer of the pool —
-    the device half of copy-on-write prefix sharing. src/dst may be
-    traced scalars, so one jit (with the cache donated) serves every
-    copy the engine ever issues."""
+    """Copy physical block `src` onto `dst` in every entry of the pool —
+    the device half of copy-on-write prefix sharing. Iterates the cache
+    dict, so an int8 pool's scale rows travel with their payload and COW
+    semantics never depend on the dtype (the block axis is axis 1 for
+    payloads and scales alike). src/dst may be traced scalars, so one
+    jit (with the cache donated) serves every copy the engine ever
+    issues."""
     out = {}
-    for name in ("k", "v"):
+    for name in cache:
         blk = jax.lax.dynamic_slice_in_dim(cache[name], src, 1, axis=1)
         out[name] = jax.lax.dynamic_update_slice_in_dim(
             cache[name], blk, dst, axis=1)
     return out
+
+
+def _scatter_kv(lc, k, v, widx):
+    """Write `k`/`v` [N, H, Dh] (activation dtype) into one layer's pool
+    slice `lc` at flat indices ``widx [N]`` (out-of-bounds rows drop —
+    the padded-tail / past-table convention every paged writer shares).
+    An int8 pool (``"k_scale" in lc`` — a static check) quantizes at the
+    write: payload rows and their (position, head) scale cells scatter
+    through the SAME indices, so single-token appends, chunked prefill
+    and W-token verify all land byte-identical int8 for identical f32
+    inputs (`ops.quant`'s determinism contract). Returns the layer's new
+    cache dict."""
+    nb, bs, nh, hd = lc["k"].shape
+    kf = lc["k"].reshape(nb * bs, nh, hd)
+    vf = lc["v"].reshape(nb * bs, nh, hd)
+    if "k_scale" in lc:
+        from ray_tpu.ops import quant
+        qk, ks = quant.quantize_rows(k)
+        qv, vs = quant.quantize_rows(v)
+        return {
+            "k": kf.at[widx].set(qk, mode="drop").reshape(
+                nb, bs, nh, hd),
+            "v": vf.at[widx].set(qv, mode="drop").reshape(
+                nb, bs, nh, hd),
+            "k_scale": lc["k_scale"].reshape(nb * bs, nh)
+                .at[widx].set(ks, mode="drop").reshape(nb, bs, nh),
+            "v_scale": lc["v_scale"].reshape(nb * bs, nh)
+                .at[widx].set(vs, mode="drop").reshape(nb, bs, nh),
+        }
+    return {
+        "k": kf.at[widx].set(k.astype(kf.dtype), mode="drop").reshape(
+            nb, bs, nh, hd),
+        "v": vf.at[widx].set(v.astype(vf.dtype), mode="drop").reshape(
+            nb, bs, nh, hd),
+    }
 
 
 def prefill_paged(params, tokens, cache, cfg: GPTConfig,
@@ -560,13 +687,22 @@ def prefill_paged(params, tokens, cache, cfg: GPTConfig,
     the radix tree) plus the causal part of its own chunk — gathered
     from the pool through the same block table it writes. `start`,
     `length` and the table are traced, so prefill compiles once per
-    chunk bucket, ever."""
+    chunk bucket, ever.
+
+    Attention routes through
+    `ops.decode_attention.paged_prefill_attention`
+    (`cfg.prefill_attn_impl`): the "jax" path is the dense gather+einsum
+    this function used to inline, bit-identical; "pallas" (or "auto" on
+    TPU) runs the fused kernel whose chunk scores never round-trip HBM.
+    An int8 pool (`cfg.kv_dtype="int8"`) quantizes K/V inside the
+    scatter and the attention op dequantizes blockwise inside."""
+    check_quant_cfg(cfg)
+    from ray_tpu.ops.decode_attention import paged_prefill_attention
     b, c = tokens.shape
     if b != 1:
         raise ValueError(f"paged prefill wants tokens [1, C], got "
                          f"batch {b}")
     nb, bs = cache["k"].shape[1], cache["k"].shape[2]
-    max_ctx = block_table.shape[0] * bs
     if start is None:
         raise ValueError("prefill_paged needs start=")
     adt = cfg.activation_dtype()
@@ -583,61 +719,45 @@ def prefill_paged(params, tokens, cache, cfg: GPTConfig,
     # bounds and are dropped, so chunk garbage never lands in a block.
     widx = jnp.where(valid, table[positions // bs] * bs + positions % bs,
                      nb * bs)
-    # Flat gather indices for the sequence's whole logical context.
-    gidx = (table[:, None] * bs
-            + jnp.arange(bs, dtype=jnp.int32)[None, :]).reshape(-1)
 
     x = params["embed"].astype(adt)[tokens[0]]
     x = x + params["pos_embed"].astype(adt)[positions]      # [C, D]
 
     def body(x, layer):
-        lp, kc, vc = layer                    # kc/vc [nb, bs, H, Dh]
+        lp, lc = layer                  # lc["k"/"v"]: [nb, bs, H, Dh]
         h = _rms_norm(x, lp["ln1_scale"].astype(adt))
-        q = jnp.einsum("td,dh->th", h, lp["wq"].astype(adt),
+        q = jnp.einsum("td,dh->th", h, _w(lp, "wq", adt),
                        preferred_element_type=pet).astype(adt)
-        k = jnp.einsum("td,dh->th", h, lp["wk"].astype(adt),
+        k = jnp.einsum("td,dh->th", h, _w(lp, "wk", adt),
                        preferred_element_type=pet).astype(adt)
-        v = jnp.einsum("td,dh->th", h, lp["wv"].astype(adt),
+        v = jnp.einsum("td,dh->th", h, _w(lp, "wv", adt),
                        preferred_element_type=pet).astype(adt)
         q = q.reshape(c, nh, hd)
-        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
-            k.reshape(c, nh, hd).astype(kc.dtype), mode="drop")
-        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
-            v.reshape(c, nh, hd).astype(vc.dtype), mode="drop")
-        kctx = kf[gidx]                       # [max_ctx, H, Dh]
-        vctx = vf[gidx]
-        scores = jnp.einsum(
-            "thd,shd->hts", q.astype(jnp.float32),
-            kctx.astype(jnp.float32),
-            preferred_element_type=jnp.float32) * (hd ** -0.5)
-        cols = jnp.arange(max_ctx, dtype=jnp.int32)
-        live = cols[None, None, :] <= positions[None, :, None]
-        scores = jnp.where(live, scores, -1e30)
-        p = jax.nn.softmax(scores, axis=-1)
-        att = jnp.einsum("hts,shd->thd", p, vctx.astype(jnp.float32),
-                         preferred_element_type=jnp.float32
-                         ).astype(adt).reshape(c, nh * hd)
-        att = jnp.einsum("th,hd->td", att, lp["wo"].astype(adt),
+        lc = _scatter_kv(lc, k.reshape(c, nh, hd),
+                         v.reshape(c, nh, hd), widx)
+        att = paged_prefill_attention(
+            q, lc["k"], lc["v"], table, start,
+            k_scale=lc.get("k_scale"), v_scale=lc.get("v_scale"),
+            impl=cfg.prefill_attn_impl).reshape(c, nh * hd)
+        att = jnp.einsum("th,hd->td", att, _w(lp, "wo", adt),
                          preferred_element_type=pet).astype(adt)
         x = x + att
         h = _rms_norm(x, lp["ln2_scale"].astype(adt))
-        up = jnp.einsum("td,df->tf", h, lp["w_up"].astype(adt),
+        up = jnp.einsum("td,df->tf", h, _w(lp, "w_up", adt),
                         preferred_element_type=pet).astype(adt)
-        gate = jnp.einsum("td,df->tf", h, lp["w_gate"].astype(adt),
+        gate = jnp.einsum("td,df->tf", h, _w(lp, "w_gate", adt),
                           preferred_element_type=pet).astype(adt)
         ff = jax.nn.silu(gate) * up
-        down = jnp.einsum("tf,fd->td", ff, lp["w_down"].astype(adt),
+        down = jnp.einsum("tf,fd->td", ff, _w(lp, "w_down", adt),
                           preferred_element_type=pet).astype(adt)
-        return x + down, (kf.reshape(nb, bs, nh, hd),
-                          vf.reshape(nb, bs, nh, hd))
+        return x + down, lc
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    x, cache = jax.lax.scan(body, x, (params["layers"], cache))
     x = _rms_norm(x, params["final_ln_scale"].astype(adt))
     last = jnp.take_along_axis(x, (length - 1)[None, None], axis=0)
     logits = jnp.einsum("td,vd->tv", last, params["embed"].astype(adt),
                         preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def decode_step_paged(params, tokens, cache, pos, tables,
@@ -652,7 +772,12 @@ def decode_step_paged(params, tokens, cache, pos, tables,
     Shapes are static (B slots, fixed pool, fixed table width), so the
     engine's jitted wrapper still compiles exactly once; idle rows
     should point their table at the trash block (0) and any position —
-    their writes collide harmlessly there and nobody reads the output."""
+    their writes collide harmlessly there and nobody reads the output.
+
+    An int8 pool (`cfg.kv_dtype="int8"`) quantizes the appended K/V row
+    (payload + per-head scale cell through the same drop-mode scatter)
+    and the attention kernel dequantizes per block in VMEM."""
+    check_quant_cfg(cfg)
     from ray_tpu.ops.decode_attention import paged_decode_attention
     adt = cfg.activation_dtype()
     pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
@@ -674,43 +799,40 @@ def decode_step_paged(params, tokens, cache, pos, tables,
         jnp.minimum(pos, cfg.max_seq_len - 1)]
 
     def body(x, layer):
-        lp, kc, vc = layer                       # kc/vc [nb, bs, H, Dh]
+        lp, lc = layer                  # lc["k"/"v"]: [nb, bs, H, Dh]
         h = _rms_norm(x, lp["ln1_scale"].astype(adt))
-        q = jnp.einsum("bd,dh->bh", h, lp["wq"].astype(adt),
+        q = jnp.einsum("bd,dh->bh", h, _w(lp, "wq", adt),
                        preferred_element_type=pet).astype(adt)
-        k = jnp.einsum("bd,dh->bh", h, lp["wk"].astype(adt),
+        k = jnp.einsum("bd,dh->bh", h, _w(lp, "wk", adt),
                        preferred_element_type=pet).astype(adt)
-        v = jnp.einsum("bd,dh->bh", h, lp["wv"].astype(adt),
+        v = jnp.einsum("bd,dh->bh", h, _w(lp, "wv", adt),
                        preferred_element_type=pet).astype(adt)
         q = q.reshape(b, nh, hd)
-        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
-            k.reshape(b, nh, hd).astype(kc.dtype), mode="drop")
-        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
-            v.reshape(b, nh, hd).astype(vc.dtype), mode="drop")
-        kc = kf.reshape(nb, bs, nh, hd)
-        vc = vf.reshape(nb, bs, nh, hd)
-        att = paged_decode_attention(q, kc, vc, tables, pos,
+        lc = _scatter_kv(lc, k.reshape(b, nh, hd),
+                         v.reshape(b, nh, hd), widx)
+        att = paged_decode_attention(q, lc["k"], lc["v"], tables, pos,
+                                     k_scale=lc.get("k_scale"),
+                                     v_scale=lc.get("v_scale"),
                                      impl=cfg.decode_attn_impl)
         att = jnp.einsum("bh,hd->bd", att.reshape(b, nh * hd),
-                         lp["wo"].astype(adt),
+                         _w(lp, "wo", adt),
                          preferred_element_type=pet).astype(adt)
         x = x + att
         h = _rms_norm(x, lp["ln2_scale"].astype(adt))
-        up = jnp.einsum("bd,df->bf", h, lp["w_up"].astype(adt),
+        up = jnp.einsum("bd,df->bf", h, _w(lp, "w_up", adt),
                         preferred_element_type=pet).astype(adt)
-        gate = jnp.einsum("bd,df->bf", h, lp["w_gate"].astype(adt),
+        gate = jnp.einsum("bd,df->bf", h, _w(lp, "w_gate", adt),
                           preferred_element_type=pet).astype(adt)
         ff = jax.nn.silu(gate) * up
-        down = jnp.einsum("bf,fd->bd", ff, lp["w_down"].astype(adt),
+        down = jnp.einsum("bf,fd->bd", ff, _w(lp, "w_down", adt),
                           preferred_element_type=pet).astype(adt)
-        return x + down, (kc, vc)
+        return x + down, lc
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    x, cache = jax.lax.scan(body, x, (params["layers"], cache))
     x = _rms_norm(x, params["final_ln_scale"].astype(adt))
     logits = jnp.einsum("bd,vd->bv", x, params["embed"].astype(adt),
                         preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def verify_step_paged(params, tokens, cache, pos, tables,
@@ -734,7 +856,14 @@ def verify_step_paged(params, tokens, cache, pos, tables,
     (tail of a near-max_len slot) drop their writes instead of clamping,
     so a slot can never corrupt its own last block. Shapes are static
     (B slots, fixed W), so the engine's verify jit compiles exactly
-    once."""
+    once.
+
+    An int8 pool (`cfg.kv_dtype="int8"`) runs verify quantized:
+    quantize-then-dequantize is a pure function of the written values
+    (`ops.quant`), so a draft row's dequantized K/V is byte-identical
+    to what the sequential decode append would have produced — verify
+    stays bit-identical to W sequential steps, quantized or not."""
+    check_quant_cfg(cfg)
     from ray_tpu.ops.decode_attention import paged_verify_attention
     adt = cfg.activation_dtype()
     pet = (jnp.float32 if cfg.matmul_out == "float32" else adt)
@@ -755,43 +884,40 @@ def verify_step_paged(params, tokens, cache, pos, tables,
         jnp.minimum(positions, cfg.max_seq_len - 1)]
 
     def body(x, layer):
-        lp, kc, vc = layer                       # kc/vc [nb, bs, H, Dh]
+        lp, lc = layer                  # lc["k"/"v"]: [nb, bs, H, Dh]
         h = _rms_norm(x, lp["ln1_scale"].astype(adt))
-        q = jnp.einsum("bwd,dh->bwh", h, lp["wq"].astype(adt),
+        q = jnp.einsum("bwd,dh->bwh", h, _w(lp, "wq", adt),
                        preferred_element_type=pet).astype(adt)
-        k = jnp.einsum("bwd,dh->bwh", h, lp["wk"].astype(adt),
+        k = jnp.einsum("bwd,dh->bwh", h, _w(lp, "wk", adt),
                        preferred_element_type=pet).astype(adt)
-        v = jnp.einsum("bwd,dh->bwh", h, lp["wv"].astype(adt),
+        v = jnp.einsum("bwd,dh->bwh", h, _w(lp, "wv", adt),
                        preferred_element_type=pet).astype(adt)
         q = q.reshape(b, w, nh, hd)
-        kf = kc.reshape(nb * bs, nh, hd).at[widx].set(
-            k.reshape(b * w, nh, hd).astype(kc.dtype), mode="drop")
-        vf = vc.reshape(nb * bs, nh, hd).at[widx].set(
-            v.reshape(b * w, nh, hd).astype(vc.dtype), mode="drop")
-        kc = kf.reshape(nb, bs, nh, hd)
-        vc = vf.reshape(nb, bs, nh, hd)
-        att = paged_verify_attention(q, kc, vc, tables, pos,
+        lc = _scatter_kv(lc, k.reshape(b * w, nh, hd),
+                         v.reshape(b * w, nh, hd), widx)
+        att = paged_verify_attention(q, lc["k"], lc["v"], tables, pos,
+                                     k_scale=lc.get("k_scale"),
+                                     v_scale=lc.get("v_scale"),
                                      impl=cfg.decode_attn_impl)
         att = jnp.einsum("bwh,hd->bwd", att.reshape(b, w, nh * hd),
-                         lp["wo"].astype(adt),
+                         _w(lp, "wo", adt),
                          preferred_element_type=pet).astype(adt)
         x = x + att
         h = _rms_norm(x, lp["ln2_scale"].astype(adt))
-        up = jnp.einsum("bwd,df->bwf", h, lp["w_up"].astype(adt),
+        up = jnp.einsum("bwd,df->bwf", h, _w(lp, "w_up", adt),
                         preferred_element_type=pet).astype(adt)
-        gate = jnp.einsum("bwd,df->bwf", h, lp["w_gate"].astype(adt),
+        gate = jnp.einsum("bwd,df->bwf", h, _w(lp, "w_gate", adt),
                           preferred_element_type=pet).astype(adt)
         ff = jax.nn.silu(gate) * up
-        down = jnp.einsum("bwf,fd->bwd", ff, lp["w_down"].astype(adt),
+        down = jnp.einsum("bwf,fd->bwd", ff, _w(lp, "w_down", adt),
                           preferred_element_type=pet).astype(adt)
-        return x + down, (kc, vc)
+        return x + down, lc
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    x, cache = jax.lax.scan(body, x, (params["layers"], cache))
     x = _rms_norm(x, params["final_ln_scale"].astype(adt))
     logits = jnp.einsum("bwd,vd->bwv", x, params["embed"].astype(adt),
                         preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache
 
 
 def num_params(params) -> int:

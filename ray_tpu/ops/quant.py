@@ -1,0 +1,81 @@
+"""Int8 quantization primitives shared by the paged KV cache and the
+weight-only decode matmuls.
+
+One math, everywhere: the decode path is HBM-bandwidth-bound, so every
+byte a K/V block or a weight read sheds converts directly into capacity
+(more concurrent streams per HBM byte) and throughput. Both consumers
+use symmetric absmax int8 with f32 scales and f32 accumulation:
+
+- **KV rows** (`quantize_rows`): one scale per (position, head) row of
+  the last axis — ``x [..., D] -> (q int8 [..., D], scale f32 [...])``.
+  Per-row scales mean a single-token decode append writes its own scale
+  cell with the same scatter index as its payload: no read-modify-write,
+  no cross-token coupling, so COW block copies and speculative rollback
+  need no special handling.
+- **Weights** (`quantize_channels`): one scale per output channel —
+  ``w [..., In, Out] -> (q int8 [..., In, Out], scale f32 [..., Out])``
+  over the contraction axis, the standard weight-only recipe: the
+  dequantized operand folds into the matmul's rhs read and accumulation
+  stays f32.
+
+Determinism contract: quantize-then-dequantize is a pure function of the
+f32 input, so any path that writes the same K/V values (prefill scatter,
+decode append, verify append) lands byte-identical int8 payloads and
+scales — which is what keeps spec-decode verify bit-identical to
+sequential decode, and shared-prefix/COW reads identical regardless of
+which request populated the block.
+
+Zero rows quantize to zero with scale 0 (the ``safe`` guard divides by 1
+instead): dequantization maps them back to exact zeros, so the pool's
+zero-init and the trash block stay inert.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+
+
+def quantize_rows(x):
+    """Symmetric per-row int8 over the LAST axis.
+
+    ``x [..., D]`` (any float dtype) -> ``(q int8 [..., D],
+    scale f32 [...])`` with ``scale = max(|x|, axis=-1) / 127`` and
+    ``q = round(x / scale)`` clipped to [-127, 127]. All-zero rows get
+    scale 0 and quantize to zeros (dequantizes to exact zeros)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = (amax / INT8_MAX).astype(jnp.float32)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale):
+    """Inverse of `quantize_rows`: ``(q int8 [..., D], scale f32 [...])``
+    -> f32 ``[..., D]``."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+
+
+def quantize_channels(w):
+    """Symmetric per-output-channel int8 over axis -2 (the contraction
+    axis of a ``[..., In, Out]`` weight).
+
+    -> ``(q int8 [..., In, Out], scale f32 [..., Out])`` with
+    ``scale = max(|w|, axis=-2) / 127``. All-zero channels get scale 0
+    and dequantize to exact zeros."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)
+    scale = (amax / INT8_MAX).astype(jnp.float32)
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(wf / safe[..., None, :]),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_channels(q, scale):
+    """Inverse of `quantize_channels`: ``(q int8 [..., In, Out],
+    scale f32 [..., Out])`` -> f32 ``[..., In, Out]``."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None, :]

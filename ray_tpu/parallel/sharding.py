@@ -152,25 +152,31 @@ def kv_cache_shardings(mesh: Mesh, rules: dict | None = None
             for name, spec in kv_cache_specs(mesh, rules).items()}
 
 
-def kv_pool_specs(mesh: Mesh, rules: dict | None = None):
+def kv_pool_specs(mesh: Mesh, rules: dict | None = None, *,
+                  quantized: bool = False):
     """PartitionSpec pytree for a paged KV block pool {"k", "v"} of
     [L, n_blocks, block_size, H, D]: heads ride the tensor axis (same
     wq/wk/wv column-split alignment as the unpaged cache — the blocks a
     tensor shard writes hold the heads it attends over). The block axis
     is replicated: the allocator hands any physical block to any
     sequence, so blocks cannot be pinned to data shards the way whole
-    slot rows were."""
+    slot rows were. With ``quantized`` (an int8 pool) the pytree grows
+    {"k_scale", "v_scale"} of [L, n_blocks, block_size, H]: the head
+    axis shards with its payload rows — each tensor shard dequantizes
+    from scales it already owns — and blocks stay replicated."""
     from ray_tpu.models.gpt import kv_pool_logical_axes
     return {name: logical_to_spec(axes, rules, mesh)
-            for name, axes in kv_pool_logical_axes().items()}
+            for name, axes in kv_pool_logical_axes(quantized).items()}
 
 
-def kv_pool_shardings(mesh: Mesh, rules: dict | None = None
+def kv_pool_shardings(mesh: Mesh, rules: dict | None = None, *,
+                      quantized: bool = False
                       ) -> dict[str, NamedSharding]:
     """NamedShardings for `kv_pool_specs` — what
     `models.gpt.init_kv_pool(mesh=...)` places the pool with."""
     return {name: NamedSharding(mesh, spec)
-            for name, spec in kv_pool_specs(mesh, rules).items()}
+            for name, spec in kv_pool_specs(
+                mesh, rules, quantized=quantized).items()}
 
 
 def replicated(mesh: Mesh):

@@ -530,6 +530,41 @@ class InferenceEngine:
         self.verify_traces = 0
         self.draft_traces = 0
         self.draft_prefill_traces = 0
+        self.quantize_traces = 0
+
+        # --- int8 weight-only path (cfg.weight_dtype="int8") ----------
+        # Quantize once at construction; update_params re-runs the same
+        # jitted fn on every swap, so trainers keep publishing f32
+        # masters and quantization rides the swap (zero decode/verify
+        # retraces — the tree the compiled paths close over keeps its
+        # shapes and dtypes). One trace per distinct tree shape: target
+        # and draft each at most once, ever.
+        def _quantize(p):
+            self.quantize_traces += 1
+            return gpt.quantize_params(p)
+
+        self._quant_target = cfg.weight_dtype == "int8"
+        self._quant_draft = (spec == "draft"
+                             and draft_cfg.weight_dtype == "int8")
+        self._quantize_fn = (jax.jit(_quantize)
+                             if self._quant_target or self._quant_draft
+                             else None)
+        if self._quant_target:
+            self.params = self._quantize_fn(self.params)
+        if self._quant_draft:
+            self.draft_params = self._quantize_fn(self.draft_params)
+
+        # Capacity gauges: total device bytes of the block pool(s) and
+        # the bytes one cached position costs — the lever kv_dtype
+        # pulls (stats()/bench_infer surface both).
+        self._pool_bytes = sum(
+            int(arr.nbytes) for arr in self.cache.values())
+        if self.draft_cache is not None:
+            self._pool_bytes += sum(
+                int(arr.nbytes) for arr in self.draft_cache.values())
+        self._kv_bytes_per_token = (
+            sum(int(arr.nbytes) for arr in self.cache.values())
+            / ((self.cache_blocks + 1) * block_size))
 
         def _sample(logits, temps, key, step):
             """Sample one token per row; also return the model's NATURAL
@@ -766,6 +801,11 @@ class InferenceEngine:
         self._sentinel.watch("swap", lambda: self.swap_traces,
                              cap=2 if spec == "draft" else 1,
                              registered=True)
+        if self._quantize_fn is not None:
+            self._sentinel.watch(
+                "quantize", lambda: self.quantize_traces,
+                cap=int(self._quant_target) + int(self._quant_draft),
+                registered=True)
         if spec is not None:
             self._sentinel.watch("verify", lambda: self.verify_traces,
                                  cap=1, registered=True)
@@ -989,6 +1029,10 @@ class InferenceEngine:
           construction (or returned by a previous swap) is invalidated
           by donation. `new_params` itself is NOT donated — a trainer
           can keep training on the same state it published.
+        - `weight_dtype="int8"` engines still take f32 masters here:
+          the same jitted quantization that ran at construction re-runs
+          on the published tree before validation/placement, so the RL
+          flywheel never handles int8 and the swap stays retrace-free.
         - The radix prefix cache is flushed: cached K/V was computed
           under the old weights and must not be shared into post-swap
           admissions. In-flight sequences keep their already-written
@@ -1017,6 +1061,16 @@ class InferenceEngine:
                 raise ValueError(
                     "update_params: draft_params given but the "
                     "engine has no draft model")
+            # Int8 weight-only engines hold quantized trees: quantize
+            # the published f32 masters BEFORE validation, so the
+            # leaf-for-leaf check compares quantized against quantized
+            # and the donated swap copies int8+scales. Shapes repeat, so
+            # this hits the cached _quantize trace (quantize_traces is
+            # sentinel-pinned).
+            if self._quant_target:
+                new_params = self._quantize_fn(new_params)
+            if self._quant_draft and draft_params is not None:
+                draft_params = self._quantize_fn(draft_params)
             placed = self._place_tree(old, new_params, "params")
             placed_draft = (
                 self._place_tree(old_draft, draft_params, "draft_params")
@@ -1478,7 +1532,27 @@ class InferenceEngine:
 
     def check_invariants(self):
         """Allocator/tree/slot cross-checks for the fuzz tests: every
-        allocated block is accounted for by exactly its holders."""
+        allocated block is accounted for by exactly its holders; an int8
+        pool's scale arrays must additionally track their payload's
+        block geometry exactly (one f32 scale per (position, head) row —
+        refcounts need no separate audit because scales share the
+        payload's block axis and ride the same copy/evict/free paths)."""
+        def _audit_scales(pool, label):
+            if pool is None or "k_scale" not in pool:
+                return
+            for nm in ("k", "v"):
+                pay, sc = pool[nm], pool[nm + "_scale"]
+                assert tuple(sc.shape) == tuple(pay.shape[:-1]), \
+                    f"{label}{nm}_scale shape {tuple(sc.shape)} != " \
+                    f"payload rows {tuple(pay.shape[:-1])}"
+                assert str(sc.dtype) == "float32", \
+                    f"{label}{nm}_scale dtype {sc.dtype} != float32"
+                assert str(pay.dtype) == "int8", \
+                    f"{label}{nm} payload dtype {pay.dtype} != int8 " \
+                    f"despite scale arrays present"
+
+        _audit_scales(self.cache, "")
+        _audit_scales(self.draft_cache, "draft ")
         self._alloc.check()
         holds = collections.Counter()
         for s in self._slots:
@@ -1554,6 +1628,10 @@ class InferenceEngine:
           each jitted path; tests pin decode/verify to 1 per lifetime.
           ``swap_traces`` — traces of the hot-swap copy fn (once per
           distinct pytree: target and draft each trace once, ever).
+          ``quantize_traces`` — traces of the int8 weight-quantize fn
+          (0 for f32-weight engines; else once per distinct tree shape
+          — target and quantized draft each at most once, however many
+          hot-swaps re-run it).
 
         Paged cache:
           ``block_size`` / ``cache_blocks`` / ``blocks_in_use`` /
@@ -1567,6 +1645,12 @@ class InferenceEngine:
           ``cancelled`` — requests cancelled/abandoned.
           ``max_admission_stall_ms`` — worst single-tick admission work
           while anything was decoding.
+          ``pool_bytes`` — total device bytes of the preallocated block
+          pool(s), payload plus any int8 scale arrays (draft pool
+          included); fixed at construction.
+          ``kv_bytes_per_token`` — main-pool bytes one cached position
+          costs (all layers, K+V, scales included) — the capacity
+          lever `kv_dtype="int8"` pulls (~4x down vs an f32 pool).
 
         Autoscaler load signals:
           ``queue_depth`` — unadmitted requests (demand ~ inflight +
@@ -1660,6 +1744,8 @@ class InferenceEngine:
                 "evicted_blocks": self._evicted_blocks,
                 "cancelled": self._cancelled,
                 "max_admission_stall_ms": self._max_admission_stall * 1e3,
+                "pool_bytes": self._pool_bytes,
+                "kv_bytes_per_token": self._kv_bytes_per_token,
                 # load stats the autoscaler consumes
                 "queue_depth": len(self._pending),
                 "decode_tok_s": (win_toks / win_t) if win_t > 0 else 0.0,
@@ -1687,6 +1773,7 @@ class InferenceEngine:
                 "swaps": self._swaps,
                 "weight_swap_ms": self._last_swap_ms,
                 "swap_traces": self.swap_traces,
+                "quantize_traces": self.quantize_traces,
                 # fault tolerance
                 "sheds": self._sheds,
                 "watchdog_stalls": self._watchdog_stalls,

@@ -88,6 +88,7 @@ COMPILE_ONCE_JITS: dict[str, dict[str, str | None]] = {
         "self._propose_fn": "draft",
         "self._draft_prefill_fn": "draft_prefill",
         "self._swap_fn": "swap",
+        "self._quantize_fn": "quantize",  # int8 weight-only path
     },
     LOOP: {
         "fuse_steps": "dispatch",       # factory: returns the fused jit

@@ -59,6 +59,42 @@ def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     assert rec["retraces_unexpected"] == 0
     assert np.isfinite(rec["trace_overhead_pct"])
     assert abs(rec["trace_overhead_pct"]) < 50.0
+    # quantization fields: everything full-precision by default. The
+    # default pool is bf16 (TPU) / model dtype, so capacity_vs_f32 — a
+    # ratio against an f32 pool of the same geometry — pins at exactly
+    # 2.0, and the quality proxy is identically 0 (nothing to compare).
+    assert rec["kv_dtype"] == "f32" and rec["weight_dtype"] == "f32"
+    assert rec["pool_bytes"] > 0
+    assert rec["capacity_streams_per_gb"] > 0
+    assert rec["capacity_vs_f32"] == 2.0
+    assert rec["quality_logprob_delta"] == 0.0
+
+
+def test_bench_infer_quantized_smoke(capsys, monkeypatch):
+    """KV_DTYPE=int8 + WEIGHT_DTYPE=int8: the capacity headline (the
+    tentpole's >=1.9x concurrent-stream criterion at equal pool budget)
+    plus the pinned quality bound, with the retrace sentinel still
+    silent — quantization must not add a single unexpected trace."""
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_REQUESTS", "3")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_NEW", "3")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_KV_DTYPE", "int8")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_WEIGHT_DTYPE", "int8")
+    import bench_infer
+
+    bench_infer.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["kv_dtype"] == "int8" and rec["weight_dtype"] == "int8"
+    # int8 rows cost H*(D+4) bytes vs the f32 pool's H*D*4: >= 1.9x
+    # more tokens (streams) per byte — 3.556x at this head_dim.
+    assert rec["capacity_vs_f32"] > 1.9
+    assert rec["capacity_streams_per_gb"] > 0
+    assert rec["pool_bytes"] > 0
+    # quality proxy: mean |greedy logprob delta| vs an f32 engine on
+    # the same prompts — the "tight-allclose" bound, pinned loose
+    # enough to absorb prompt-mix noise but far below real drift.
+    assert 0.0 <= rec["quality_logprob_delta"] < 0.02
+    assert rec["retraces_unexpected"] == 0
+    assert rec["value"] == rec["decode_tokens_per_sec"] > 0
 
 
 def test_bench_infer_spec_ngram_smoke(capsys, monkeypatch):

@@ -12,6 +12,7 @@ import pytest
 
 from ray_tpu.models import gpt
 from ray_tpu.ops import decode_attention as da
+from ray_tpu.ops import quant
 from ray_tpu.serve.engine import BlockAllocator, InferenceEngine, RadixTree
 
 
@@ -637,3 +638,313 @@ def test_fuzz_small_no_prefix_cache(setup):
 @pytest.mark.parametrize("seed", [2, 3, 4])
 def test_fuzz_large(setup, seed):
     _fuzz(setup, ops=300, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# quantized KV (int8 payload, per-row scales)
+# ---------------------------------------------------------------------------
+
+def _peaked(params):
+    """Sharpen the tiny random-init model's logits: they are near-uniform
+    (greedy argmax gaps below int8 noise), so token-identity tests scale
+    the embedding to restore a decisive winner at every step."""
+    return {**params, "embed": params["embed"] * 8}
+
+
+@pytest.fixture(scope="module")
+def setup_q(setup):
+    """kv_dtype="int8" config + peaked params (shapes are independent of
+    kv_dtype, so the module fixture's params are reusable)."""
+    return tiny_cfg(kv_dtype="int8"), _peaked(setup[1])
+
+
+class TestQuantizedPagedAttention:
+    def _quantized(self, b, s, h, d, bs, seed=0):
+        q, k, v, kp, vp, tables, pos = TestPagedAttention()._paged(
+            b, s, h, d, bs, seed=seed)
+        kq, ksc = quant.quantize_rows(kp)
+        vq, vsc = quant.quantize_rows(vp)
+        return q, kp, vp, kq, ksc, vq, vsc, tables, pos
+
+    def test_kernel_matches_reference(self):
+        """Pallas (interpret on CPU) dequant-in-VMEM == gather-then-
+        dequant reference on an int8 pool."""
+        q, _, _, kq, ksc, vq, vsc, tables, pos = self._quantized(
+            2, 64, 2, 16, 16, seed=5)
+        ref = da.reference_paged_decode_attention(
+            q, kq, vq, tables, pos, k_scale=ksc, v_scale=vsc)
+        out = da.paged_decode_attention(
+            q, kq, vq, tables, pos, k_scale=ksc, v_scale=vsc,
+            impl="pallas")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_quantized_close_to_f32(self):
+        """Int8+scale attention lands within quantization noise of the
+        f32 pool it was built from."""
+        q, kp, vp, kq, ksc, vq, vsc, tables, pos = self._quantized(
+            2, 32, 2, 8, 8, seed=1)
+        f32 = da.paged_decode_attention(q, kp, vp, tables, pos,
+                                        impl="jax")
+        i8 = da.paged_decode_attention(
+            q, kq, vq, tables, pos, k_scale=ksc, v_scale=vsc,
+            impl="jax")
+        np.testing.assert_allclose(np.asarray(i8), np.asarray(f32),
+                                   atol=0.1, rtol=0.1)
+
+    def test_roundtrip_is_deterministic(self):
+        """Same f32 rows -> byte-identical int8 payload and scales on
+        every call — the property that keeps batched verify bit-equal
+        to sequential decode on a quantized pool."""
+        x = jax.random.normal(jax.random.PRNGKey(2), (16, 4, 8))
+        q1, s1 = quant.quantize_rows(x)
+        q2, s2 = quant.quantize_rows(x)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        # zero rows must dequantize to exact zero, not NaN
+        qz, sz = quant.quantize_rows(jnp.zeros((2, 3, 8)))
+        assert not np.isnan(np.asarray(sz)).any()
+        np.testing.assert_array_equal(
+            np.asarray(quant.dequantize_rows(qz, sz)), 0.0)
+
+    def test_scale_validation(self):
+        """k_scale/v_scale are both-or-neither on every paged wrapper."""
+        q, _, _, kq, ksc, vq, vsc, tables, pos = self._quantized(
+            2, 32, 2, 8, 8)
+        with pytest.raises(ValueError, match="both k_scale and v_scale"):
+            da.paged_decode_attention(q, kq, vq, tables, pos,
+                                      k_scale=ksc)
+        with pytest.raises(ValueError):
+            da.paged_decode_attention(
+                q, kq, vq, tables, pos, k_scale=ksc[:, :4],
+                v_scale=vsc)
+
+
+class TestFusedPrefill:
+    def _seq(self, s, h, d, bs, seed=0, quantize=False):
+        """One sequence's K/V scattered into a scrambled single-table
+        pool, plus its full query stack."""
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (s, h, d))
+        k = jax.random.normal(ks[1], (s, h, d))
+        v = jax.random.normal(ks[2], (s, h, d))
+        mb = s // bs
+        table = (np.random.default_rng(seed).permutation(mb) + 1) \
+            .astype(np.int32)
+        kp = np.zeros((mb + 1, bs, h, d), np.float32)
+        vp = np.zeros_like(kp)
+        for j in range(mb):
+            kp[table[j]] = np.asarray(k[j * bs:(j + 1) * bs])
+            vp[table[j]] = np.asarray(v[j * bs:(j + 1) * bs])
+        kp, vp = jnp.asarray(kp), jnp.asarray(vp)
+        if not quantize:
+            return q, kp, vp, None, None, jnp.asarray(table)
+        kq, ksc = quant.quantize_rows(kp)
+        vq, vsc = quant.quantize_rows(vp)
+        return q, kq, vq, ksc, vsc, jnp.asarray(table)
+
+    @pytest.mark.parametrize("start,c", [(0, 32), (8, 8), (16, 5)])
+    def test_pallas_matches_jax(self, start, c):
+        """The fused (mq-kernel) path == the legacy dense gather+einsum,
+        including a ragged tail chunk (c=5, padded rows discarded)."""
+        q, kp, vp, _, _, table = self._seq(32, 2, 16, 8, seed=4)
+        ref = da.paged_prefill_attention(q[start:start + c], kp, vp,
+                                         table, start, impl="jax")
+        pal = da.paged_prefill_attention(q[start:start + c], kp, vp,
+                                         table, start, impl="pallas")
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("start,c", [(0, 16), (8, 5)])
+    def test_pallas_matches_jax_quantized(self, start, c):
+        q, kq, vq, ksc, vsc, table = self._seq(16, 2, 16, 8, seed=7,
+                                               quantize=True)
+        ref = da.paged_prefill_attention(
+            q[start:start + c], kq, vq, table, start,
+            k_scale=ksc, v_scale=vsc, impl="jax")
+        pal = da.paged_prefill_attention(
+            q[start:start + c], kq, vq, table, start,
+            k_scale=ksc, v_scale=vsc, impl="pallas")
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_rejects_bad_rank(self):
+        with pytest.raises(ValueError, match="paged_prefill_attention"):
+            da.paged_prefill_attention(
+                jnp.zeros((4, 16)), jnp.zeros((4, 8, 2, 16)),
+                jnp.zeros((4, 8, 2, 16)), jnp.zeros((4,), jnp.int32), 0)
+
+
+class TestQuantizedModelPath:
+    def test_pool_layout(self, setup_q):
+        cfg, _ = setup_q
+        pool = gpt.init_kv_pool(cfg, 6, 8)
+        assert set(pool) == {"k", "v", "k_scale", "v_scale"}
+        assert pool["k"].dtype == jnp.int8
+        assert pool["k_scale"].dtype == jnp.float32
+        assert pool["k_scale"].shape == pool["k"].shape[:-1]
+
+    def test_f32_pool_unchanged(self, setup):
+        """kv_dtype="f32" (the default) keeps the legacy two-array pool
+        — no scale arrays, no dtype change."""
+        cfg, _ = setup
+        pool = gpt.init_kv_pool(cfg, 6, 8)
+        assert set(pool) == {"k", "v"}
+        assert pool["k"].dtype == jnp.dtype(cfg.dtype)
+
+    def test_bad_kv_dtype_rejected(self, setup):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            gpt.init_kv_pool(tiny_cfg(kv_dtype="int4"), 6, 8)
+
+    def test_copy_block_carries_scales(self, setup_q):
+        """COW block copies move the scale rows with the payload."""
+        cfg, _ = setup_q
+        pool = gpt.init_kv_pool(cfg, 4, 8)
+        pool = {name: arr + jnp.arange(4, dtype=arr.dtype).reshape(
+                    (1, 4) + (1,) * (arr.ndim - 2))
+                for name, arr in pool.items()}
+        out = gpt.copy_block(pool, 3, 1)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(np.asarray(out[name][:, 1]),
+                                          np.asarray(out[name][:, 3]))
+        np.testing.assert_array_equal(
+            np.asarray(out["k_scale"][:, 2]),
+            2 * np.ones_like(np.asarray(out["k_scale"][:, 2])))
+
+    def test_pool_sharding_specs_quantized(self):
+        from ray_tpu.parallel import MeshSpec
+        from ray_tpu.parallel.sharding import kv_pool_specs
+        mesh = MeshSpec(data=-1).build(jax.devices())
+        specs = kv_pool_specs(mesh, quantized=True)
+        assert set(specs) == {"k", "v", "k_scale", "v_scale"}
+        pool = gpt.init_kv_pool(tiny_cfg(n_layers=1, kv_dtype="int8"),
+                                4, 8, mesh=mesh)
+        assert pool["k_scale"].sharding.spec == specs["k_scale"]
+
+    def test_prefill_decode_greedy_matches_f32(self, setup):
+        """The tentpole criterion at the model-path level: chunked
+        prefill + greedy decode through an int8 pool emits the exact
+        tokens of the f32 pool AND the full-forward rollout."""
+        params = _peaked(setup[1])
+        prompt = list(np.random.default_rng(0).integers(
+            0, 128, 12))
+
+        def run(cfg):
+            pool = gpt.init_kv_pool(cfg, 8, 8)
+            table = np.array([5, 2, 7, 1], np.int32)
+            start = 0
+            for clen in (8, 4):
+                toks = np.zeros((1, 8), np.int32)
+                toks[0, :clen] = prompt[start:start + clen]
+                logits, pool = gpt.prefill_paged(
+                    params, jnp.asarray(toks), pool, cfg,
+                    block_table=jnp.asarray(table), start=start,
+                    length=jnp.int32(clen))
+                start += clen
+            out, cur = [], int(jnp.argmax(logits[0]))
+            tables = jnp.asarray(table)[None]
+            for t in range(len(prompt), len(prompt) + 6):
+                out.append(cur)
+                logits, pool = gpt.decode_step_paged(
+                    params, jnp.asarray([cur], jnp.int32), pool,
+                    jnp.asarray([t], jnp.int32), tables, cfg)
+                cur = int(jnp.argmax(logits[0]))
+            return out
+
+        got_q = run(tiny_cfg(kv_dtype="int8"))
+        got_f = run(tiny_cfg())
+        assert got_q == got_f == rollout_reference(
+            params, prompt, tiny_cfg(), 6)
+
+    def test_quantize_params_layout(self, setup):
+        """Weight-only int8: every matmul weight gains a per-output-
+        channel scale sibling; norms/embeddings stay f32 masters."""
+        _, params = setup
+        qp = gpt.quantize_params(params)
+        for name in gpt.QUANTIZED_WEIGHTS:
+            w = qp["layers"][name]
+            s = qp["layers"][name + "_scale"]
+            assert w.dtype == jnp.int8
+            assert s.shape == w.shape[:-2] + w.shape[-1:]
+        assert qp["embed"].dtype == params["embed"].dtype
+        assert qp["layers"]["ln1_scale"].dtype == jnp.float32
+
+
+class TestQuantizedEngine:
+    def test_greedy_token_identical_to_f32(self, setup, setup_q):
+        """Engine-level tentpole criterion: int8-KV greedy decode is
+        token-identical to the f32 engine across a shared aligned
+        prefix AND a mid-block COW divergence."""
+        cfg_q, params = setup_q
+        cfg_f = tiny_cfg()
+        rng = np.random.default_rng(21)
+        x = list(rng.integers(0, 128, 16))
+        y = x[:12] + list(rng.integers(0, 128, 4))   # COW split
+        z = x + list(rng.integers(0, 128, 4))        # aligned extend
+
+        def run(cfg):
+            eng = make_engine(cfg, params)
+            outs = [eng.generate(p, max_new_tokens=6) for p in
+                    (x, y, z)]
+            eng.check_invariants()
+            return outs, eng.stats()
+
+        got_q, sq = run(cfg_q)
+        got_f, sf = run(cfg_f)
+        assert got_q == got_f
+        assert got_q[0] == rollout_reference(params, x, cfg_f, 6)
+        assert sq["cow_copies"] >= 1 and sq["prefix_hit_tokens"] > 0
+        assert sq["decode_traces"] == 1
+
+    def test_weight_int8_quality_and_swap(self, setup):
+        """Weight-only int8: greedy logprobs stay tight-allclose to the
+        f32 engine (the pinned quality bound), the quantize executable
+        compiles exactly once, and a same-shape update_params reuses it
+        (RL-flywheel swap path, zero retraces)."""
+        cfg_f = tiny_cfg()
+        cfg_w = tiny_cfg(weight_dtype="int8")
+        params = _peaked(setup[1])
+        prompt = list(np.random.default_rng(23).integers(0, 128, 10))
+        eng_w = make_engine(cfg_w, params)
+        eng_f = make_engine(cfg_f, params)
+        a = eng_w.generate(prompt, max_new_tokens=8)
+        b = eng_f.generate(prompt, max_new_tokens=8)
+        assert list(a) == list(b)           # peaked logits: same argmax
+        deltas = [abs(x.logprob - y.logprob) for x, y in zip(a, b)]
+        assert max(deltas) < 0.05
+        assert eng_w.quantize_traces == 1
+        assert eng_w.stats()["quantize_traces"] == 1
+        eng_w.update_params(params)         # same shapes: no retrace
+        assert eng_w.quantize_traces == 1
+        assert list(eng_w.generate(prompt, max_new_tokens=8)) == list(b)
+        eng_w.check_invariants()
+
+    def test_pool_gauges(self, setup, setup_q):
+        """`pool_bytes`/`kv_bytes_per_token` report the int8 shrink:
+        payload bytes per token drop from 4 per element to 1 + the
+        amortized scale column."""
+        cfg_q, params = setup_q
+        sq = make_engine(cfg_q, params).stats()
+        sf = make_engine(tiny_cfg(), params).stats()
+        assert 0 < sq["pool_bytes"] < sf["pool_bytes"]
+        hd = cfg_q.head_dim
+        assert sf["kv_bytes_per_token"] / sq["kv_bytes_per_token"] == \
+            pytest.approx(4 * hd / (hd + 4))
+        # engine invariants audit the scale arrays alongside payloads
+        eng = make_engine(cfg_q, params)
+        eng.generate([1, 2, 3, 4], max_new_tokens=3)
+        eng.check_invariants()
+
+
+def test_fuzz_small_quantized(setup):
+    """The admit/cancel/retire storm on an int8 pool: COW, eviction and
+    abandonment with `check_invariants` auditing scale arrays after
+    every operation."""
+    s = _fuzz((tiny_cfg(kv_dtype="int8"), setup[1]), ops=40, seed=0)
+    assert s["decode_tokens"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [5, 6])
+def test_fuzz_large_quantized(setup, seed):
+    _fuzz((tiny_cfg(kv_dtype="int8"), setup[1]), ops=300, seed=seed)

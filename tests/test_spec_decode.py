@@ -343,3 +343,97 @@ class TestSpecStats:
             make_engine(cfg, params, spec="draft")   # no draft model
         with pytest.raises(ValueError):
             make_engine(cfg, params, spec="ngram", spec_k=0)
+
+
+# ---------------------------------------------------------------------------
+# quantized cache (int8 KV) through the speculative path
+# ---------------------------------------------------------------------------
+
+class TestQuantizedSpec:
+    def test_verify_kernel_quantized_parity(self):
+        """The W-query verify kernel's in-VMEM dequant == the gather-
+        then-dequant jax path on an int8 pool."""
+        from ray_tpu.ops import quant
+        b, s, h, d, bs, w = 3, 48, 2, 16, 8, 5
+        kp, vp, tables = TestVerifyAttention()._paged(b, s, h, d, bs)
+        kq, ksc = quant.quantize_rows(kp)
+        vq, vsc = quant.quantize_rows(vp)
+        q = jax.random.normal(jax.random.PRNGKey(7), (b, w, h, d))
+        pos = jnp.asarray([5, 17, 40 - w], jnp.int32)
+        ref = da.paged_verify_attention(
+            q, kq, vq, tables, pos, k_scale=ksc, v_scale=vsc,
+            impl="jax")
+        pal = da.paged_verify_attention(
+            q, kq, vq, tables, pos, k_scale=ksc, v_scale=vsc,
+            impl="pallas")
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_verify_step_quantized_matches_sequential(self, setup):
+        """Batched verify on an int8 pool == W sequential decode steps,
+        bit-identical logits AND cache INCLUDING scale arrays: both
+        paths quantize each token's K/V row once at write through the
+        same deterministic round-trip, so speculative acceptance on a
+        quantized cache stays distribution-exact, not merely close."""
+        _, params = setup
+        cfg = tiny_cfg(kv_dtype="int8")
+        bs, w = 8, 4
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, cfg.vocab_size, 10).astype(np.int32)
+        window = rng.integers(1, cfg.vocab_size, (2, w)) \
+            .astype(np.int32)
+        tables = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+        pos = np.asarray([prompt.size, prompt.size], np.int32)
+
+        def prefilled():
+            cache = gpt.init_kv_pool(cfg, 9, bs)
+            for row in range(2):
+                _, cache = gpt.prefill_paged(
+                    params, jnp.asarray(prompt[None]), cache, cfg,
+                    block_table=jnp.asarray(tables[row]),
+                    start=0, length=prompt.size)
+            return cache
+
+        va, cache_a = gpt.verify_step_paged(
+            params, jnp.asarray(window), prefilled(),
+            jnp.asarray(pos), jnp.asarray(tables), cfg)
+        cache_b = prefilled()
+        seq_logits = []
+        for j in range(w):
+            lg, cache_b = gpt.decode_step_paged(
+                params, jnp.asarray(window[:, j]), cache_b,
+                jnp.asarray(pos + j), jnp.asarray(tables), cfg)
+            seq_logits.append(np.asarray(lg))
+        np.testing.assert_array_equal(np.asarray(va),
+                                      np.stack(seq_logits, axis=1))
+        assert set(cache_a) == {"k", "v", "k_scale", "v_scale"}
+        for name in cache_a:
+            np.testing.assert_array_equal(np.asarray(cache_a[name]),
+                                          np.asarray(cache_b[name]))
+
+    def test_greedy_spec_parity_quantized(self, setup):
+        """Speculation on/off over an int8 cache: token-identical to
+        each other AND to the f32 no-spec engine (peaked params keep
+        the argmax gaps above quantization noise)."""
+        _, base_params = setup
+        params = {**base_params, "embed": base_params["embed"] * 8}
+        cfg_q = tiny_cfg(kv_dtype="int8")
+        rng = np.random.default_rng(8)
+        prompts = [motif_prompt(rng, cfg_q.vocab_size, 12),
+                   rng.integers(1, cfg_q.vocab_size, 9)
+                   .astype(np.int32)]
+
+        def run(cfg, **ekw):
+            eng = make_engine(cfg, params, **ekw)
+            outs = [eng.generate(p, max_new_tokens=10) for p in prompts]
+            eng.check_invariants()
+            return outs, eng.stats()
+
+        f32, _ = run(tiny_cfg())
+        base, bs = run(cfg_q)
+        ng, ns = run(cfg_q, spec="ngram", spec_k=4)
+        dr, ds = run(cfg_q, spec="draft", spec_k=3,
+                     draft_params=params, draft_cfg=cfg_q)
+        assert f32 == base == ng == dr
+        assert ns["verify_traces"] == 1 and ds["verify_traces"] == 1
+        assert bs["decode_traces"] == 1
