@@ -37,6 +37,13 @@ extras ride alongside:
                            sampled at 1.0 vs 0.0. Only measured when
                            RAY_TPU_INFER_BENCH_TRACE_OVERHEAD=1 (it
                            doubles the run); 0.0 otherwise
+  priority_mix             the PRIORITY_MIX knob this run used ("" off)
+  preemptions              streams evicted for a higher class during
+                           the priority phase (0 when the mix is unset)
+  reprefill_blocks         resume blocks re-prefilled that the radix
+                           cache did not cover
+  queue_wait_ms_p99_by_class  per-class p99 submit-to-first-token (ms),
+                           keyed by class id ({} when the mix is unset)
   kv_dtype / weight_dtype  the quantization knobs this run used
   pool_bytes               device bytes of the preallocated KV block
                            pool(s), scale arrays included
@@ -82,6 +89,22 @@ Knobs (env vars, platform-tuned defaults in main()):
                                      quantized pool, models/gpt.py)
   RAY_TPU_INFER_BENCH_WEIGHT_DTYPE   "f32" | "int8": weight-only decode
                                      matmul precision
+  RAY_TPU_INFER_BENCH_PRIORITY_MIX   comma-separated per-class request
+                                     counts, lowest class first (e.g.
+                                     "3,0,1" = 3 class-0 + 1 class-2).
+                                     When set, an extra phase runs the
+                                     mix through a priority-enabled
+                                     engine — the low classes admitted
+                                     and decoding first, the high wave
+                                     arriving into a loaded pool — and
+                                     the JSON gains `priority_mix`,
+                                     `preemptions`, `reprefill_blocks`,
+                                     and `queue_wait_ms_p99_by_class`
+                                     (all neutral when unset)
+  RAY_TPU_INFER_BENCH_CACHE_BLOCKS   paged-pool size for the priority
+                                     phase (0 = engine default); size it
+                                     below the mix's total footprint to
+                                     force block-pressure preemption
 
 Baseline: single-token decode is HBM-bandwidth-bound — every step
 streams the full parameter set plus the live KV prefix through the chip
@@ -299,6 +322,39 @@ def main():
     weight_swap_ms = swap_stats["weight_swap_ms"]
     rollout_tok_s = sampler.last_rollout_tok_s
 
+    # --- priority-mix phase: class contention under a tight pool -------
+    priority_mix = os.environ.get("RAY_TPU_INFER_BENCH_PRIORITY_MIX", "")
+    preemptions = reprefill_blocks = 0
+    wait_p99_by_class: dict[str, float] = {}
+    if priority_mix:
+        mix = [int(x) for x in priority_mix.split(",")]
+        cache_blocks = _env_int("RAY_TPU_INFER_BENCH_CACHE_BLOCKS", 0)
+        pkw = {"priority_classes": max(len(mix), 2)}
+        if cache_blocks:
+            pkw["cache_blocks"] = cache_blocks
+        peng = InferenceEngine(params, cfg, slots=slots, max_len=max_len,
+                               block_size=block_size,
+                               prefill_chunk=chunk or None, **pkw)
+        # Low classes first, pumped until they hold blocks and decode —
+        # so the higher waves land on a loaded pool and any preemption
+        # is real block pressure, not queue ordering.
+        for cls, n in enumerate(mix):
+            for _ in range(n):
+                peng.submit(make_prompt(), max_new_tokens=new_tokens,
+                            priority=cls)
+            for _ in range(200):
+                if not peng._pending:
+                    break
+                peng.step()
+        peng.run_until_idle()
+        ps = peng.stats()
+        preemptions = ps["preemptions"]
+        reprefill_blocks = ps["reprefill_blocks"]
+        wait_p99_by_class = {
+            c: round(pc["queue_wait_ms_p99"], 3)
+            for c, pc in ps["per_class"].items()}
+        peng.check_invariants()
+
     spec_stats = None
     if spec:
         ekw = {"spec": spec, "spec_k": spec_k}
@@ -369,6 +425,11 @@ def main():
         "ttft_ms_p99": round(s["ttft_ms_p99"], 3),
         "retraces_unexpected": s["retraces_unexpected"],
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        # priority/preemption phase (neutral when the mix is unset)
+        "priority_mix": priority_mix,
+        "preemptions": preemptions,
+        "reprefill_blocks": reprefill_blocks,
+        "queue_wait_ms_p99_by_class": wait_p99_by_class,
     }))
 
 

@@ -23,6 +23,7 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle, token_resume
 from ray_tpu.serve.http_proxy import Request
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.priority import get_request_priority
 from ray_tpu.serve.replica import StreamingResponse
 from ray_tpu.serve.schema import apply_config, build_app_from_config
 
@@ -30,7 +31,8 @@ __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "status",
     "shutdown", "delete", "set_route", "get_deployment_handle",
     "DeploymentHandle", "batch", "Request", "StreamingResponse",
-    "multiplexed", "get_multiplexed_model_id", "apply_config",
+    "multiplexed", "get_multiplexed_model_id", "get_request_priority",
+    "apply_config",
     "build_app_from_config", "OverloadedError", "token_resume",
     "InferenceEngine", "InferenceReplica",
 ]

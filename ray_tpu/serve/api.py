@@ -57,7 +57,8 @@ class Deployment:
                  ray_actor_options: Optional[dict] = None,
                  max_concurrent_queries: int = 8,
                  autoscaling_config: Optional[dict] = None,
-                 route_prefix: Optional[str] = None):
+                 route_prefix: Optional[str] = None,
+                 default_priority: int = 0):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
@@ -65,6 +66,7 @@ class Deployment:
         self.max_concurrent_queries = max_concurrent_queries
         self.autoscaling_config = autoscaling_config
         self.route_prefix = route_prefix
+        self.default_priority = default_priority
 
     def options(self, **opts) -> "Deployment":
         merged = {
@@ -74,6 +76,7 @@ class Deployment:
             "max_concurrent_queries": self.max_concurrent_queries,
             "autoscaling_config": self.autoscaling_config,
             "route_prefix": self.route_prefix,
+            "default_priority": self.default_priority,
         }
         merged.update(opts)
         return Deployment(self._target, **merged)
@@ -95,6 +98,7 @@ class Deployment:
             "max_concurrent_queries": self.max_concurrent_queries,
             "autoscaling_config": self.autoscaling_config,
             "route_prefix": route_prefix,
+            "default_priority": self.default_priority,
         }
 
     def __call__(self, *a, **kw):
@@ -108,8 +112,12 @@ def deployment(target=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[dict] = None,
                max_concurrent_queries: int = 8,
                autoscaling_config: Optional[dict] = None,
-               route_prefix: Optional[str] = None):
-    """`@serve.deployment` decorator (bare or with options)."""
+               route_prefix: Optional[str] = None,
+               default_priority: int = 0):
+    """`@serve.deployment` decorator (bare or with options).
+
+    `default_priority` is the priority class stamped on requests that
+    don't carry one of their own (serve/priority.py)."""
 
     def wrap(t):
         return Deployment(t, name or t.__name__,
@@ -117,7 +125,8 @@ def deployment(target=None, *, name: Optional[str] = None,
                           ray_actor_options=ray_actor_options,
                           max_concurrent_queries=max_concurrent_queries,
                           autoscaling_config=autoscaling_config,
-                          route_prefix=route_prefix)
+                          route_prefix=route_prefix,
+                          default_priority=default_priority)
 
     if target is not None:
         return wrap(target)

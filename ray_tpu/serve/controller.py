@@ -294,6 +294,7 @@ class ServeController:
             "deployment_name": st.name,
             "max_concurrent_queries":
                 st.spec.get("max_concurrent_queries", 8),
+            "default_priority": st.spec.get("default_priority", 0),
         })
         self._born[r._actor_id] = time.time()
         return r

@@ -57,6 +57,21 @@ thing that changes between steps is *data*, never shapes:
   tradeoff (MindSpeed RL, 2507.19017) — which the per-token version
   tags make visible to the learner.
 
+- **priority classes + preemption** (multi-tenant serving): `submit`
+  takes a class (``priority=``, 0 = lowest). Admission runs weighted
+  shares across backlogged classes (stride scheduling, weight =
+  base**class) with an aging escalation bound so low classes never
+  starve; overload shedding is class-ordered (the lowest-class QUEUED
+  request sheds first, typed `OverloadedError` delivered through its
+  `tokens_for`); and when the block pool can't serve a higher class,
+  the lowest-class ACTIVE stream is preempted — its written blocks are
+  published to the radix tree, its blocks released, and the stream
+  requeued as a chunked re-prefill of prompt+emitted with the SAME rid
+  and output queue. A resumed greedy stream is token-identical to an
+  unpreempted run (same KV ⇒ same continuation — the property the
+  serve handle's `token_resume` failover already relies on), including
+  across shared-prefix/COW admissions and both spec-decode backends.
+
 Sampling (greedy + temperature) runs inside the jitted functions, as
 before. `step()` is the one scheduler tick (admit, chunk, decode);
 `submit()` / `tokens_for()` / `cancel()` are the request-side API. A
@@ -390,6 +405,13 @@ class _Pending:
     temperature: float
     eos_id: int | None
     ts: float = 0.0               # submit time (queue-wait accounting)
+    priority: int = 0             # class (0 = lowest); admission order,
+    # shed order, and preemption eligibility all key off it
+    resumed: bool = False         # a preempted stream's re-prefill:
+    # prompt is the ORIGINAL prompt + every token already delivered, so
+    # admission must not re-count TTFT/queue-wait for it
+    aged: bool = False            # escalated past the weighted-share
+    # order by the aging bound (counted once per request)
 
 
 @dataclass
@@ -412,6 +434,12 @@ class _Slot:
     temperature: float = 0.0
     eos_id: int | None = None
     submit_ts: float = 0.0
+    priority: int = 0
+    resumed: bool = False
+    # every token this stream has emitted, in order — preemption
+    # requeues prompt+emitted as a re-prefill, which (greedy) resumes
+    # token-identical: the KV it recomputes is exactly the KV released
+    emitted: list = field(default_factory=list)
     # speculative decoding state: the request's token history (prompt +
     # emitted, n-gram lookahead's corpus) and, for the draft-model
     # backend, this slot's blocks/table in the DRAFT pool.
@@ -453,7 +481,10 @@ class InferenceEngine:
                  telemetry_sample: float | None = None,
                  max_queue: int | None = None,
                  shed_high_water: float | None = None,
-                 watchdog_s: float | None = None):
+                 watchdog_s: float | None = None,
+                 priority_classes: int | None = None,
+                 priority_aging_s: float | None = None,
+                 priority_weight_base: float | None = None):
         import jax
         import jax.numpy as jnp
         from ray_tpu.models import gpt
@@ -713,7 +744,40 @@ class InferenceEngine:
         # drained (tokens_for pops, then deletes) or cancelled.
         self._out: dict[int, collections.deque] = {}
         self._done: set[int] = set()
+        # rid -> exception for requests terminated while QUEUED (class-
+        # ordered shedding): tokens_for raises it to the consumer.
+        self._errors: dict[int, Exception] = {}
         self._lock = threading.RLock()
+
+        # --- priority classes (multi-tenant admission) ----------------
+        from ray_tpu._private.constants import (
+            ENGINE_PRIORITY_AGING_S, ENGINE_PRIORITY_CLASSES,
+            ENGINE_PRIORITY_WEIGHT_BASE)
+        self.priority_classes = (ENGINE_PRIORITY_CLASSES
+                                 if priority_classes is None
+                                 else int(priority_classes))
+        if self.priority_classes < 1:
+            raise ValueError("priority_classes must be >= 1")
+        self.priority_aging_s = (ENGINE_PRIORITY_AGING_S
+                                 if priority_aging_s is None
+                                 else float(priority_aging_s))
+        if self.priority_aging_s <= 0:
+            raise ValueError("priority_aging_s must be > 0")
+        self.priority_weight_base = (ENGINE_PRIORITY_WEIGHT_BASE
+                                     if priority_weight_base is None
+                                     else float(priority_weight_base))
+        if self.priority_weight_base < 1.0:
+            raise ValueError("priority_weight_base must be >= 1")
+        # stride-scheduler pass value per backlogged class; shares the
+        # scheduler lock (the admission queue has no lock of its own —
+        # R004: no new lock-order edge)
+        self._class_pass: dict[int, float] = {}
+        # per-class counters/waits (lazily created per class seen)
+        self._per_class: dict[int, dict] = {}
+        self._class_waits: dict[int, collections.deque] = {}
+        self._preemptions = 0
+        self._reprefill_blocks = 0
+        self._aging_promotions = 0
         # Serializes weight hot-swaps; exists so the blocking
         # host->device upload in _place_tree happens OUTSIDE _lock.
         self._swap_mutex = threading.Lock()
@@ -882,14 +946,25 @@ class InferenceEngine:
 
     def submit(self, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0,
-               eos_id: int | None = None) -> int:
+               eos_id: int | None = None,
+               priority: int = 0) -> int:
         """Queue a prompt (sequence of token ids); returns a request id
         for `tokens_for`. Admission happens inside `step()` — long
         prompts are absorbed in chunks, so there is no per-bucket prompt
-        length limit, only the cache-capacity ones."""
+        length limit, only the cache-capacity ones.
+
+        `priority` is the request's class (0 = lowest, up to
+        ``priority_classes - 1``): higher classes get proportionally
+        more admission share, shed last, and may preempt strictly-lower
+        active streams under block pressure."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        priority = int(priority)
+        if not 0 <= priority < self.priority_classes:
+            raise ValueError(
+                f"priority {priority} outside "
+                f"[0, {self.priority_classes})")
         if prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
@@ -911,8 +986,17 @@ class InferenceEngine:
                     self.shed_high_water is not None:
                 reason = self._shed_verdict(
                     self._blocks_for(prompt.size, max_new_tokens))
+                # Class-ordered shedding: pressure evicts the lowest-
+                # class QUEUED request first; the incoming request is
+                # only shed when nothing queued ranks below it (so an
+                # all-one-class engine behaves exactly as before).
+                while reason is not None and \
+                        self._shed_lowest_below(priority):
+                    reason = self._shed_verdict(
+                        self._blocks_for(prompt.size, max_new_tokens))
                 if reason is not None:
                     self._sheds += 1
+                    self._class_counter(priority)["sheds"] += 1
                     raise OverloadedError(
                         f"engine overloaded, request shed: {reason}")
             rid = self._rid
@@ -920,9 +1004,50 @@ class InferenceEngine:
             self._out[rid] = collections.deque()
             self._pending.append(_Pending(rid, prompt, max_new_tokens,
                                           temperature, eos_id,
-                                          time.perf_counter()))
+                                          time.perf_counter(),
+                                          priority=priority))
+            self._class_counter(priority)["submitted"] += 1
             self._recorder.on_submit(rid, prompt.size)
         return rid
+
+    def _shed_lowest_below(self, priority: int) -> bool:
+        """Shed the lowest-class queued request strictly below
+        `priority` (newest of that class — least sunk wait), delivering
+        a typed `OverloadedError` through its `tokens_for`. Returns
+        False when no queued request ranks below `priority`. Resumed
+        (preempted) streams are never shed here: they have already
+        delivered tokens to a live consumer."""
+        victim_i = None
+        for i, q in enumerate(self._pending):
+            if q.priority >= priority or q.resumed:
+                continue
+            if victim_i is None:
+                victim_i = i
+                continue
+            v = self._pending[victim_i]
+            if (q.priority, -q.ts) < (v.priority, -v.ts):
+                victim_i = i
+        if victim_i is None:
+            return False
+        victim = self._pending[victim_i]
+        del self._pending[victim_i]
+        self._errors[victim.rid] = OverloadedError(
+            f"engine overloaded: request (class {victim.priority}) "
+            f"shed from the queue for a class-{priority} admission")
+        self._sheds += 1
+        self._class_counter(victim.priority)["sheds"] += 1
+        self._recorder.on_finish(victim.rid, "shed")
+        return True
+
+    def _class_counter(self, c: int) -> dict:
+        """Per-class counter row (lazily created; under the lock)."""
+        d = self._per_class.get(c)
+        if d is None:
+            d = {"submitted": 0, "completed": 0, "sheds": 0,
+                 "preemptions": 0, "decode_tokens": 0}
+            self._per_class[c] = d
+            self._class_waits[c] = collections.deque(maxlen=256)
+        return d
 
     def cancel(self, rid: int) -> bool:
         """Abort a request wherever it is — pending, mid-prefill,
@@ -942,6 +1067,7 @@ class InferenceEngine:
                     hit = True
                     break
             hit |= self._out.pop(rid, None) is not None
+            hit |= self._errors.pop(rid, None) is not None
             self._done.discard(rid)
             if hit:
                 self._cancelled += 1
@@ -967,6 +1093,13 @@ class InferenceEngine:
                     q = self._out.get(rid)
                     if q is None:
                         return
+                    err = self._errors.pop(rid, None)
+                    if err is not None:
+                        # terminated while queued (class-ordered shed):
+                        # surface the typed error to this consumer
+                        del self._out[rid]
+                        self._done.discard(rid)
+                        raise err
                     while not q and rid not in self._done:
                         self.step()
                     if q:
@@ -1117,6 +1250,14 @@ class InferenceEngine:
         bs = self.block_size
         p = req.prompt.size
         total = self._blocks_for(p, req.max_new_tokens)
+        # fault site: 'fail' here reads as deterministic allocator
+        # exhaustion — the admission is refused exactly as if the pool
+        # had no free blocks, driving the class-preemption path (it
+        # does NOT unwind to the consumer)
+        try:
+            _faults.check("engine.alloc")
+        except _faults.FaultInjected:
+            return False
         # The draft pool has no prefix sharing or eviction — the full
         # footprint must be free up front, checked before any main-pool
         # work so failure needs no rollback.
@@ -1167,6 +1308,13 @@ class InferenceEngine:
         s.remaining = req.max_new_tokens
         s.submit_ts = req.ts
         s.version = self._params_version
+        s.priority = req.priority
+        s.resumed = req.resumed
+        s.emitted = []
+        if req.resumed:
+            # blocks' worth of KV this resume recomputes (the radix
+            # match absorbed the rest for free)
+            self._reprefill_blocks += -(-(p - matched) // bs)
         s.history = req.prompt.tolist() if self.spec == "ngram" else []
         if self._draft_alloc is not None:
             dblocks = [self._draft_alloc.alloc() for _ in range(total)]
@@ -1179,17 +1327,139 @@ class InferenceEngine:
         self._recorder.on_admit(req.rid, matched, partial)
         return True
 
-    def _admit_pending(self) -> bool:
-        """Move pending requests into idle slots. A request whose first
-        block of tokens matches an in-flight prefill's is deferred one
-        tick — once that prefill completes and its full blocks enter
-        the radix tree, the latecomer admits by reference instead of
-        re-prefilling the shared prefix."""
-        if not self._pending:
+    def _admission_order(self) -> list[_Pending]:
+        """Class-aware admission order over the pending queue.
+
+        Two mechanisms compose (ROADMAP item 4's multi-tenant
+        admission): **weighted shares** — a stride scheduler across
+        backlogged classes with weight ``priority_weight_base**class``,
+        so class c+1 gets base x class c's admission share while every
+        backlogged class keeps a guaranteed nonzero share — and
+        **aging** — a request older than
+        ``(priority_classes - class) * priority_aging_s`` escalates
+        past the stride order entirely (oldest first), which bounds the
+        worst-case wait of the lowest class under sustained high-class
+        load. Within one class, order is FIFO."""
+        now = time.perf_counter()
+        aged: list[_Pending] = []
+        backlog: dict[int, collections.deque] = {}
+        for req in self._pending:
+            bound = (self.priority_classes - req.priority) \
+                * self.priority_aging_s
+            if now - req.ts > bound:
+                if not req.aged:
+                    req.aged = True
+                    self._aging_promotions += 1
+                aged.append(req)
+            else:
+                backlog.setdefault(
+                    req.priority, collections.deque()).append(req)
+        aged.sort(key=lambda r: (r.ts, r.rid))
+        order = aged
+        # A class entering the backlog starts at the current pass floor
+        # so it can't claim banked credit for the time it was idle.
+        floor = max(self._class_pass.values(), default=0.0)
+        for c in backlog:
+            self._class_pass.setdefault(c, floor)
+        sim = dict(self._class_pass)
+        while backlog:
+            c = min(backlog, key=lambda k: (sim[k], -k))
+            order.append(backlog[c].popleft())
+            sim[c] += 1.0 / (self.priority_weight_base ** c)
+            if not backlog[c]:
+                del backlog[c]
+        return order
+
+    def _pick_victim(self, below: int) -> int | None:
+        """Preemption victim: the active slot of the lowest class
+        strictly below `below`; ties broken by most recent admission
+        (least progress = cheapest re-prefill)."""
+        best = None
+        for i, s in enumerate(self._slots):
+            if not s.active or s.priority >= below:
+                continue
+            if best is None or \
+                    (s.priority, -s.order) < (self._slots[best].priority,
+                                              -self._slots[best].order):
+                best = i
+        return best
+
+    def _preempt(self, slot_idx: int, why: str) -> None:
+        """Evict one active stream under block pressure: publish its
+        written blocks to the radix tree (resume admits them by
+        reference — mostly free), release the slot, and requeue
+        prompt+emitted as a re-prefill under the SAME rid and output
+        queue. The consumer keeps iterating `tokens_for` unaware; a
+        greedy stream resumes token-identical because the re-prefilled
+        KV is bit-identical to the KV released (prefill and decode
+        share the paged attention math)."""
+        s = self._slots[slot_idx]
+        seq = [int(t) for t in s.prompt.tolist()] \
+            + [int(t) for t in s.emitted]
+        # KV written so far covers seq[:pos] in decode (the parked
+        # last token is sampled but never written), prompt[:filled]
+        # mid-prefill.
+        written = s.pos if s.phase == "decode" else s.filled
+        if self._tree is not None and written >= self.block_size \
+                and s.version == self._params_version:
+            self._tree.insert(seq[:written], s.blocks)
+        resume = _Pending(
+            s.rid,
+            np.concatenate([
+                s.prompt.astype(np.int32, copy=False),
+                np.fromiter((int(t) for t in s.emitted), np.int32,
+                            len(s.emitted))]),
+            s.remaining, s.temperature, s.eos_id, s.submit_ts,
+            priority=s.priority, resumed=True)
+        self._preemptions += 1
+        self._class_counter(s.priority)["preemptions"] += 1
+        logger.info(
+            "engine %s: preempted rid=%d class=%d (%s) after %d tokens",
+            getattr(self, "name", "?"), s.rid, s.priority, why,
+            len(s.emitted))
+        self._recorder.on_finish(s.rid, f"preempted:{why}")
+        self._release(slot_idx)
+        self._pending.appendleft(resume)
+
+    def _force_preempt(self) -> bool:
+        """Fault-injected preemption (site ``engine.preempt``): evict
+        the lowest-class active stream regardless of pressure."""
+        victim = self._pick_victim(self.priority_classes)
+        if victim is None:
             return False
-        free = [i for i, s in enumerate(self._slots)
-                if s.phase == "idle"]
-        if not free:
+        self._preempt(victim, "forced")
+        return True
+
+    def _admit_or_preempt(self, req: _Pending) -> bool:
+        """Admit one request, preempting strictly-lower-class active
+        streams while the block pool can't serve it. Slot exhaustion
+        defers instead of preempting: the stride order already decided
+        who deserves the slots, and letting a later entry evict this
+        pass's winners would undo the weighted shares (observed as
+        full class-1 drain before any class-0 admission). Bounded:
+        every retry removes one active victim."""
+        free = next((i for i, s in enumerate(self._slots)
+                     if s.phase == "idle"), None)
+        if free is None:
+            return False
+        while not self._try_admit(free, req):
+            victim = self._pick_victim(req.priority)
+            if victim is None:
+                return False
+            self._preempt(victim, "block-pressure")
+        return True
+
+    def _admit_pending(self) -> bool:
+        """Move pending requests into slots, in class-aware order
+        (`_admission_order`). A request whose first block of tokens
+        matches an in-flight prefill's is deferred one tick — once that
+        prefill completes and its full blocks enter the radix tree, the
+        latecomer admits by reference instead of re-prefilling the
+        shared prefix. When a request fails admission even after
+        preemption, strictly LOWER classes are locked out for the rest
+        of the tick — freed blocks accrue to the blocked class instead
+        of leaking to small low-class requests forever."""
+        if not self._pending:
             return False
         bs = self.block_size
         heads = set()
@@ -1197,22 +1467,43 @@ class InferenceEngine:
             heads = {tuple(s.prompt[:bs].tolist())
                      for s in self._slots
                      if s.phase == "prefill" and s.prompt.size >= bs}
+        order = self._admission_order()
+        # Reset the live queue: preemptions during the loop appendleft
+        # their resumes here (re-admitted next tick); deferred requests
+        # are re-extended below.
+        self._pending = collections.deque()
         admitted, keep = False, []
-        for req in self._pending:
+        blocked_pri: int | None = None
+        for req in order:
             head = (tuple(req.prompt[:bs].tolist())
                     if req.prompt.size >= bs else None)
-            if not free or (head is not None and head in heads
-                            and self._tree is not None):
+            if head is not None and head in heads \
+                    and self._tree is not None:
                 keep.append(req)
                 continue
-            if self._try_admit(free[0], req):
-                free.pop(0)
+            if blocked_pri is not None and req.priority < blocked_pri:
+                keep.append(req)
+                continue
+            if self._admit_or_preempt(req):
                 admitted = True
+                self._class_pass[req.priority] = \
+                    self._class_pass.get(req.priority, 0.0) \
+                    + 1.0 / (self.priority_weight_base ** req.priority)
                 if head is not None:
                     heads.add(head)
             else:
                 keep.append(req)
-        self._pending = collections.deque(keep)
+                if blocked_pri is None or req.priority > blocked_pri:
+                    blocked_pri = req.priority
+        # keep is in admission order — per-class FIFO is preserved,
+        # which is the only order the scheduler depends on. Preempted
+        # resumes (appendleft during the loop) stay at the front.
+        self._pending.extend(keep)
+        # drop stride state for classes with no backlog left so a
+        # long-idle class can't bank credit
+        live = {q.priority for q in self._pending}
+        for c in [c for c in self._class_pass if c not in live]:
+            del self._class_pass[c]
         return admitted
 
     def _run_prefill_chunk(self, slot_idx: int):
@@ -1279,9 +1570,14 @@ class InferenceEngine:
         s.phase = "decode"
         s.pos = s.prompt.size
         s.remaining -= 1
-        wait = time.perf_counter() - s.submit_ts
-        self._queue_waits.append(wait)
-        self._recorder.on_first_token(s.rid, wait)
+        if not s.resumed:
+            # A resumed (preempted) stream delivered its first token
+            # long ago — re-counting its original submit_ts here would
+            # poison the TTFT/queue-wait percentiles.
+            wait = time.perf_counter() - s.submit_ts
+            self._queue_waits.append(wait)
+            self._class_waits[s.priority].append(wait)
+            self._recorder.on_first_token(s.rid, wait)
         self._emit(s, slot_idx, s.token, s.token_logp, s.token_ver)
 
     def _prefill_tick(self, had_decoders: bool) -> bool:
@@ -1319,6 +1615,9 @@ class InferenceEngine:
             self._swap_pending_ts = None
             self._recorder.on_swap_crossing(s.rid)
         self._out[s.rid].append(ev)
+        s.emitted.append(int(tok))
+        cc = self._class_counter(s.priority)
+        cc["decode_tokens"] += 1
         self._recorder.on_token(s.rid)
         if self.spec == "ngram":
             s.history.append(tok)
@@ -1326,6 +1625,7 @@ class InferenceEngine:
         # pos of the *next* token; it must still fit in the cache row.
         if s.remaining <= 0 or hit_eos or s.pos + 1 >= self.max_len:
             self._done.add(s.rid)
+            cc["completed"] += 1
             self._release(slot_idx)
             self._recorder.on_finish(s.rid, "finished")
 
@@ -1345,6 +1645,13 @@ class InferenceEngine:
                 # pumping consumer; 'delay' wedges the tick (what the
                 # watchdog exists to catch)
                 _faults.check("engine.tick")
+                # fault site: 'fail' forces preemption of the lowest-
+                # class active stream this tick (absorbed — consumers
+                # see only the token-identical resume)
+                try:
+                    _faults.check("engine.preempt")
+                except _faults.FaultInjected:
+                    self._force_preempt()
                 had_decoders = any(
                     s.phase == "decode" for s in self._slots)
                 admitted = self._admit_pending()
@@ -1573,6 +1880,34 @@ class InferenceEngine:
                 assert self._draft_alloc.refcount(b) == dholds[b], \
                     f"draft block {b}: refcount " \
                     f"{self._draft_alloc.refcount(b)} != {dholds[b]}"
+        # Preempted-stream state: after any preempt→resume→cancel
+        # interleaving, a request must live in exactly one place and
+        # every output queue must still be owned by someone — a leaked
+        # `_out` deque (or an errored rid still scheduled) would pin
+        # consumer state forever.
+        pend_rids = [q.rid for q in self._pending]
+        assert len(pend_rids) == len(set(pend_rids)), \
+            f"duplicate pending rids: {pend_rids}"
+        slot_rids = [s.rid for s in self._slots if s.active]
+        assert len(slot_rids) == len(set(slot_rids)), \
+            f"duplicate slot rids: {slot_rids}"
+        assert not set(pend_rids) & set(slot_rids), \
+            "rid both pending and active"
+        for rid in pend_rids + slot_rids:
+            assert rid in self._out, f"rid {rid} has no output queue"
+            assert rid not in self._done, f"rid {rid} done but scheduled"
+        for rid in self._errors:
+            assert rid in self._out, f"errored rid {rid} has no queue"
+            assert rid not in set(pend_rids) | set(slot_rids), \
+                f"errored rid {rid} still scheduled"
+        owners = set(pend_rids) | set(slot_rids) | self._done \
+            | set(self._errors)
+        for rid in self._out:
+            assert rid in owners, f"orphaned output queue for rid {rid}"
+        for q in self._pending:
+            assert 0 <= q.priority < self.priority_classes
+            assert q.max_new_tokens >= 1, \
+                f"rid {q.rid} requeued with no token budget"
 
     def reset_stats(self):
         """Zero the throughput/latency accounting — benches call this
@@ -1603,6 +1938,17 @@ class InferenceEngine:
             self._last_swap_ms = 0.0
             self._sheds = 0
             self._watchdog_stalls = 0
+            self._preemptions = 0
+            self._reprefill_blocks = 0
+            self._aging_promotions = 0
+            # Zero per-class counters in place and clear wait windows —
+            # the dicts themselves must survive (admitted slots index
+            # into `_class_waits` by class on prefill completion).
+            for cc in self._per_class.values():
+                for k in cc:
+                    cc[k] = 0
+            for w in self._class_waits.values():
+                w.clear()
 
     def stats(self) -> dict:
         """The engine's one stats contract — this dict feeds the serve
@@ -1691,9 +2037,48 @@ class InferenceEngine:
           ``watchdog_stalls`` — scheduler ticks the watchdog thread saw
           overrun the `watchdog_s` budget (always present; 0 with the
           watchdog disabled). Each stall also logs one WARN.
+
+        Priority / preemption (multi-tenant plane):
+          ``priority_classes`` — number of configured classes (identity,
+          not rate; class c+1 outranks class c).
+          ``preemptions`` — active streams evicted mid-flight for a
+          higher class (or a forced fault site) since reset; each one
+          requeues as a chunked re-prefill and resumes token-identical.
+          ``reprefill_blocks`` — KV blocks re-filled on resume that the
+          radix cache did NOT cover (the true cost of preemption; 0
+          when the preempt-time tree insert survives to re-admission).
+          ``aging_promotions`` — starvation-guard escalations: requests
+          whose queue wait exceeded the per-class aging bound and were
+          admitted ahead of stride order.
+          ``per_class`` — dict keyed by class id (str) with per-class
+          ``submitted`` / ``completed`` / ``sheds`` / ``preemptions`` /
+          ``decode_tokens`` counters plus ``pending`` / ``active``
+          occupancy and ``queue_wait_ms_p50`` / ``queue_wait_ms_p99``
+          over a 256-request window — the fairness/usage series the
+          telemetry bridge fans out as class-tagged gauges.
         """
         with self._lock:
             self._sentinel.check()   # surface retraces since last tick
+            per_class = {}
+            pend_by = collections.Counter(q.priority for q in self._pending)
+            act_by = collections.Counter(
+                s.priority for s in self._slots if s.active)
+            for c in sorted(set(self._per_class) | set(pend_by)
+                            | set(act_by)):
+                cw = sorted(self._class_waits.get(c, ()))
+
+                def cpct(p, _cw=cw):
+                    if not _cw:
+                        return 0.0
+                    return _cw[min(len(_cw) - 1,
+                                   int(p / 100 * len(_cw)))] * 1e3
+                per_class[str(c)] = {
+                    **{k: v for k, v in self._per_class.get(c, {}).items()},
+                    "pending": pend_by.get(c, 0),
+                    "active": act_by.get(c, 0),
+                    "queue_wait_ms_p50": cpct(50),
+                    "queue_wait_ms_p99": cpct(99),
+                }
             times = sorted(self._step_times)
             occ = list(self._occupancy)
             util = list(self._block_util)
@@ -1777,6 +2162,12 @@ class InferenceEngine:
                 # fault tolerance
                 "sheds": self._sheds,
                 "watchdog_stalls": self._watchdog_stalls,
+                # priority / preemption
+                "priority_classes": self.priority_classes,
+                "preemptions": self._preemptions,
+                "reprefill_blocks": self._reprefill_blocks,
+                "aging_promotions": self._aging_promotions,
+                "per_class": per_class,
             }
 
 
@@ -1815,9 +2206,17 @@ class InferenceReplica:
             params, cfg, slots=slots, max_len=max_len, **ek)
 
     def __call__(self, prompt, max_new_tokens: int = 8,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0, priority: int | None = None):
+        # Explicit kwarg wins; otherwise pick up the class the serve
+        # path stamped on this request's context (handle/proxy), so
+        # priority rides `handle.stream(prompt)` with no signature
+        # changes at every hop.
+        if priority is None:
+            from ray_tpu.serve import priority as _prio
+            priority = _prio.get_request_priority()
         rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
-                                 temperature=temperature)
+                                 temperature=temperature,
+                                 priority=priority)
         return self.engine.tokens_for(rid)
 
     def cancel(self, rid: int) -> bool:

@@ -115,10 +115,12 @@ class DeploymentHandle:
     _MAX_TRACKED = 64
 
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 multiplexed_model_id: str = "", _router=None):
+                 multiplexed_model_id: str = "", priority: int | None = None,
+                 _router=None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._model_id = multiplexed_model_id
+        self._priority = priority
         self._router = _router or _RouterState()
 
     # delegate routing state to the SHARED router object
@@ -161,18 +163,22 @@ class DeploymentHandle:
     # handles must survive pickling into replicas/proxies (composition)
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self._model_id))
+                (self.deployment_name, self.app_name, self._model_id,
+                 self._priority))
 
-    def options(self, *, multiplexed_model_id: str | None = None
-                ) -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: str | None = None,
+                priority: int | None = None) -> "DeploymentHandle":
         """Per-call options (reference: handle.options(
         multiplexed_model_id=...) routes to the replica already serving
-        that model, serve/multiplex.py). The view SHARES the parent's
-        router state (replica cache + load counters)."""
+        that model, serve/multiplex.py; `priority=` stamps a priority
+        class on every call made through the view — see
+        serve/priority.py). The view SHARES the parent's router state
+        (replica cache + load counters)."""
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._model_id,
+            priority if priority is not None else self._priority,
             _router=self._router)
 
     def _controller(self):
@@ -291,6 +297,8 @@ class DeploymentHandle:
         if self._model_id:
             kwargs = {**kwargs,
                       "__multiplexed_model_id__": self._model_id}
+        if self._priority is not None:
+            kwargs = {**kwargs, "__serve_priority__": self._priority}
         from ray_tpu.util import tracing as _tracing
         with _tracing.span("handle.call",
                            {"deployment": self.deployment_name,
@@ -456,6 +464,9 @@ class _MethodCaller:
         if self._handle._model_id:
             kwargs = {**kwargs,
                       "__multiplexed_model_id__": self._handle._model_id}
+        if self._handle._priority is not None:
+            kwargs = {**kwargs,
+                      "__serve_priority__": self._handle._priority}
         ref = replica.handle_method.remote(self._method, args, kwargs)
         self._handle._record(replica._actor_id, ref)
         return ref

@@ -143,6 +143,20 @@ class HTTPProxy:
         if key not in self._handles:
             self._handles[key] = DeploymentHandle(dep, app_name)
         handle = self._handles[key]
+        # Priority class rides HTTP as `X-Serve-Priority: <int>` (or a
+        # `?priority=<int>` query param; the header wins). The proxy only
+        # validates the int here — range checks belong to the engine,
+        # which knows its own class count.
+        raw_pri = request.headers.get(
+            "X-Serve-Priority", request.query.get("priority"))
+        if raw_pri is not None:
+            try:
+                handle = handle.options(priority=int(raw_pri))
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "bad_priority",
+                     "detail": f"priority must be an int, got {raw_pri!r}"},
+                    status=400)
         body = await request.read()
         req = Request(
             method=request.method,

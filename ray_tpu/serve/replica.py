@@ -41,6 +41,10 @@ class Replica:
         """serialized_init: {"callable": cls_or_fn, "init_args": tuple,
         "init_kwargs": dict, "deployment_name": str}"""
         self.deployment_name = serialized_init["deployment_name"]
+        # Priority class stamped on requests that carry none of their
+        # own (@serve.deployment(default_priority=...)).
+        self._default_priority = int(
+            serialized_init.get("default_priority", 0))
         target = serialized_init["callable"]
         args = serialized_init.get("init_args", ())
         kwargs = serialized_init.get("init_kwargs", {})
@@ -150,6 +154,10 @@ class Replica:
     def _pop_model_id(kwargs: dict) -> str:
         return kwargs.pop("__multiplexed_model_id__", "")
 
+    def _pop_priority(self, kwargs: dict) -> int:
+        return int(kwargs.pop("__serve_priority__",
+                              self._default_priority))
+
     async def _invoke(self, target, args, kwargs):
         """Run the user callable without stalling the replica: coroutine
         functions are awaited on the replica's event loop; sync callables
@@ -175,8 +183,10 @@ class Replica:
         (reference: `serve/_private/replica.py:429` — the replica IS an
         asyncio actor; thousands of slow requests overlap on awaits)."""
         from ray_tpu.serve.multiplex import _set_model_id
+        from ray_tpu.serve.priority import _set_priority
         kwargs = dict(kwargs)
         _set_model_id(self._pop_model_id(kwargs))
+        _set_priority(self._pop_priority(kwargs))
         self._enter()
         try:
             target = (self.callable if self._is_function
@@ -189,8 +199,10 @@ class Replica:
     async def handle_method(self, method: str, args: tuple, kwargs: dict):
         """handle.method.remote path (model composition)."""
         from ray_tpu.serve.multiplex import _set_model_id
+        from ray_tpu.serve.priority import _set_priority
         kwargs = dict(kwargs)
         _set_model_id(self._pop_model_id(kwargs))
+        _set_priority(self._pop_priority(kwargs))
         self._enter()
         try:
             return self._maybe_stream(await self._invoke(

@@ -45,6 +45,15 @@ HOT_SCOPES: dict[str, frozenset[str]] = {
         "InferenceEngine._decode_tick",
         "InferenceEngine._spec_tick",
         "InferenceEngine._emit",
+        # priority/preemption plane — all run inside the scheduler tick
+        # under engine.scheduler (the admission queue shares self._lock;
+        # no new lock, so no new LOCK_ORDER edges)
+        "InferenceEngine._admission_order",
+        "InferenceEngine._pick_victim",
+        "InferenceEngine._preempt",
+        "InferenceEngine._force_preempt",
+        "InferenceEngine._admit_or_preempt",
+        "InferenceEngine._shed_lowest_below",
     }),
     LOOP: frozenset({
         "TrainLoop.run",

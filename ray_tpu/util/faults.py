@@ -7,8 +7,16 @@ declarative, picklable `FaultPlan` — a list of specs keyed by *site*
 strings that production code consults at its fault points via
 `check(site)`:
 
-  * ``engine.tick``   — top of `InferenceEngine.step` (fail/delay)
+  * ``engine.tick``   — top of `InferenceEngine.step` (fail/delay;
+    a `delay` spec here IS the "tick stall" chaos site — the watchdog
+    and per-token latency series see it)
   * ``engine.emit``   — per emitted token (kill = die at step N)
+  * ``engine.alloc``  — per admission attempt inside the scheduler
+    (fail = simulated allocator exhaustion: the admit is refused as if
+    the block pool had no room, driving the preemption path for
+    higher-class requests exactly where real block pressure would)
+  * ``engine.preempt`` — per scheduler tick (fail = force-preempt the
+    lowest-class active stream this tick, real pressure or not)
   * ``replica.health_ping``    — `Replica.check_health`
   * ``controller.health_ping`` — controller health fan-out
   * ``netaddr.send`` / ``netaddr.recv`` — control-channel messages

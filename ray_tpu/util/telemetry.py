@@ -98,7 +98,8 @@ _MS_BOUNDARIES = [0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000]
 _metric_cache: dict[tuple[type, str], "_metrics.Metric"] = {}
 
 
-def _metric(cls, name: str, desc: str = "", boundaries=None):
+def _metric(cls, name: str, desc: str = "", boundaries=None,
+            tag_keys=("source",)):
     """Lazily create/reuse one tagged metric; returns None when the name
     is already registered as a conflicting type (the scrape must not
     break because two subsystems picked one name)."""
@@ -110,9 +111,9 @@ def _metric(cls, name: str, desc: str = "", boundaries=None):
         try:
             if cls is _metrics.Histogram:
                 m = cls(name, desc, boundaries=boundaries,
-                        tag_keys=("source",))
+                        tag_keys=tag_keys)
             else:
-                m = cls(name, desc, tag_keys=("source",))
+                m = cls(name, desc, tag_keys=tag_keys)
         except (ValueError, TypeError):
             return None
         _metric_cache[key] = m
@@ -470,10 +471,14 @@ COUNTER_KEYS = frozenset({
     "breaker_trips", "replicas_restarted", "health_check_failures",
     # task-event recorder (stage-attribution observations)
     "stage_samples",
+    # priority/preemption plane (engine + per_class sub-dicts)
+    "preemptions", "reprefill_blocks", "aging_promotions",
+    "submitted", "completed",
 })
 
 _sources: dict[str, tuple] = {}          # name -> (weakref, kind)
-_last_counts: dict[tuple[str, str], float] = {}
+# (name, metric) or (name, metric, class_tag) -> last published count
+_last_counts: dict[tuple, float] = {}
 _hook_installed = False
 
 
@@ -534,26 +539,56 @@ def _publish_stats(kind: str, name: str, stats: dict) -> None:
     for key, val in stats.items():
         if isinstance(val, bool) or isinstance(val, str):
             continue
+        if isinstance(val, dict):
+            # One level of nesting fans out as tagged series: a stats key
+            # like ``per_class: {"0": {"sheds": 2, ...}, ...}`` becomes
+            # `<kind>_<key>_<metric>{source=..., class="0"}` — the
+            # fairness/usage-by-class view without N distinct sources.
+            for tag, sub in val.items():
+                if not isinstance(sub, dict):
+                    continue
+                for skey, sval in sub.items():
+                    if isinstance(sval, (bool, str)):
+                        continue
+                    try:
+                        num = float(sval)
+                    except (TypeError, ValueError):
+                        continue
+                    _publish_one(name, f"{kind}_{key}_{skey}", skey, num,
+                                 {"source": name, "class": str(tag)},
+                                 (name, f"{kind}_{key}_{skey}", str(tag)))
+            continue
         try:
             num = float(val)
         except (TypeError, ValueError):
             continue
         mname = f"{kind}_{key}"
-        if key in COUNTER_KEYS:
-            c = _metric(_metrics.Counter, mname)
-            if c is None:
-                continue
-            ckey = (name, mname)
-            last = _last_counts.get(ckey, 0.0)
-            if num < last:          # stats reset upstream
-                last = 0.0
-            if num > last:
-                c.inc(num - last, tags={"source": name})
-            _last_counts[ckey] = num
-        else:
-            g = _metric(_metrics.Gauge, mname)
-            if g is not None:
-                g.set(num, tags={"source": name})
+        _publish_one(name, mname, key, num, {"source": name},
+                     (name, mname))
+
+
+def _publish_one(name: str, mname: str, key: str, num: float,
+                 tags: dict, ckey: tuple) -> None:
+    """Publish one numeric sample: delta-tracked Counter when `key` is in
+    COUNTER_KEYS, Gauge otherwise. `ckey` keys the delta state (2-tuple
+    for flat stats, 3-tuple with the class tag for nested ones); the
+    metric's tag_keys come from `tags` so class-tagged series declare
+    both labels."""
+    tag_keys = tuple(tags)
+    if key in COUNTER_KEYS:
+        c = _metric(_metrics.Counter, mname, tag_keys=tag_keys)
+        if c is None:
+            return
+        last = _last_counts.get(ckey, 0.0)
+        if num < last:          # stats reset upstream
+            last = 0.0
+        if num > last:
+            c.inc(num - last, tags=tags)
+        _last_counts[ckey] = num
+    else:
+        g = _metric(_metrics.Gauge, mname, tag_keys=tag_keys)
+        if g is not None:
+            g.set(num, tags=tags)
 
 
 # ---------------------------------------------------------------------------

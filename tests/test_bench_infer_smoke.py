@@ -68,6 +68,11 @@ def test_bench_infer_cpu_smoke(capsys, monkeypatch):
     assert rec["capacity_streams_per_gb"] > 0
     assert rec["capacity_vs_f32"] == 2.0
     assert rec["quality_logprob_delta"] == 0.0
+    # priority-mix off: fields present but neutral
+    assert rec["priority_mix"] == ""
+    assert rec["preemptions"] == 0
+    assert rec["reprefill_blocks"] == 0
+    assert rec["queue_wait_ms_p99_by_class"] == {}
 
 
 def test_bench_infer_quantized_smoke(capsys, monkeypatch):
@@ -152,6 +157,34 @@ def test_bench_infer_spec_big(capsys, monkeypatch):
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["tokens_per_step"] > 1.0
     assert rec["spec_decode_tok_s"] > 0.0
+
+
+def test_bench_infer_priority_mix_smoke(capsys, monkeypatch):
+    """PRIORITY_MIX with a pool sized below the mix's footprint: the
+    high-class wave must preempt at least one low-class stream (real
+    block pressure, deterministically provoked), and the JSON carries
+    the per-class p99 queue-wait contract. Geometry: block 4, prompt 8,
+    new 6 => 4 blocks per request; CACHE_BLOCKS=7 leaves 6 usable
+    (block 0 is the trash block), so two streams can't coexist."""
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_PRIORITY_MIX", "2,0,1")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_CACHE_BLOCKS", "7")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_BLOCK", "4")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_PROMPT", "8")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_NEW", "6")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_MAX_LEN", "32")
+    monkeypatch.setenv("RAY_TPU_INFER_BENCH_REQUESTS", "3")
+    import bench_infer
+
+    bench_infer.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["priority_mix"] == "2,0,1"
+    assert rec["preemptions"] >= 1, rec
+    assert rec["reprefill_blocks"] >= 1, rec
+    waits = rec["queue_wait_ms_p99_by_class"]
+    assert set(waits) == {"0", "2"} and all(
+        np.isfinite(v) and v >= 0 for v in waits.values()), rec
+    # the baseline headline is untouched by the priority engine's run
+    assert rec["value"] == rec["decode_tokens_per_sec"] > 0
 
 
 def test_bench_infer_shared_prefix_knobs(capsys, monkeypatch):

@@ -196,6 +196,7 @@ def cartpole_expert_shards(tmp_path_factory):
     return path
 
 
+@pytest.mark.slow
 def test_bc_learns_from_expert(cartpole_expert_shards):
     """BC on decent CartPole data should act like the data policy."""
     from ray_tpu.rllib.algorithms.marwil import BCConfig
@@ -222,6 +223,7 @@ def test_bc_learns_from_expert(cartpole_expert_shards):
     assert total / 5 > 50, total / 5
 
 
+@pytest.mark.slow
 def test_marwil_beta_weights_run(cartpole_expert_shards):
     from ray_tpu.rllib.algorithms.marwil import MARWILConfig
     algo = (MARWILConfig().environment("CartPole-v1")

@@ -4,12 +4,17 @@ Two claims, tested separately: the WORLD MODEL learns (reconstruction
 loss collapses — the RSSM actually models CartPole dynamics), and the
 IMAGINATION-trained policy improves the real-environment return well
 beyond the random baseline. Time-bounded thresholds: from ~22 (random)
-the measured curve passes 60 around iteration 30-40 on this box."""
+the measured curve passes 60 around iteration 30-40 on this box. The
+full learning regression is `slow` (tier-1 budget); the tier-1 smoke
+pins the train-step contract and a checkpoint roundtrip in a few
+iterations.
+"""
 
 import numpy as np
+import pytest
 
 
-def test_dreamerv3_world_model_and_policy_learn():
+def _build(**overrides):
     from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3Config
 
     cfg = DreamerV3Config().environment(
@@ -19,7 +24,29 @@ def test_dreamerv3_world_model_and_policy_learn():
     cfg.n_updates_per_iter = 10
     cfg.learning_starts = 16
     cfg.entropy_coeff = 1e-2
-    algo = cfg.build()
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg.build()
+
+
+def test_dreamerv3_smoke():
+    """Tier-1: the world-model + actor-critic step runs end to end with
+    finite losses, and get_state/set_state roundtrips — no learning
+    threshold (that's the slow regression)."""
+    algo = _build(n_updates_per_iter=2)
+    r = None
+    for _ in range(3):
+        r = algo.train()
+    assert np.isfinite(r["world_model_loss"])
+    assert np.isfinite(r["recon_loss"])
+    assert np.isfinite(r["episode_reward_mean"])
+    st = algo.get_state()
+    algo.set_state(st)
+
+
+@pytest.mark.slow
+def test_dreamerv3_world_model_and_policy_learn():
+    algo = _build()
 
     first_recon, best = None, 0.0
     for i in range(40):

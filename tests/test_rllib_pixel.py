@@ -149,16 +149,19 @@ def _run_yaml(substr: str) -> dict:
     return run_tuned_example(path[0], verbose=False)
 
 
+@pytest.mark.slow
 def test_pixel_breakout_ppo_regression():
     out = _run_yaml("pixel-breakout-ppo")
     assert out["passed"], out
 
 
+@pytest.mark.slow
 def test_pixel_breakout_impala_regression(ray_session):
     out = _run_yaml("pixel-breakout-impala")
     assert out["passed"], out
 
 
+@pytest.mark.slow
 def test_pixel_invaders_apex_regression(ray_session):
     out = _run_yaml("pixel-invaders-apex")
     assert out["passed"], out
@@ -171,6 +174,7 @@ def test_pixel_invaders_apex_regression(ray_session):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_pixel_ppo_on_8_device_mesh():
     """The pixel-breakout PPO config shard_maps its WHOLE fused
     iteration (rollout + GAE + minibatch SGD) over a data-axis mesh:

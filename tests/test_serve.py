@@ -96,6 +96,39 @@ def test_http_proxy(serve_session):
         assert e.code == 404
 
 
+def test_priority_rides_the_serve_path(serve_session):
+    """A request's priority class travels handle -> replica contextvar
+    (and proxy header -> handle.options), with the deployment's
+    `default_priority` as the fallback — the serve-side plumbing of the
+    engine's priority classes."""
+    @serve.deployment(default_priority=1)
+    class WhatClass:
+        def __call__(self, req):
+            return serve.get_request_priority()
+
+    h = serve.run(WhatClass.bind(), name="t_prio")
+    assert h.call(0) == 1                       # deployment default
+    assert h.options(priority=3).call(0) == 3   # per-call override
+    assert h.call(0) == 1                       # options() didn't stick
+
+    proxy = serve.start(http_options={"port": 0})
+    info = ray_tpu.get(proxy.ready.remote(), timeout=30)
+    serve.set_route("/prio", "WhatClass", "t_prio")
+    base = f"http://127.0.0.1:{info['port']}/prio"
+    req = urllib.request.Request(base, data=b"{}")
+    req.add_header("X-Serve-Priority", "2")
+    assert json.loads(urllib.request.urlopen(req).read()) == 2
+    assert json.loads(urllib.request.urlopen(
+        urllib.request.Request(f"{base}?priority=4",
+                               data=b"{}")).read()) == 4
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            base, data=b"{}", headers={"X-Serve-Priority": "nope"}))
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
 def test_batching(serve_session):
     @serve.deployment(max_concurrent_queries=16)
     class Batched:

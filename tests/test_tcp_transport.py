@@ -199,9 +199,11 @@ def _run_matrix(path: str, timeout: int):
         f"stderr:\n{r.stderr[-4000:]}"
 
 
+@pytest.mark.slow
 def test_multi_node_matrix_over_tcp():
     _run_matrix("tests/test_multi_node.py", timeout=1500)
 
 
+@pytest.mark.slow
 def test_chaos_matrix_over_tcp():
     _run_matrix("tests/test_chaos.py", timeout=1500)

@@ -196,6 +196,7 @@ def _torch_ddp_loop(config):
         {"weights": params.numpy().copy()}))
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_gloo(ray_session, tmp_path):
     from ray_tpu.train import TorchTrainer
 
@@ -283,6 +284,7 @@ def _tf_mwms_loop(config):
     }, checkpoint=Checkpoint.from_dict({"v": out.copy()}))
 
 
+@pytest.mark.slow
 def test_tensorflow_trainer_mwms(ray_session, tmp_path):
     from ray_tpu.train import TensorflowTrainer
 
