@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint test test-fast trace-smoke scale-smoke quant-smoke
+.PHONY: lint test test-fast trace-smoke scale-smoke quant-smoke disagg-smoke
 
 # Static invariant checks (R001-R005): exits non-zero on any
 # non-waived finding. tests/test_graftlint.py::test_repo_is_clean runs
@@ -29,6 +29,13 @@ quant-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
 		tests/test_spec_decode.py tests/test_bench_infer_smoke.py \
 		-q -m 'not slow' -k 'quant or Quant or FusedPrefill'
+
+# Disaggregated prefill/decode smoke: token identity vs colocated
+# across spec backends + int8, KV-block streaming over netaddr with
+# transfer stats, cancel/failover block accounting, SLO admission,
+# and streams-driven decode-pool autoscaling.
+disagg-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_disagg.py -q
 
 # Trimmed scale_bench parity run: channel batching + pipelined
 # submission ON vs OFF must produce bit-identical task results and

@@ -44,6 +44,20 @@ extras ride alongside:
                            cache did not cover
   queue_wait_ms_p99_by_class  per-class p99 submit-to-first-token (ms),
                            keyed by class id ({} when the mix is unset)
+  disagg_decode_tpot_ms_p99 / colocated_decode_tpot_ms_p99
+                           client-observed inter-token gap p99 for the
+                           decode streams of the disagg A/B phase,
+                           role-split vs colocated — the disagg
+                           headline: the role-split number stays flat
+                           under long-prefill interference while the
+                           colocated one absorbs whole prefill chunks
+                           between decode ticks
+  disagg_ttft_ms_p99 / colocated_ttft_ms_p99
+                           submit-to-first-token p99 for those streams
+                           (the disagg side includes the KV handoff)
+  kv_transfer_gbps         KV-block handoff bandwidth, export blob to
+                           imported pool blocks (GB/s, import wall)
+  kv_blocks_streamed       paged KV blocks shipped prefill -> decode
   kv_dtype / weight_dtype  the quantization knobs this run used
   pool_bytes               device bytes of the preallocated KV block
                            pool(s), scale arrays included
@@ -105,6 +119,17 @@ Knobs (env vars, platform-tuned defaults in main()):
                                      phase (0 = engine default); size it
                                      below the mix's total footprint to
                                      force block-pressure preemption
+  RAY_TPU_INFER_BENCH_DISAGG         1 (default) = run the disaggregated
+                                     prefill/decode A/B: the same mixed
+                                     workload (decode streams + long-
+                                     prefill interference) through equal
+                                     engine counts colocated vs role-
+                                     split, reporting client-observed
+                                     decode TPOT/TTFT p99 per mode plus
+                                     kv_transfer_gbps for the KV-block
+                                     handoffs; 0 = skip (zeros in JSON)
+  RAY_TPU_INFER_BENCH_PREFILL_REPLICAS  prefill-role engines in the A/B
+  RAY_TPU_INFER_BENCH_DECODE_REPLICAS   decode-role engines in the A/B
 
 Baseline: single-token decode is HBM-bandwidth-bound — every step
 streams the full parameter set plus the live KV prefix through the chip
@@ -355,6 +380,156 @@ def main():
             for c, pc in ps["per_class"].items()}
         peng.check_invariants()
 
+    # --- disaggregated prefill/decode A/B ------------------------------
+    # Same mixed workload (decode streams + long-prefill interference)
+    # through the same total engine count, split two ways. Colocated:
+    # every engine takes both kinds of traffic, so each long prompt's
+    # chunked prefill runs BETWEEN that engine's decode ticks and
+    # stretches its streams' inter-token gaps. Disagg: prefill-role
+    # engines absorb the long prompts and hand finished KV blocks to
+    # decode-role engines, whose ticks stay pure decode. TPOT is
+    # measured CLIENT-SIDE (inter-token arrival gaps at the consumer) —
+    # the engine's own p99_token_latency_ms only times the decode device
+    # call and cannot see prefill chunks sitting between ticks.
+    disagg = _env_int("RAY_TPU_INFER_BENCH_DISAGG", 1)
+    pre_n = _env_int("RAY_TPU_INFER_BENCH_PREFILL_REPLICAS", 1)
+    dec_n = _env_int("RAY_TPU_INFER_BENCH_DECODE_REPLICAS", 1)
+    disagg_tpot_p99 = coloc_tpot_p99 = 0.0
+    disagg_ttft_p99 = coloc_ttft_p99 = 0.0
+    kv_transfer_gbps = 0.0
+    kv_blocks_streamed = 0
+    if disagg:
+        import threading
+
+        total_engines = pre_n + dec_n
+        n_streams = slots * dec_n
+        n_long = max(2, requests)
+        long_p = min(max_len - 2,
+                     max(prompt_len * 4, prompt_len + 2 * block_size))
+
+        def make_long():
+            return rng.integers(0, cfg.vocab_size, long_p) \
+                .astype(np.int32)
+
+        def new_engine(role=None):
+            ekw = {"role": role} if role else {}
+            return InferenceEngine(params, cfg, slots=slots,
+                                   max_len=max_len,
+                                   block_size=block_size,
+                                   prefill_chunk=chunk or None, **ekw)
+
+        def drain(e, rid, recs, t_submit):
+            ttft, gaps, last = None, [], t_submit
+            for _tok in e.tokens_for(rid):
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = (now - t_submit) * 1e3
+                else:
+                    gaps.append((now - last) * 1e3)
+                last = now
+            recs.append((ttft, gaps))
+
+        def _p99(xs):
+            return float(np.percentile(xs, 99)) if xs else 0.0
+
+        def collect(recs):
+            ttfts = [t for t, _ in recs if t is not None]
+            gaps = [g for _, gs in recs for g in gs]
+            return _p99(ttfts), _p99(gaps)
+
+        # -- colocated baseline ----------------------------------------
+        engines = [new_engine() for _ in range(total_engines)]
+        for e in engines:       # warm both prompt-shape buckets
+            e.generate(make_prompt(), max_new_tokens=2)
+            e.generate(make_long(), max_new_tokens=1)
+        stream_recs: list = []
+        sink: list = []
+        threads = []
+        for i in range(n_streams):
+            e = engines[i % total_engines]
+            t0 = time.perf_counter()
+            rid = e.submit(make_prompt(), max_new_tokens=new_tokens)
+            th = threading.Thread(target=drain,
+                                  args=(e, rid, stream_recs, t0),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        time.sleep(0.05)        # let the streams reach steady decode
+        for j in range(n_long):
+            e = engines[j % total_engines]
+            t0 = time.perf_counter()
+            rid = e.submit(make_long(), max_new_tokens=1)
+            th = threading.Thread(target=drain, args=(e, rid, sink, t0),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300)
+        coloc_ttft_p99, coloc_tpot_p99 = collect(stream_recs)
+
+        # -- disaggregated ---------------------------------------------
+        pres = [new_engine("prefill") for _ in range(pre_n)]
+        decs = [new_engine("decode") for _ in range(dec_n)]
+        for k, de in enumerate(decs):   # warm prefill + import + decode
+            pe = pres[k % pre_n]
+            for mk, mn in ((make_long, 1), (make_prompt, 2)):
+                blob = pe.handoff_for(
+                    pe.submit(mk(), max_new_tokens=mn))
+                list(de.tokens_for(de.import_handoff(blob)))
+        stream_recs, sink, threads = [], [], []
+        kv_bytes_streamed = 0
+        import_wall = 0.0
+        for i in range(n_streams):
+            pe, de = pres[i % pre_n], decs[i % dec_n]
+            t0 = time.perf_counter()
+            rid = pe.submit(make_prompt(), max_new_tokens=new_tokens)
+            blob = pe.handoff_for(rid)
+            ti = time.perf_counter()
+            drid = de.import_handoff(blob)
+            import_wall += time.perf_counter() - ti
+            kv_bytes_streamed += blob["kv_bytes"]
+            kv_blocks_streamed += blob["n_blocks"]
+            th = threading.Thread(target=drain,
+                                  args=(de, drid, stream_recs, t0),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        time.sleep(0.05)
+
+        _kv_mu = threading.Lock()
+
+        def long_disagg(pe, de, t0):
+            nonlocal kv_bytes_streamed, kv_blocks_streamed, import_wall
+            rid = pe.submit(make_long(), max_new_tokens=1)
+            blob = pe.handoff_for(rid)
+            ti = time.perf_counter()
+            drid = de.import_handoff(blob)
+            with _kv_mu:
+                import_wall += time.perf_counter() - ti
+                kv_bytes_streamed += blob["kv_bytes"]
+                kv_blocks_streamed += blob["n_blocks"]
+            drain(de, drid, sink, t0)
+
+        for j in range(n_long):
+            th = threading.Thread(
+                target=long_disagg,
+                args=(pres[j % pre_n], decs[j % dec_n],
+                      time.perf_counter()),
+                daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=300)
+        disagg_ttft_p99, disagg_tpot_p99 = collect(stream_recs)
+        kv_transfer_gbps = kv_bytes_streamed / max(import_wall,
+                                                   1e-9) / 1e9
+        for pe in pres:
+            assert pe.stats()["decode_steps"] == 0, \
+                "prefill engine decoded"
+            pe.check_invariants()
+        for de in decs:
+            de.check_invariants()
+
     spec_stats = None
     if spec:
         ekw = {"spec": spec, "spec_k": spec_k}
@@ -430,6 +605,16 @@ def main():
         "preemptions": preemptions,
         "reprefill_blocks": reprefill_blocks,
         "queue_wait_ms_p99_by_class": wait_p99_by_class,
+        # disaggregated prefill/decode A/B (zeros when DISAGG=0)
+        "disagg": int(bool(disagg)),
+        "disagg_prefill_replicas": pre_n if disagg else 0,
+        "disagg_decode_replicas": dec_n if disagg else 0,
+        "disagg_decode_tpot_ms_p99": round(disagg_tpot_p99, 3),
+        "colocated_decode_tpot_ms_p99": round(coloc_tpot_p99, 3),
+        "disagg_ttft_ms_p99": round(disagg_ttft_p99, 3),
+        "colocated_ttft_ms_p99": round(coloc_ttft_p99, 3),
+        "kv_transfer_gbps": round(kv_transfer_gbps, 4),
+        "kv_blocks_streamed": kv_blocks_streamed,
     }))
 
 

@@ -494,10 +494,23 @@ class WorkerRuntime:
 
     async def _run_task_async(self, push: protocol.PushTask):
         import asyncio
+        import contextlib
         import inspect as _inspect
         spec = push.spec
         loop = asyncio.get_running_loop()
-        async with self._async_sem:
+        # Control-plane exemption (reference: Ray's concurrency groups —
+        # actor classes route health/stats RPCs through a group that
+        # data-plane calls cannot saturate). A class may declare
+        # `_control_plane_methods`: those methods skip the
+        # max_concurrency semaphore, so a scrape or health ping is never
+        # queued behind a full window of long-blocking data calls.
+        # (Observed: serve replicas with max_concurrency streams all
+        # parked in next_chunks starved the controller's stats fan-out.)
+        gate = self._async_sem
+        if spec.method_name in getattr(type(self.actor_instance),
+                                       "_control_plane_methods", ()):
+            gate = contextlib.nullcontext()
+        async with gate:
             # each asyncio task has its own context, so the current-task
             # id — and the attached trace context — survive interleaving
             # (a thread-local cannot)

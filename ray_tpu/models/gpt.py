@@ -635,6 +635,34 @@ def copy_block(cache, src, dst):
     return out
 
 
+def gather_block(cache, idx):
+    """Read physical block `idx` out of every entry of the pool — the
+    device half of KV-block export for disaggregated prefill/decode
+    serving. Returns a dict of [L, block_size, H, Dh] payload rows (and
+    [L, block_size, H] scale rows for an int8 pool — iterating the
+    cache dict means scales always travel with their payload, exactly
+    like `copy_block`). `idx` may be a traced scalar, so one jit serves
+    every block a prefill engine ever exports; the cache is NOT donated
+    (the pool must survive the read)."""
+    return {name: jax.lax.dynamic_index_in_dim(
+                cache[name], idx, axis=1, keepdims=False)
+            for name in cache}
+
+
+def scatter_block(cache, block, idx):
+    """Write one exported block's rows (the dict `gather_block`
+    returned, re-hosted on the importing engine) onto physical block
+    `idx` of this pool — the device half of KV-block import. Payload
+    and scale entries land through the same index, so an int8 pool's
+    quantized rows re-install byte-identical and the decode engine's
+    attention dequantizes exactly what the prefill engine wrote. `idx`
+    may be a traced scalar; donate the cache at jit time so imports
+    update the pool in place."""
+    return {name: jax.lax.dynamic_update_slice_in_dim(
+                cache[name], block[name][:, None], idx, axis=1)
+            for name in cache}
+
+
 def _scatter_kv(lc, k, v, widx):
     """Write `k`/`v` [N, H, Dh] (activation dtype) into one layer's pool
     slice `lc` at flat indices ``widx [N]`` (out-of-bounds rows drop —

@@ -13,6 +13,7 @@ from ray_tpu.serve.api import (
     deployment,
     get_deployment_handle,
     run,
+    run_disagg,
     set_route,
     shutdown,
     start,
@@ -35,12 +36,16 @@ __all__ = [
     "apply_config",
     "build_app_from_config", "OverloadedError", "token_resume",
     "InferenceEngine", "InferenceReplica",
+    "run_disagg", "DisaggHandle", "PrefillReplica", "DecodeReplica",
 ]
 
 # The inference engine pulls in jax; most serve workers never touch it,
 # so it loads lazily (PEP 562) instead of taxing every import.
 _LAZY = {"InferenceEngine": "ray_tpu.serve.engine",
-         "InferenceReplica": "ray_tpu.serve.engine"}
+         "InferenceReplica": "ray_tpu.serve.engine",
+         "DisaggHandle": "ray_tpu.serve.disagg",
+         "PrefillReplica": "ray_tpu.serve.disagg",
+         "DecodeReplica": "ray_tpu.serve.disagg"}
 
 
 def __getattr__(name):

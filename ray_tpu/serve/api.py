@@ -173,6 +173,61 @@ def run(app: Application, *, name: str = "default",
     return handle
 
 
+def run_disagg(cfg_kwargs: Optional[dict] = None, *,
+               name: str = "default",
+               prefill_replicas: int = 1, decode_replicas: int = 1,
+               slots: int = 4, max_len: int = 64, seed: int = 0,
+               engine_kwargs: Optional[dict] = None,
+               prefill_autoscaling: Optional[dict] = None,
+               decode_autoscaling: Optional[dict] = None,
+               max_concurrent_queries: int = 8):
+    """Deploy a disaggregated prefill/decode inference fleet: one
+    `PrefillReplica` pool (chunked prefill + KV-block export) and one
+    `DecodeReplica` pool (KV import + token streaming), paired behind a
+    `DisaggHandle` — `handle.stream(prompt, n)` is greedy token-identical
+    to a colocated `InferenceReplica` deployment of the same seed.
+
+    The two pools autoscale independently on their own demand signals
+    (see `ServeController`): prefill on queue depth (prompts waiting to
+    be absorbed), decode on stream occupancy (live token streams) — pass
+    `prefill_autoscaling` / `decode_autoscaling` dicts to enable; each
+    gets the role's natural `demand_signal` unless overridden."""
+    from ray_tpu.serve.controller import get_controller
+    from ray_tpu.serve.disagg import (
+        DecodeReplica,
+        DisaggHandle,
+        PrefillReplica,
+    )
+    if prefill_autoscaling is not None:
+        prefill_autoscaling = {"demand_signal": "queue_depth",
+                               **prefill_autoscaling}
+    if decode_autoscaling is not None:
+        decode_autoscaling = {"demand_signal": "streams",
+                              **decode_autoscaling}
+    init_kwargs = {"slots": slots, "max_len": max_len, "seed": seed,
+                   "engine_kwargs": engine_kwargs}
+    specs = [
+        Deployment(PrefillReplica, "prefill",
+                   num_replicas=prefill_replicas,
+                   max_concurrent_queries=max_concurrent_queries,
+                   autoscaling_config=prefill_autoscaling).to_spec(
+            (cfg_kwargs,), init_kwargs, None),
+        Deployment(DecodeReplica, "decode",
+                   num_replicas=decode_replicas,
+                   max_concurrent_queries=max_concurrent_queries,
+                   autoscaling_config=decode_autoscaling).to_spec(
+            (cfg_kwargs,), init_kwargs, None),
+    ]
+    controller = get_controller()
+    ray_tpu.get(controller.deploy_application.remote(name, specs),
+                timeout=120)
+    prefill = DeploymentHandle("prefill", name)
+    decode = DeploymentHandle("decode", name)
+    prefill._pick_replica()      # block until both pools are live
+    decode._pick_replica()
+    return DisaggHandle(prefill, decode)
+
+
 _node_proxies: dict = {}
 
 

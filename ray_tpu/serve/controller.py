@@ -58,6 +58,18 @@ class _DeploymentState:
         # autoscaling smoothing (reference: autoscaling_policy.py
         # downscale_delay_s): scale down only after sustained low demand.
         self._downscale_candidate_since: float | None = None
+        # autoscaler observability: the demand the last reconcile tick
+        # computed (None = never scraped) and the error that aborted the
+        # last scrape (None = the scrape worked) — surfaced in status()
+        # so "never scaled up" is diagnosable from the outside.
+        self.last_demand: float | None = None
+        self.peak_demand: float = 0.0
+        self.last_autoscale_error: str | None = None
+        self.autoscale_ticks: int = 0
+        # live latency view for SLO-aware admission (http_proxy): the
+        # worst replica's TTFT/TPOT p99 from the last stats scrape,
+        # None until engine-backed replicas report them.
+        self.slo_snapshot: dict | None = None
         # circuit breaker over replica deaths: closed (normal restarts)
         # -> open (quarantine: deaths stop triggering restarts) ->
         # half_open (one probe replica) -> closed on probe survival.
@@ -156,9 +168,45 @@ class ServeController:
                     "replicas": len(st.replicas),
                     "target_replicas": st.target_num,
                     "breaker": st.breaker,
+                    "last_demand": st.last_demand,
+                    "peak_demand": st.peak_demand,
+                    "autoscale_ticks": st.autoscale_ticks,
+                    "last_autoscale_error": st.last_autoscale_error,
                 }
                 for (app, name), st in self._deployments.items()
             }
+
+    @staticmethod
+    def _update_slo_snapshot(st: _DeploymentState,
+                             replica_stats: list) -> None:
+        """Fold one stats scrape into the deployment's live latency view
+        (the proxy's SLO-admission input). Worst replica wins — an SLO
+        the slowest replica can't meet isn't met, since the router may
+        pick any of them."""
+        ttft = [s["ttft_ms_p99"] for s in replica_stats
+                if isinstance(s.get("ttft_ms_p99"), (int, float))]
+        tpot = [s["p99_token_latency_ms"] for s in replica_stats
+                if isinstance(s.get("p99_token_latency_ms"),
+                              (int, float))]
+        if not ttft and not tpot:
+            return
+        st.slo_snapshot = {
+            "ttft_ms_p99": max(ttft) if ttft else 0.0,
+            "tpot_ms_p99": max(tpot) if tpot else 0.0,
+            "queue_depth": sum(s.get("queue_depth", 0)
+                               for s in replica_stats),
+            "replicas": len(replica_stats),
+        }
+
+    def get_slo_snapshot(self) -> dict:
+        """`"app:deployment" -> {ttft_ms_p99, tpot_ms_p99, queue_depth,
+        replicas}` for every deployment whose replicas report latency
+        histograms (engine-backed ones do). The HTTP proxy caches this
+        briefly and admits/sheds per-request SLO targets against it."""
+        with self._lock:
+            return {f"{app}:{name}": dict(st.slo_snapshot)
+                    for (app, name), st in self._deployments.items()
+                    if st.slo_snapshot is not None}
 
     def stats(self) -> dict:
         """Serve-plane fault-tolerance counters, published to /metrics
@@ -456,39 +504,58 @@ class ServeController:
         self._update_breaker(st, deaths, now)
 
         replica_stats = None
-        if st.autoscaling and alive:
+        if alive:
+            # Scrape every deployment, not just autoscaled ones: the
+            # stats feed BOTH the autoscaler's demand signal and the
+            # SLO-admission latency snapshot the proxy routes against.
             try:
                 replica_stats = ray_tpu.get(
                     [r.stats.remote() for r in alive],
                     timeout=SERVE_STATS_TIMEOUT_S)
-                # Demand = requests being served + requests queued
-                # behind them (engine stats merged through
-                # Replica.stats expose `queue_depth`; plain callables
-                # contribute 0) — queue pressure scales up BEFORE
-                # latency collapses, not after.
+                self._update_slo_snapshot(st, replica_stats)
+            except _exc.RayTpuError as e:
+                if st.autoscaling:
+                    st.last_autoscale_error = f"{type(e).__name__}: {e}"
+        if st.autoscaling and replica_stats:
+            # Demand signal is role-aware (disaggregated serving):
+            #   "queue_depth" (default) = requests being served +
+            #     requests queued behind them — the prefill pool's
+            #     signal (queue pressure scales up BEFORE latency
+            #     collapses, not after);
+            #   "streams" = live response streams + queue — the decode
+            #     pool's signal (a decode replica's load is its resident
+            #     token streams, which stay open long after the
+            #     admitting request returned).
+            if st.autoscaling.get("demand_signal") == "streams":
+                demand = sum(s.get("streams", 0)
+                             + s.get("queue_depth", 0)
+                             for s in replica_stats)
+            else:
                 demand = sum(s["inflight"] + s.get("queue_depth", 0)
                              for s in replica_stats)
-                target_per = st.autoscaling.get(
-                    "target_num_ongoing_requests_per_replica", 1.0)
-                desired = int(max(
-                    st.autoscaling.get("min_replicas", 1),
-                    min(st.autoscaling.get("max_replicas", 8),
-                        -(-demand // max(target_per, 1e-6))
-                        or st.autoscaling.get("min_replicas", 1))))
-                if desired >= len(alive):
+            st.last_demand = demand
+            st.peak_demand = max(st.peak_demand, demand)
+            st.autoscale_ticks += 1
+            st.last_autoscale_error = None
+            target_per = st.autoscaling.get(
+                "target_num_ongoing_requests_per_replica", 1.0)
+            desired = int(max(
+                st.autoscaling.get("min_replicas", 1),
+                min(st.autoscaling.get("max_replicas", 8),
+                    -(-demand // max(target_per, 1e-6))
+                    or st.autoscaling.get("min_replicas", 1))))
+            if desired >= len(alive):
+                st.target_num = desired
+                st._downscale_candidate_since = None
+            else:
+                delay = st.autoscaling.get("downscale_delay_s",
+                                           SERVE_DOWNSCALE_DELAY_S)
+                now = time.time()
+                if st._downscale_candidate_since is None:
+                    st._downscale_candidate_since = now
+                elif now - st._downscale_candidate_since >= delay:
                     st.target_num = desired
                     st._downscale_candidate_since = None
-                else:
-                    delay = st.autoscaling.get("downscale_delay_s",
-                                               SERVE_DOWNSCALE_DELAY_S)
-                    now = time.time()
-                    if st._downscale_candidate_since is None:
-                        st._downscale_candidate_since = now
-                    elif now - st._downscale_candidate_since >= delay:
-                        st.target_num = desired
-                        st._downscale_candidate_since = None
-            except _exc.RayTpuError:
-                pass
 
         # breaker gates replacement: open = no new replicas at all
         # (quarantine), half_open = at most one probe beyond survivors

@@ -1,0 +1,396 @@
+"""Disaggregated prefill/decode serving: role-specialized replicas and
+the KV-block stream between them.
+
+The paged engine already splits a request's life into two phases with
+opposite resource shapes — chunked prefill (compute-bound, bursty) and
+decode (bandwidth-bound, steady). Colocating them means a burst of long
+prompts steals decode ticks from every active stream. This module splits
+the fleet instead (the architecture of DistServe/Splitwise and the
+reference's prefill-disaggregation work):
+
+- `PrefillReplica` runs an `InferenceEngine(role="prefill")`: chunked
+  prefill only, prompt-only block footprint. A request returns a small
+  *handoff descriptor*; the finished KV blocks (payload + any int8 scale
+  rows, block-aligned) stay parked on the replica until the decode side
+  pulls them over netaddr.
+- `DecodeReplica` runs `role="decode"`: it dials the prefill replica's
+  block server, reassembles the blob (header rides a coalesced
+  `BatchedConnection` frame, each array travels as one zero-pickle raw
+  frame), imports it into its own pool via `engine.import_handoff`, and
+  serves the token stream — greedy token-identical to a colocated run,
+  picking up at the first generated token the prefill engine already
+  sampled.
+- `DisaggHandle` pairs the two deployment handles: prompt → prefill pool
+  (retrying `call`, so a prefill replica death mid-handoff fails over
+  through the PR-12 path), resume → decode pool (`stream`, with a
+  disagg-aware failover policy that re-prefills prompt+emitted on a
+  fresh decode replica if the decode side dies mid-stream).
+
+Wire format per pulled handoff (one logical exchange per request):
+  -> {"handoff_id": rid}                      (pickled, batched frame)
+  <- header: blob metadata + frame manifest   (pickled, batched frame)
+  <- one raw byte frame per payload array, manifest order
+`BatchedConnection.send_bytes` flushes pending logical messages before
+the raw write under the same wire-lock hold, so header/payload adjacency
+is guaranteed without an explicit barrier.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _np_frames(blob: dict):
+    """Split a handoff blob into (meta, manifest, arrays): every numpy
+    array in the payload (and draft payload) becomes one raw wire frame,
+    described by a manifest entry the receiver rebuilds from."""
+    meta = {k: v for k, v in blob.items()
+            if k not in ("payload", "draft_payload", "prompt")}
+    meta["prompt"] = [int(t) for t in np.asarray(blob["prompt"]).ravel()]
+    meta["has_draft"] = blob.get("draft_payload") is not None
+    manifest, arrays = [], []
+    for which in ("payload", "draft_payload"):
+        blocks = blob.get(which) or []
+        for i, blk in enumerate(blocks):
+            for name in sorted(blk):
+                arr = np.ascontiguousarray(blk[name])
+                manifest.append((which, i, name, arr.shape,
+                                 str(arr.dtype)))
+                arrays.append(arr)
+    meta["manifest"] = manifest
+    return meta, manifest, arrays
+
+
+def _blob_from_frames(meta: dict, frames: list) -> dict:
+    """Inverse of `_np_frames`: reassemble the engine-shaped blob from
+    the header and the received raw byte frames."""
+    payload: dict[int, dict] = {}
+    draft: dict[int, dict] = {}
+    for (which, i, name, shape, dtype), buf in zip(meta["manifest"],
+                                                   frames):
+        arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+        (payload if which == "payload" else draft).setdefault(
+            i, {})[name] = arr
+    blob = {k: v for k, v in meta.items()
+            if k not in ("manifest", "has_draft")}
+    blob["payload"] = [payload[i] for i in sorted(payload)]
+    blob["draft_payload"] = ([draft[i] for i in sorted(draft)]
+                             if meta["has_draft"] else None)
+    return blob
+
+
+class PrefillReplica:
+    """Serve deployment hosting a prefill-role engine plus the netaddr
+    block server the decode side pulls finished KV from.
+
+    `__call__` runs the chunked prefill to completion and returns a
+    handoff *descriptor* — small enough to ride the control plane — with
+    the dial address of this replica's block server. The heavyweight KV
+    blob itself never touches the object store: it stays parked here
+    until exactly one decode replica streams it out (or the park TTL
+    reaps it, so an abandoned descriptor can't pin host memory forever).
+    """
+
+    _PARK_TTL_S = 120.0
+
+    def __init__(self, cfg_kwargs: dict | None = None, *,
+                 slots: int = 4, max_len: int = 64, seed: int = 0,
+                 engine_kwargs: dict | None = None):
+        from ray_tpu.serve.engine import InferenceReplica
+        inner = InferenceReplica(cfg_kwargs, slots=slots, max_len=max_len,
+                                 seed=seed,
+                                 engine_kwargs={**(engine_kwargs or {}),
+                                                "role": "prefill"})
+        self.engine = inner.engine
+        self._lock = threading.Lock()
+        self._parked: dict[int, tuple[dict, float]] = {}
+        self._authkey = os.urandom(16)
+        from ray_tpu._private import netaddr
+        self._listener = netaddr.listener(("0.0.0.0", 0), self._authkey)
+        self._addr = netaddr.bound_address(self._listener)
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="disagg-kv-server")
+        self._accept_thread.start()
+
+    # -- request path -----------------------------------------------------
+
+    def __call__(self, prompt, max_new_tokens: int = 8,
+                 temperature: float = 0.0, priority: int | None = None):
+        if priority is None:
+            from ray_tpu.serve import priority as _prio
+            priority = _prio.get_request_priority()
+        rid = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
+                                 temperature=temperature,
+                                 priority=priority)
+        blob = self.engine.handoff_for(rid)
+        now = time.time()
+        with self._lock:
+            stale = [r for r, (_, ts) in self._parked.items()
+                     if now - ts > self._PARK_TTL_S]
+            for r in stale:
+                self._parked.pop(r, None)
+            self._parked[rid] = (blob, now)
+        return {
+            "handoff_addr": self._addr,
+            "handoff_key": self._authkey.hex(),
+            "handoff_id": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "priority": int(priority),
+            "kv_bytes": int(blob["kv_bytes"]),
+        }
+
+    # -- block server -----------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="disagg-kv-conn").start()
+
+    def _serve_conn(self, conn):
+        """One puller connection: any number of handoff pulls, then EOF.
+        Each pull pops the blob — a handoff streams out exactly once."""
+        try:
+            while True:
+                req = conn.recv()
+                rid = int(req["handoff_id"])
+                with self._lock:
+                    entry = self._parked.pop(rid, None)
+                if entry is None:
+                    conn.send({"error": f"unknown handoff {rid} "
+                                        "(expired or already pulled)"})
+                    continue
+                meta, _, arrays = _np_frames(entry[0])
+                conn.send(meta)
+                for arr in arrays:
+                    # tobytes(): dtypes like bfloat16 have no buffer
+                    # protocol, so the ndarray itself can't go on the wire.
+                    conn.send_bytes(arr.tobytes())
+        except (EOFError, OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # -- control surface --------------------------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        with self._lock:
+            parked = self._parked.pop(rid, None) is not None
+        return self.engine.cancel(rid) or parked
+
+    def update_params(self, new_params, *, draft_params=None) -> int:
+        return self.engine.update_params(new_params,
+                                         draft_params=draft_params)
+
+    def stats(self) -> dict:
+        out = self.engine.stats()
+        with self._lock:
+            out["handoffs_parked"] = len(self._parked)
+        return out
+
+    def __del__(self):
+        self._closing = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+class DecodeReplica:
+    """Serve deployment hosting a decode-role engine: resumes handoff
+    descriptors by streaming the KV blob from the prefill replica's
+    block server and importing it into the local pool; also accepts a
+    plain prompt (full local prefill) — the failover resubmission path
+    and the shape `token_resume` can rebuild.
+    """
+
+    def __init__(self, cfg_kwargs: dict | None = None, *,
+                 slots: int = 4, max_len: int = 64, seed: int = 0,
+                 engine_kwargs: dict | None = None):
+        from ray_tpu.serve.engine import InferenceReplica
+        inner = InferenceReplica(cfg_kwargs, slots=slots, max_len=max_len,
+                                 seed=seed,
+                                 engine_kwargs={**(engine_kwargs or {}),
+                                                "role": "decode"})
+        self.engine = inner.engine
+        self._lock = threading.Lock()
+        # serializes whole pull exchanges (send..recv_bytes*) — a
+        # blocking wire wait must never run under self._lock, which the
+        # controller's stats scrape needs promptly
+        self._pull_mu = threading.Lock()
+        # (addr, key) -> BatchedConnection, reused across pulls so the
+        # PR-17 frame coalescing actually amortizes
+        self._conns: dict = {}
+        self._pull_ms: list = []
+        self._handoff_fallbacks = 0
+        self._kv_pulled_bytes = 0
+        self._kv_pull_s = 0.0
+
+    def _conn_for(self, addr: str, key: bytes):
+        from ray_tpu._private import netaddr
+        with self._lock:
+            conn = self._conns.get((addr, key))
+        if conn is not None and not conn.closed:
+            return conn
+        conn = netaddr.client(addr, key)
+        with self._lock:
+            self._conns[(addr, key)] = conn
+        return conn
+
+    def _pull_blob(self, desc: dict) -> dict:
+        addr = desc["handoff_addr"]
+        key = bytes.fromhex(desc["handoff_key"])
+        conn = self._conn_for(addr, key)
+        # one pull exchange at a time per replica: request/response pairs
+        # must not interleave on the shared connection
+        with self._pull_mu:
+            conn.send({"handoff_id": desc["handoff_id"]})
+            conn.flush()
+            meta = conn.recv()
+            if "error" in meta:
+                raise KeyError(meta["error"])
+            frames = [conn.recv_bytes() for _ in meta["manifest"]]
+        return _blob_from_frames(meta, frames)
+
+    def __call__(self, request, max_new_tokens: int = 8,
+                 temperature: float = 0.0, priority: int | None = None):
+        if isinstance(request, dict) and "handoff_addr" in request:
+            return self.resume_from(request)
+        if priority is None:
+            from ray_tpu.serve import priority as _prio
+            priority = _prio.get_request_priority()
+        rid = self.engine.submit(request, max_new_tokens=max_new_tokens,
+                                 temperature=temperature,
+                                 priority=priority)
+        return self.engine.tokens_for(rid)
+
+    def resume_from(self, desc: dict):
+        """Pull the descriptor's KV blob, import it, and return the
+        token generator continuing at the first generated token. If the
+        prefill replica died (or the blob expired) between descriptor
+        and pull, fall back to a full local prefill of the descriptor's
+        prompt — greedy decode makes that token-identical, just without
+        the transfer savings."""
+        t0 = time.perf_counter()
+        try:
+            blob = self._pull_blob(desc)
+        except (KeyError, OSError, EOFError, ConnectionError):
+            with self._lock:
+                self._handoff_fallbacks += 1
+                self._conns.pop((desc["handoff_addr"],
+                                 bytes.fromhex(desc["handoff_key"])),
+                                None)
+            rid = self.engine.submit(
+                desc["prompt"],
+                max_new_tokens=int(desc["max_new_tokens"]),
+                temperature=float(desc["temperature"]),
+                priority=int(desc.get("priority", 0)))
+            return self.engine.tokens_for(rid)
+        rid = self.engine.import_handoff(blob)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._pull_ms.append(dt * 1e3)
+            del self._pull_ms[:-256]
+            self._kv_pulled_bytes += int(blob.get("kv_bytes", 0))
+            self._kv_pull_s += dt
+        self.engine._recorder.on_handoff(rid, dt)
+        return self.engine.tokens_for(rid)
+
+    def cancel(self, rid: int) -> bool:
+        return self.engine.cancel(rid)
+
+    def update_params(self, new_params, *, draft_params=None) -> int:
+        return self.engine.update_params(new_params,
+                                         draft_params=draft_params)
+
+    def stats(self) -> dict:
+        out = self.engine.stats()
+        with self._lock:
+            pulls = sorted(self._pull_ms)
+            out["handoff_fallbacks"] = self._handoff_fallbacks
+            out["kv_pulled_bytes"] = self._kv_pulled_bytes
+            out["kv_transfer_gbps"] = (
+                self._kv_pulled_bytes / max(self._kv_pull_s, 1e-9) / 1e9)
+            out["handoff_pull_ms_p50"] = (
+                pulls[len(pulls) // 2] if pulls else 0.0)
+            out["handoff_pull_ms_p99"] = (
+                pulls[min(len(pulls) - 1, int(len(pulls) * 0.99))]
+                if pulls else 0.0)
+        return out
+
+    def __del__(self):
+        with self._lock:
+            conns, self._conns = list(self._conns.values()), {}
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def disagg_resume(args, kwargs, emitted):
+    """`DisaggHandle.stream` failover policy for the DECODE leg: the
+    original submission was a handoff descriptor, so rebuild a plain
+    prompt+emitted resubmission from the prompt the descriptor carries —
+    a fresh decode replica re-prefills locally and the spliced stream
+    stays token-identical under greedy decode. Returns None when the
+    token budget is already spent (stream was complete at death)."""
+    desc = args[0]
+    if not (isinstance(desc, dict) and "prompt" in desc):
+        raise TypeError("disagg_resume needs a handoff descriptor")
+    remaining = int(desc["max_new_tokens"]) - len(emitted)
+    if remaining <= 0:
+        return None
+    prompt = list(desc["prompt"]) + [int(t) for t in emitted]
+    return (prompt,), {"max_new_tokens": remaining,
+                       "temperature": float(desc["temperature"]),
+                       "priority": int(desc.get("priority", 0))}
+
+
+class DisaggHandle:
+    """Client-side pairing of the two role pools: `stream(prompt, ...)`
+    routes the prompt to the prefill deployment (retrying `call` — a
+    prefill replica killed mid-handoff fails over through the standard
+    replica-death retry), then resumes the descriptor on the decode
+    deployment as a token stream with the disagg failover policy."""
+
+    def __init__(self, prefill_handle, decode_handle):
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+
+    def options(self, *, priority: int | None = None) -> "DisaggHandle":
+        return DisaggHandle(
+            self.prefill.options(priority=priority)
+            if priority is not None else self.prefill,
+            self.decode.options(priority=priority)
+            if priority is not None else self.decode)
+
+    def stream(self, prompt, max_new_tokens: int = 8,
+               temperature: float = 0.0, *, timeout: float = 120.0,
+               deadline_s: float | None = None, **kw):
+        desc = self.prefill.call(
+            list(prompt), max_new_tokens=max_new_tokens,
+            temperature=temperature, timeout=timeout,
+            deadline_s=deadline_s, **kw)
+        return self.decode.stream(
+            desc, timeout=timeout, deadline_s=deadline_s,
+            failover=disagg_resume)
+
+    def generate(self, prompt, max_new_tokens: int = 8, **kw) -> list:
+        return list(self.stream(prompt, max_new_tokens=max_new_tokens,
+                                **kw))

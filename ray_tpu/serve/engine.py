@@ -484,13 +484,27 @@ class InferenceEngine:
                  watchdog_s: float | None = None,
                  priority_classes: int | None = None,
                  priority_aging_s: float | None = None,
-                 priority_weight_base: float | None = None):
+                 priority_weight_base: float | None = None,
+                 role: str = "colocated"):
         import jax
         import jax.numpy as jnp
         from ray_tpu.models import gpt
         self._jax = jax
         self._gpt = gpt
         self.cfg = cfg
+        # Disaggregated serving role. "prefill": this engine runs
+        # chunked prefill only — a completed prompt's KV blocks are
+        # gathered to host and parked as a handoff blob for a decode
+        # engine to import; nothing ever enters the decode phase here.
+        # "decode": behaviorally a colocated engine (it can still serve
+        # whole requests) that additionally advertises itself as an
+        # import target — the role tag drives serve routing, per-role
+        # autoscaling signals, and per-role telemetry. "colocated"
+        # (default): the classic single-engine path. import_handoff is
+        # available on any non-prefill engine.
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
         self.params = params
         self.mesh = mesh
         self.num_slots = slots
@@ -695,6 +709,27 @@ class InferenceEngine:
         self._verify_fn = (jax.jit(_verify, donate_argnums=(1,))
                            if spec is not None else None)
 
+        # Disaggregation transport jits: gather one block's KV (payload
+        # plus any int8 scale rows) into standalone device arrays for
+        # host export, and scatter one transferred block back into a
+        # pool. The block index is traced, so each compiles once per
+        # pool geometry — target and draft pools differ in shape, hence
+        # at most two traces each (sentinel-capped below).
+        self.kv_gather_traces = 0
+        self.kv_scatter_traces = 0
+
+        def _gather(cache, idx):
+            self.kv_gather_traces += 1
+            return gpt.gather_block(cache, idx)
+
+        def _scatter_blk(cache, block, idx):
+            self.kv_scatter_traces += 1
+            return gpt.scatter_block(cache, block, idx)
+
+        self._gather_fn = jax.jit(_gather)
+        self._scatter_block_fn = jax.jit(_scatter_blk,
+                                         donate_argnums=(0,))
+
         if spec == "draft":
             W = self.spec_window
 
@@ -748,6 +783,25 @@ class InferenceEngine:
         # ordered shedding): tokens_for raises it to the consumer.
         self._errors: dict[int, Exception] = {}
         self._lock = threading.RLock()
+
+        # --- disaggregated prefill/decode handoff state ---------------
+        # Export side (role="prefill"): rid -> host-side KV blob parked
+        # when the prompt's prefill completes, until the serve layer (or
+        # a test) collects it via handoff_for/take_handoff. Import side
+        # (any non-prefill role): FIFO of (rid, blob) waiting for a free
+        # slot; `_import_rids` mirrors it for O(1) membership.
+        self._handoffs: dict[int, dict] = {}
+        self._imports: collections.deque = collections.deque()
+        self._import_rids: set[int] = set()
+        self._handoffs_exported = 0
+        self._imports_completed = 0
+        self._handoffs_abandoned = 0
+        self._kv_blocks_exported = 0
+        self._kv_blocks_imported = 0
+        self._kv_export_bytes = 0
+        self._kv_import_bytes = 0
+        self._kv_export_ms = collections.deque(maxlen=256)
+        self._kv_import_ms = collections.deque(maxlen=256)
 
         # --- priority classes (multi-tenant admission) ----------------
         from ray_tpu._private.constants import (
@@ -881,6 +935,16 @@ class InferenceEngine:
                                  registered=True)
         self._sentinel.watch("prefill", lambda: self.prefill_traces,
                              registered=True)
+        # Block gather/scatter trace once per pool geometry: the draft
+        # pool's shapes differ from the target's, so a draft engine gets
+        # two traces; everyone else exactly one.
+        self._sentinel.watch("kv_gather", lambda: self.kv_gather_traces,
+                             cap=2 if spec == "draft" else 1,
+                             registered=True)
+        self._sentinel.watch("kv_scatter",
+                             lambda: self.kv_scatter_traces,
+                             cap=2 if spec == "draft" else 1,
+                             registered=True)
         _telemetry.register_stats_source(self.name, self, kind="engine")
 
     def arm_retrace_sentinel(self):
@@ -924,7 +988,7 @@ class InferenceEngine:
             # projection (not just instantaneous usage) keeps a burst of
             # submits between two ticks from overshooting the mark.
             queued = sum(
-                self._blocks_for(q.prompt.size, q.max_new_tokens)
+                self._slot_blocks_for(q.prompt.size, q.max_new_tokens)
                 for q in self._pending)
             projected = (self._alloc.used + queued + n_blocks) \
                 / max(self.cache_blocks, 1)
@@ -943,6 +1007,17 @@ class InferenceEngine:
         sampled token is never written)."""
         highest = p - 1 + max(max_new - 1, 0)
         return highest // self.block_size + 1
+
+    def _slot_blocks_for(self, p: int, max_new: int) -> int:
+        """Blocks THIS engine must hold for a request. A prefill-role
+        engine never decodes: its slots only write the prompt's
+        positions before handing off, so its footprint is the prompt
+        blocks alone — the generation footprint is the importing
+        engine's problem. Every other role needs the full
+        prompt+generation footprint (`_blocks_for`)."""
+        if self.role == "prefill":
+            return (p - 1) // self.block_size + 1
+        return self._blocks_for(p, max_new)
 
     def submit(self, prompt, max_new_tokens: int = 16,
                temperature: float = 0.0,
@@ -969,14 +1044,14 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
                 f"exceeds cache max_len {self.max_len}")
-        if self._blocks_for(prompt.size, max_new_tokens) > \
+        if self._slot_blocks_for(prompt.size, max_new_tokens) > \
                 self.cache_blocks:
             raise ValueError(
                 f"request footprint "
-                f"{self._blocks_for(prompt.size, max_new_tokens)} blocks "
-                f"exceeds cache blocks {self.cache_blocks}")
+                f"{self._slot_blocks_for(prompt.size, max_new_tokens)} "
+                f"blocks exceeds cache blocks {self.cache_blocks}")
         if self._draft_alloc is not None and \
-                self._blocks_for(prompt.size, max_new_tokens) > \
+                self._slot_blocks_for(prompt.size, max_new_tokens) > \
                 self.draft_cache_blocks:
             raise ValueError(
                 f"request footprint exceeds draft cache blocks "
@@ -985,7 +1060,7 @@ class InferenceEngine:
             if self.max_queue is not None or \
                     self.shed_high_water is not None:
                 reason = self._shed_verdict(
-                    self._blocks_for(prompt.size, max_new_tokens))
+                    self._slot_blocks_for(prompt.size, max_new_tokens))
                 # Class-ordered shedding: pressure evicts the lowest-
                 # class QUEUED request first; the incoming request is
                 # only shed when nothing queued ranks below it (so an
@@ -993,7 +1068,8 @@ class InferenceEngine:
                 while reason is not None and \
                         self._shed_lowest_below(priority):
                     reason = self._shed_verdict(
-                        self._blocks_for(prompt.size, max_new_tokens))
+                        self._slot_blocks_for(prompt.size,
+                                              max_new_tokens))
                 if reason is not None:
                     self._sheds += 1
                     self._class_counter(priority)["sheds"] += 1
@@ -1066,6 +1142,19 @@ class InferenceEngine:
                     self._release(i)
                     hit = True
                     break
+            if self._handoffs.pop(rid, None) is not None:
+                # an exported-but-never-collected prefill: the device
+                # blocks were already freed at export, so abandoning
+                # only drops the host blob
+                self._handoffs_abandoned += 1
+                hit = True
+            if rid in self._import_rids:
+                self._import_rids.discard(rid)
+                for i, (irid, _) in enumerate(self._imports):
+                    if irid == rid:
+                        del self._imports[i]
+                        break
+                hit = True
             hit |= self._out.pop(rid, None) is not None
             hit |= self._errors.pop(rid, None) is not None
             self._done.discard(rid)
@@ -1087,6 +1176,7 @@ class InferenceEngine:
         try:
             while True:
                 tok = None
+                fin = False
                 with self._lock:   # pop under the lock, yield OUTSIDE
                     # it — a generator suspends at yield, and a
                     # suspended holder would block every consumer's pump.
@@ -1100,22 +1190,328 @@ class InferenceEngine:
                         del self._out[rid]
                         self._done.discard(rid)
                         raise err
-                    while not q and rid not in self._done:
+                    if not q and rid not in self._done:
+                        # ONE tick per lock hold, not a hold-until-token
+                        # loop: releasing between ticks lets submit()/
+                        # stats()/cancel() interleave with saturated
+                        # pumps. (Observed: the serve controller's
+                        # autoscaling scrape starving seconds behind 8
+                        # pumping consumers and reading post-drain
+                        # queue depths — the scale-up signal vanished.)
                         self.step()
                     if q:
                         tok = q.popleft()
                     if rid in self._done and not q:
                         self._done.discard(rid)
                         del self._out[rid]
-                if tok is None:
+                        fin = True
+                if tok is not None:
+                    yield tok
+                elif fin:
                     return
-                yield tok
         finally:
             self.cancel(rid)
 
     def generate(self, prompt, **kw) -> list[int]:
         """Blocking convenience: submit + drain one request."""
         return list(self.tokens_for(self.submit(prompt, **kw)))
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode handoff
+    # ------------------------------------------------------------------
+
+    def _export_handoff(self, slot_idx: int):
+        """Prefill-role endgame for one slot (under the lock, called
+        from `_run_prefill_chunk` the tick the prompt completes): gather
+        every written KV block — payload and any int8 scale rows travel
+        together, block-aligned — to host, park the blob for collection,
+        and free the device blocks. The blob carries everything a
+        decode-role `import_handoff` needs to continue the stream
+        token-identically: the parked first token (with logprob/version,
+        sampled from the final prefill chunk HERE so the decode engine
+        never re-runs prefill), the sampling state, and the weight
+        version the KV was computed under."""
+        s = self._slots[slot_idx]
+        p = s.prompt.size
+        n_written = (p - 1) // self.block_size + 1
+        t0 = time.perf_counter()
+
+        def _dump(pool, blocks):
+            out = []
+            for b in blocks[:n_written]:
+                blk = self._gather_fn(pool, np.int32(b))
+                # graftlint: disable-next-line=R001,R004 the export IS the handoff's one deliberate device->host pull: the blob must be host bytes before it can ride netaddr to the decode replica
+                out.append({k: np.asarray(v) for k, v in blk.items()})
+            return out
+
+        payload = _dump(self.cache, s.blocks)
+        draft_payload = (_dump(self.draft_cache, s.draft_blocks)
+                         if self._draft_alloc is not None else None)
+        kv_bytes = sum(int(a.nbytes) for blk in payload
+                       for a in blk.values())
+        if draft_payload is not None:
+            kv_bytes += sum(int(a.nbytes) for blk in draft_payload
+                            for a in blk.values())
+        dt = time.perf_counter() - t0
+        blob = {
+            "rid": s.rid,
+            "prompt": s.prompt,
+            "token": int(s.token),
+            "token_logp": float(s.token_logp),
+            "token_ver": int(s.token_ver),
+            "max_new_tokens": int(s.remaining),
+            "temperature": float(s.temperature),
+            "eos_id": s.eos_id,
+            "priority": int(s.priority),
+            "params_version": int(self._params_version),
+            "block_size": self.block_size,
+            "n_blocks": n_written,
+            "payload": payload,
+            "draft_payload": draft_payload,
+            "kv_bytes": int(kv_bytes),
+        }
+        self._handoffs[s.rid] = blob
+        self._handoffs_exported += 1
+        self._kv_blocks_exported += n_written * (
+            2 if draft_payload is not None else 1)
+        self._kv_export_bytes += kv_bytes
+        self._kv_export_ms.append(dt * 1e3)
+        self._recorder.on_kv_export(s.rid, n_written, kv_bytes, dt)
+        self._recorder.on_finish(s.rid, "handoff")
+        # No token consumer on a prefill engine: drop the output queue
+        # now (handoff_for polls `_handoffs`, not `_out`) and release
+        # the device blocks — the prompt's full blocks live on in the
+        # radix tree for shared-prefix admissions, everything else is
+        # host-side in the blob.
+        self._out.pop(s.rid, None)
+        self._done.discard(s.rid)
+        self._release(slot_idx)
+
+    def handoff_for(self, rid: int) -> dict:
+        """Pump the scheduler until `rid`'s prefill completes, then pop
+        and return its handoff blob — the prefill-role analogue of
+        draining `tokens_for`. Raises the parked error for a request
+        shed from the queue, KeyError for an unknown/cancelled rid."""
+        if self.role != "prefill":
+            raise RuntimeError(
+                "handoff_for is only available on a prefill-role "
+                f"engine (this engine is {self.role!r})")
+        while True:
+            with self._lock:
+                blob = self._handoffs.pop(rid, None)
+                if blob is not None:
+                    return blob
+                err = self._errors.pop(rid, None)
+                if err is not None:
+                    self._out.pop(rid, None)
+                    self._done.discard(rid)
+                    raise err
+                if rid not in self._out:
+                    raise KeyError(
+                        f"unknown or cancelled handoff rid {rid}")
+                # one tick per lock hold, same contract as tokens_for
+                self.step()
+
+    def take_handoff(self, rid: int) -> dict | None:
+        """Non-blocking collect: pop `rid`'s parked blob if its prefill
+        already completed, else None."""
+        with self._lock:
+            return self._handoffs.pop(rid, None)
+
+    def import_handoff(self, blob: dict) -> int:
+        """Adopt a prefill-role engine's handoff blob: queue its KV
+        blocks for scatter into this pool and its stream for a decode
+        slot. Returns a fresh LOCAL rid for `tokens_for` — the stream
+        picks up at the first generated token (already sampled by the
+        prefill engine and delivered from here), greedy token-identical
+        to a colocated run over the same prompt."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                "a prefill-role engine cannot import handoffs")
+        prompt = np.asarray(blob["prompt"], np.int32).reshape(-1)
+        p = prompt.size
+        max_new = int(blob["max_new_tokens"])
+        if int(blob["block_size"]) != self.block_size:
+            raise ValueError(
+                f"handoff block_size {blob['block_size']} != engine "
+                f"block_size {self.block_size} — prefill and decode "
+                f"pools must share the paging granule")
+        n_written = (p - 1) // self.block_size + 1
+        if len(blob["payload"]) != n_written:
+            raise ValueError(
+                f"handoff payload has {len(blob['payload'])} blocks, "
+                f"expected {n_written} for a {p}-token prompt")
+        if p + max_new > self.max_len:
+            raise ValueError(
+                f"handoff prompt {p} + max_new_tokens {max_new} "
+                f"exceeds cache max_len {self.max_len}")
+        if self._blocks_for(p, max_new) > self.cache_blocks:
+            raise ValueError(
+                f"handoff footprint {self._blocks_for(p, max_new)} "
+                f"blocks exceeds cache blocks {self.cache_blocks}")
+        if self._draft_alloc is not None:
+            if blob.get("draft_payload") is None:
+                raise ValueError(
+                    "draft-spec engine needs the handoff's draft-pool "
+                    "blocks (prefill engine must run the same spec)")
+            if self._blocks_for(p, max_new) > self.draft_cache_blocks:
+                raise ValueError(
+                    "handoff footprint exceeds draft cache blocks "
+                    f"{self.draft_cache_blocks}")
+        priority = int(blob.get("priority", 0))
+        if not 0 <= priority < self.priority_classes:
+            raise ValueError(
+                f"handoff priority {priority} outside "
+                f"[0, {self.priority_classes})")
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+            self._out[rid] = collections.deque()
+            self._imports.append((rid, blob))
+            self._import_rids.add(rid)
+            self._class_counter(priority)["submitted"] += 1
+            self._recorder.on_submit(rid, p)
+        return rid
+
+    def _admit_imports(self) -> bool:
+        """Move queued handoff imports into decode slots (FIFO), ahead
+        of regular pending admissions — an import's prefill cost is
+        already sunk on another engine, so making it wait behind local
+        prefills would throw that work away latency-wise. Under block
+        pressure an import may preempt strictly-lower-class active
+        streams, exactly like `_admit_or_preempt`."""
+        did = False
+        while self._imports:
+            rid, blob = self._imports[0]
+            free = next((i for i, s in enumerate(self._slots)
+                         if s.phase == "idle"), None)
+            if free is None:
+                break
+            if not self._try_import(free, rid, blob):
+                victim = self._pick_victim(int(blob.get("priority", 0)))
+                if victim is None:
+                    break
+                self._preempt(victim, "import-pressure")
+                continue
+            self._imports.popleft()
+            self._import_rids.discard(rid)
+            did = True
+        return did
+
+    def _try_import(self, slot_idx: int, rid: int, blob: dict) -> bool:
+        """Install one handoff into a slot: share any radix-cached full
+        prefix blocks by reference, scatter the remaining transferred
+        blocks into freshly allocated ones, and enter the decode phase
+        at the first generated token. Returns False (leaving the import
+        queued) when the pool can't supply the footprint even after
+        eviction. Unlike `_try_admit` there is NO copy-on-write: a
+        prefix match ending mid-block just means that block is
+        re-scattered from the transferred payload instead of shared —
+        cheaper than a device copy and bit-identical by construction."""
+        bs = self.block_size
+        # graftlint: disable-next-line=R001,R004 blob arrays are host numpy (they crossed the wire); this asarray is a view/cast, not a device sync
+        prompt = np.asarray(blob["prompt"], np.int32).reshape(-1)
+        p = prompt.size
+        max_new = int(blob["max_new_tokens"])
+        total = self._blocks_for(p, max_new)
+        payload = blob["payload"]
+        n_written = len(payload)
+        try:
+            _faults.check("engine.alloc")
+        except _faults.FaultInjected:
+            return False
+        if self._draft_alloc is not None and \
+                self._draft_alloc.free < total:
+            return False
+        # Prefix sharing only under a matching weight version: imported
+        # KV was computed under the blob's params_version, and mixing it
+        # with tree blocks from a different version would splice stale
+        # context into the sequence.
+        blocks, matched = ([], 0)
+        if self._tree is not None and \
+                int(blob["params_version"]) == self._params_version:
+            blocks, matched = self._tree.match(prompt)
+        n_full = min(matched // bs, n_written)
+        for b in blocks[:n_full]:
+            self._alloc.ref(b)
+        fresh_needed = total - n_full
+        if self._alloc.free < fresh_needed and self._tree is not None:
+            self._evicted_blocks += self._tree.evict(
+                fresh_needed - self._alloc.free)
+        if self._alloc.free < fresh_needed:
+            for b in blocks[:n_full]:
+                self._alloc.decref(b)
+            return False
+        fresh = [self._alloc.alloc() for _ in range(fresh_needed)]
+        slot_blocks = blocks[:n_full] + fresh
+        jnp = self._jax.numpy
+        t0 = time.perf_counter()
+        scattered = 0
+        for j in range(n_full, n_written):
+            self.cache = self._scatter_block_fn(
+                self.cache,
+                {k: jnp.asarray(v) for k, v in payload[j].items()},
+                np.int32(slot_blocks[j]))
+            scattered += 1
+        table = np.zeros((self.max_blocks,), np.int32)
+        table[:len(slot_blocks)] = slot_blocks
+        s = self._slots[slot_idx]
+        s.rid, s.phase = rid, "decode"
+        s.prompt, s.filled = prompt, p
+        s.blocks, s.table = slot_blocks, table
+        s.order = self._admit_seq
+        self._admit_seq += 1
+        s.temperature = float(blob["temperature"])
+        s.eos_id = blob["eos_id"]
+        s.remaining = max_new - 1
+        s.pos = p
+        s.token = int(blob["token"])
+        s.token_logp = float(blob["token_logp"])
+        s.token_ver = int(blob["token_ver"])
+        s.submit_ts = time.perf_counter()
+        s.priority = int(blob.get("priority", 0))
+        # resumed: TTFT was recorded on the prefill engine — counting
+        # the import here would double-book the same first token.
+        s.resumed = True
+        s.emitted = []
+        s.history = prompt.tolist() if self.spec == "ngram" else []
+        if self._draft_alloc is not None:
+            dblocks = [self._draft_alloc.alloc() for _ in range(total)]
+            dtable = np.zeros((self.max_blocks,), np.int32)
+            dtable[:len(dblocks)] = dblocks
+            for j in range(n_written):
+                self.draft_cache = self._scatter_block_fn(
+                    self.draft_cache,
+                    {k: jnp.asarray(v)
+                     for k, v in blob["draft_payload"][j].items()},
+                    np.int32(dblocks[j]))
+                scattered += 1
+            s.draft_blocks, s.draft_table = dblocks, dtable
+            s.draft_filled = p
+        # Version trust: a same-version import's prompt blocks are as
+        # publishable as a local prefill's; a cross-version one must
+        # never enter the tree (its K/V predates the current weights).
+        if int(blob["params_version"]) == self._params_version:
+            s.version = self._params_version
+            if self._tree is not None and p >= bs:
+                self._tree.insert(prompt, slot_blocks)
+        else:
+            s.version = self._params_version - 1
+        dt = time.perf_counter() - t0
+        kv_bytes = int(blob.get("kv_bytes", 0))
+        self._imports_completed += 1
+        self._kv_blocks_imported += scattered
+        self._kv_import_bytes += kv_bytes
+        self._kv_import_ms.append(dt * 1e3)
+        self._prefix_hit_tokens += n_full * bs
+        self._prompt_tokens += p
+        self._recorder.on_kv_import(rid, scattered, kv_bytes, dt)
+        self._recorder.on_admit(rid, n_full * bs, False)
+        # Deliver the parked first token through the normal emit path
+        # (it carries the logprob/version the prefill engine computed);
+        # a max_new_tokens=1 request retires right here.
+        self._emit(s, slot_idx, s.token, s.token_logp, s.token_ver)
+        return True
 
     # ------------------------------------------------------------------
     # weight hot-swap (RL flywheel)
@@ -1249,7 +1645,7 @@ class InferenceEngine:
         evicting zero-ref cached prefixes."""
         bs = self.block_size
         p = req.prompt.size
-        total = self._blocks_for(p, req.max_new_tokens)
+        total = self._slot_blocks_for(p, req.max_new_tokens)
         # fault site: 'fail' here reads as deterministic allocator
         # exhaustion — the admission is refused exactly as if the pool
         # had no free blocks, driving the class-preemption path (it
@@ -1567,6 +1963,19 @@ class InferenceEngine:
         if self._tree is not None and s.prompt.size >= self.block_size \
                 and s.version == self._params_version:
             self._tree.insert(s.prompt, s.blocks)
+        if self.role == "prefill":
+            # Disaggregated handoff: the first token is sampled (TTFT
+            # closes HERE — the decode side never re-counts it), then
+            # the written blocks ship to host and the slot frees for
+            # the next prompt. No decode phase ever runs on this
+            # engine.
+            if not s.resumed:
+                wait = time.perf_counter() - s.submit_ts
+                self._queue_waits.append(wait)
+                self._class_waits[s.priority].append(wait)
+                self._recorder.on_first_token(s.rid, wait)
+            self._export_handoff(slot_idx)
+            return
         s.phase = "decode"
         s.pos = s.prompt.size
         s.remaining -= 1
@@ -1654,7 +2063,8 @@ class InferenceEngine:
                     self._force_preempt()
                 had_decoders = any(
                     s.phase == "decode" for s in self._slots)
-                admitted = self._admit_pending()
+                imported = self._admit_imports()
+                admitted = self._admit_pending() or imported
                 chunked = self._prefill_tick(had_decoders)
                 if had_decoders and (admitted or chunked):
                     self._max_admission_stall = max(
@@ -1900,8 +2310,26 @@ class InferenceEngine:
             assert rid in self._out, f"errored rid {rid} has no queue"
             assert rid not in set(pend_rids) | set(slot_rids), \
                 f"errored rid {rid} still scheduled"
+        # Disaggregation registries: a queued import owns a live output
+        # queue and must not be scheduled anywhere else yet; a parked
+        # handoff's slot/queue were already released at export, so its
+        # rid must appear NOWHERE else.
+        import_rids = {irid for irid, _ in self._imports}
+        assert import_rids == self._import_rids, \
+            f"import registry drift: {import_rids} != {self._import_rids}"
+        assert not import_rids & (set(pend_rids) | set(slot_rids)), \
+            "import rid also pending/active"
+        for irid in import_rids:
+            assert irid in self._out, f"import rid {irid} has no queue"
+        handoff_rids = set(self._handoffs)
+        assert not handoff_rids & (set(pend_rids) | set(slot_rids)
+                                   | import_rids), \
+            "handoff rid still scheduled"
+        for hrid in handoff_rids:
+            assert hrid not in self._out, \
+                f"handoff rid {hrid} still owns an output queue"
         owners = set(pend_rids) | set(slot_rids) | self._done \
-            | set(self._errors)
+            | set(self._errors) | import_rids
         for rid in self._out:
             assert rid in owners, f"orphaned output queue for rid {rid}"
         for q in self._pending:
@@ -1938,6 +2366,13 @@ class InferenceEngine:
             self._last_swap_ms = 0.0
             self._sheds = 0
             self._watchdog_stalls = 0
+            self._handoffs_exported = 0
+            self._imports_completed = 0
+            self._handoffs_abandoned = 0
+            self._kv_blocks_exported = self._kv_blocks_imported = 0
+            self._kv_export_bytes = self._kv_import_bytes = 0
+            self._kv_export_ms.clear()
+            self._kv_import_ms.clear()
             self._preemptions = 0
             self._reprefill_blocks = 0
             self._aging_promotions = 0
@@ -2038,6 +2473,31 @@ class InferenceEngine:
           overrun the `watchdog_s` budget (always present; 0 with the
           watchdog disabled). Each stall also logs one WARN.
 
+        Disaggregated prefill/decode (role-specialized serving):
+          ``role`` — this engine's role: ``colocated`` (default) /
+          ``prefill`` (chunked prefill only, exports KV handoffs) /
+          ``decode`` (colocated behavior + import target; the tag
+          drives role-aware routing and per-role autoscaling).
+          ``handoffs`` — prompts prefilled and exported as KV blobs
+          since reset; ``imports`` — handoffs adopted into this pool.
+          ``handoffs_abandoned`` — exported blobs cancelled before
+          collection. ``handoffs_pending`` / ``imports_queued`` —
+          blobs parked awaiting pickup / imports awaiting a slot
+          (imports also count into ``queue_depth``: they are demand
+          exactly like queued prompts).
+          ``kv_blocks_exported`` / ``kv_blocks_imported`` — paged KV
+          blocks gathered to host / scattered into this pool;
+          ``kv_export_bytes`` / ``kv_import_bytes`` the host bytes
+          moved (payload + int8 scale rows).
+          ``kv_export_ms_p50`` / ``kv_export_ms_p99`` /
+          ``kv_import_ms_p50`` / ``kv_import_ms_p99`` — per-handoff
+          device->host gather / host->device scatter latency
+          percentiles over a 256-handoff window.
+          ``kv_gather_traces`` / ``kv_scatter_traces`` — compile-once
+          counters for the block transport jits (NEVER reset; at most
+          one trace per pool geometry — two with a draft pool —
+          sentinel-enforced like ``decode_traces``).
+
         Priority / preemption (multi-tenant plane):
           ``priority_classes`` — number of configured classes (identity,
           not rate; class c+1 outranks class c).
@@ -2097,6 +2557,21 @@ class InferenceEngine:
                     return 0.0
                 return waits[min(len(waits) - 1,
                                  int(p / 100 * len(waits)))] * 1e3
+
+            exp_ms = sorted(self._kv_export_ms)
+            imp_ms = sorted(self._kv_import_ms)
+
+            def xpct(p):
+                if not exp_ms:
+                    return 0.0
+                return exp_ms[min(len(exp_ms) - 1,
+                                  int(p / 100 * len(exp_ms)))]
+
+            def ipct(p):
+                if not imp_ms:
+                    return 0.0
+                return imp_ms[min(len(imp_ms) - 1,
+                                  int(p / 100 * len(imp_ms)))]
             return {
                 "slots": self.num_slots,
                 "active": sum(s.active for s in self._slots),
@@ -2131,8 +2606,9 @@ class InferenceEngine:
                 "max_admission_stall_ms": self._max_admission_stall * 1e3,
                 "pool_bytes": self._pool_bytes,
                 "kv_bytes_per_token": self._kv_bytes_per_token,
-                # load stats the autoscaler consumes
-                "queue_depth": len(self._pending),
+                # load stats the autoscaler consumes (queued imports
+                # are demand exactly like queued prompts)
+                "queue_depth": len(self._pending) + len(self._imports),
                 "decode_tok_s": (win_toks / win_t) if win_t > 0 else 0.0,
                 "queue_wait_ms_p50": wpct(50),
                 "queue_wait_ms_p99": wpct(99),
@@ -2162,6 +2638,23 @@ class InferenceEngine:
                 # fault tolerance
                 "sheds": self._sheds,
                 "watchdog_stalls": self._watchdog_stalls,
+                # disaggregated prefill/decode
+                "role": self.role,
+                "handoffs": self._handoffs_exported,
+                "imports": self._imports_completed,
+                "handoffs_abandoned": self._handoffs_abandoned,
+                "handoffs_pending": len(self._handoffs),
+                "imports_queued": len(self._imports),
+                "kv_blocks_exported": self._kv_blocks_exported,
+                "kv_blocks_imported": self._kv_blocks_imported,
+                "kv_export_bytes": self._kv_export_bytes,
+                "kv_import_bytes": self._kv_import_bytes,
+                "kv_export_ms_p50": xpct(50),
+                "kv_export_ms_p99": xpct(99),
+                "kv_import_ms_p50": ipct(50),
+                "kv_import_ms_p99": ipct(99),
+                "kv_gather_traces": self.kv_gather_traces,
+                "kv_scatter_traces": self.kv_scatter_traces,
                 # priority / preemption
                 "priority_classes": self.priority_classes,
                 "preemptions": self._preemptions,

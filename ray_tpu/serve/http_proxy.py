@@ -23,6 +23,7 @@ import concurrent.futures
 import json
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 
 from ray_tpu._private.constants import (
@@ -47,12 +48,28 @@ class Request:
 
 
 class HTTPProxy:
+    # SLO-admission knobs: how long one controller latency snapshot
+    # stays fresh, and how long a non-sheddable request queues at the
+    # proxy waiting for the histograms to come back under target.
+    _SLO_TTL_S = 0.25
+    _SLO_QUEUE_S = 0.5
+
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         from aiohttp import web
 
         self.host, self.port = host, port
         self._routes: dict = {}           # prefix -> (deployment, app)
         self._handles: dict = {}
+        # SLO-aware admission state: cached controller latency snapshot
+        # + shed/queue counters (stats() -> Prometheus bridge)
+        self._slo_mu = threading.Lock()
+        self._slo_cache: dict = {}
+        self._slo_fetched = -1e9
+        self._slo_sheds = 0
+        self._slo_queued = 0
+        from ray_tpu.util import telemetry as _telemetry
+        self._telemetry_name = _telemetry.register_stats_source(
+            _telemetry.next_name("http_proxy#"), self, kind="http_proxy")
         # picks/submits touch blocking plumbing (non-blocking wait() for
         # load probes, socket sends): keep them off the event loop.
         # Streaming drains get their OWN pool — a drain can legitimately
@@ -149,14 +166,30 @@ class HTTPProxy:
         # which knows its own class count.
         raw_pri = request.headers.get(
             "X-Serve-Priority", request.query.get("priority"))
+        priority = 0
         if raw_pri is not None:
             try:
-                handle = handle.options(priority=int(raw_pri))
+                priority = int(raw_pri)
+                handle = handle.options(priority=priority)
             except (TypeError, ValueError):
                 return web.json_response(
                     {"error": "bad_priority",
                      "detail": f"priority must be an int, got {raw_pri!r}"},
                     status=400)
+        # SLO-aware admission (disaggregated serving): a request may
+        # declare TTFT/TPOT targets; they are checked against the
+        # routed deployment's LIVE latency histograms (controller
+        # scrape). Unsatisfiable + lowest class -> immediate 429 shed;
+        # higher classes queue briefly instead (never SLO-shed).
+        raw_ttft = request.headers.get(
+            "X-SLO-TTFT-MS", request.query.get("slo_ttft_ms"))
+        raw_tpot = request.headers.get(
+            "X-SLO-TPOT-MS", request.query.get("slo_tpot_ms"))
+        if raw_ttft is not None or raw_tpot is not None:
+            reject = await self._slo_admit(dep, app_name, raw_ttft,
+                                           raw_tpot, priority)
+            if reject is not None:
+                return reject
         body = await request.read()
         req = Request(
             method=request.method,
@@ -221,6 +254,96 @@ class HTTPProxy:
         finally:
             if root is not None:
                 _tracing.end_span(root, token)
+
+    # -- SLO-aware admission ----------------------------------------------
+
+    async def _slo_snapshot(self, dep: str, app_name: str,
+                            force: bool = False):
+        """This deployment's live latency view, from a briefly-cached
+        controller `get_slo_snapshot` RPC (the cache keeps admission off
+        the controller's hot path at high request rates). None = no view
+        yet (no engine-backed replica has reported), which admits."""
+        now = time.monotonic()
+        with self._slo_mu:
+            if not force and now - self._slo_fetched < self._SLO_TTL_S:
+                return self._slo_cache.get(f"{app_name}:{dep}")
+
+        def fetch():
+            import ray_tpu
+            from ray_tpu.serve.controller import get_controller
+            try:
+                return ray_tpu.get(
+                    get_controller().get_slo_snapshot.remote(), timeout=5)
+            except Exception:
+                return {}
+
+        loop = asyncio.get_event_loop()
+        snaps = await loop.run_in_executor(self._pool, fetch)
+        with self._slo_mu:
+            self._slo_cache = snaps
+            self._slo_fetched = now
+        return snaps.get(f"{app_name}:{dep}")
+
+    async def _slo_admit(self, dep: str, app_name: str, raw_ttft,
+                         raw_tpot, priority: int):
+        """Admission verdict for a request carrying SLO targets: None to
+        admit, or the error response to return. A target is
+        unsatisfiable when the deployment's live p99 already exceeds it
+        — admitting would knowingly blow the SLO and load the pool for
+        nothing. Class 0 sheds (429 + Retry-After); higher classes are
+        queued up to `_SLO_QUEUE_S` for the histograms to recover, then
+        admitted regardless — priority work is delayed, never dropped
+        here (the engine's own admission still protects the pool)."""
+        from aiohttp import web
+        try:
+            ttft = float(raw_ttft) if raw_ttft is not None else None
+            tpot = float(raw_tpot) if raw_tpot is not None else None
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "bad_slo",
+                 "detail": "X-SLO-TTFT-MS / X-SLO-TPOT-MS must be "
+                           f"numbers, got {raw_ttft!r}/{raw_tpot!r}"},
+                status=400)
+
+        def ok(snap) -> bool:
+            return ((ttft is None
+                     or ttft >= snap.get("ttft_ms_p99", 0.0))
+                    and (tpot is None
+                         or tpot >= snap.get("tpot_ms_p99", 0.0)))
+
+        snap = await self._slo_snapshot(dep, app_name)
+        if snap is None or ok(snap):
+            return None
+        if priority <= 0:
+            with self._slo_mu:
+                self._slo_sheds += 1
+            return web.json_response(
+                {"error": "slo_shed",
+                 "detail": f"deployment {dep!r} p99 "
+                           f"ttft={snap.get('ttft_ms_p99', 0.0):.1f}ms/"
+                           f"tpot={snap.get('tpot_ms_p99', 0.0):.1f}ms "
+                           "exceeds the request's SLO targets"},
+                status=429, headers={"Retry-After": "1"})
+        with self._slo_mu:
+            self._slo_queued += 1
+        deadline = time.monotonic() + self._SLO_QUEUE_S
+        while time.monotonic() < deadline:
+            await asyncio.sleep(self._SLO_TTL_S)
+            snap = await self._slo_snapshot(dep, app_name, force=True)
+            if snap is None or ok(snap):
+                break
+        return None
+
+    def stats(self) -> dict:
+        """SLO-admission counters, published through the stats bridge as
+        ``http_proxy_*`` series: ``slo_sheds`` is requests 429-shed for
+        unsatisfiable SLO targets, ``slo_queued`` is requests delayed at
+        the proxy instead (non-zero priority class), and ``routes`` is
+        the registered route count."""
+        with self._slo_mu:
+            return {"slo_sheds": self._slo_sheds,
+                    "slo_queued": self._slo_queued,
+                    "routes": len(self._routes)}
 
     def _call_in_ctx(self, handle, req, span):
         """Run the handle call on the pool WITH the request's trace

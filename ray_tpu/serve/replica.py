@@ -37,6 +37,16 @@ class StreamingResponse:
 
 
 class Replica:
+    # Control-plane RPCs skip the actor's max_concurrency semaphore
+    # (worker_main._run_task_async; reference: Ray's concurrency
+    # groups): a replica whose whole admission window is parked in
+    # long-blocking next_chunks pulls must still answer the
+    # controller's stats scrape and health ping promptly — starving
+    # them reads as dead replicas and invisible queue depth.
+    _control_plane_methods = ("stats", "check_health", "ready",
+                              "install_faults", "prepare_shutdown",
+                              "cancel_stream")
+
     def __init__(self, serialized_init: dict):
         """serialized_init: {"callable": cls_or_fn, "init_args": tuple,
         "init_kwargs": dict, "deployment_name": str}"""

@@ -23,6 +23,7 @@ PREDICTOR = "ray_tpu/train/predictor.py"
 CONTROLLER = "ray_tpu/serve/controller.py"
 REPLICA = "ray_tpu/serve/replica.py"
 HANDLE = "ray_tpu/serve/handle.py"
+DISAGG = "ray_tpu/serve/disagg.py"
 TELEMETRY = "ray_tpu/util/telemetry.py"
 METRICS = "ray_tpu/util/metrics.py"
 FAULTS = "ray_tpu/util/faults.py"
@@ -54,6 +55,14 @@ HOT_SCOPES: dict[str, frozenset[str]] = {
         "InferenceEngine._force_preempt",
         "InferenceEngine._admit_or_preempt",
         "InferenceEngine._shed_lowest_below",
+        # disaggregated prefill/decode handoff plane — export runs in
+        # the prefill-completion tick, import admission inside step();
+        # both under engine.scheduler (no new lock, no new LOCK_ORDER
+        # edges)
+        "InferenceEngine._export_handoff",
+        "InferenceEngine._admit_imports",
+        "InferenceEngine._try_import",
+        "InferenceEngine.handoff_for",
     }),
     LOOP: frozenset({
         "TrainLoop.run",
@@ -98,6 +107,10 @@ COMPILE_ONCE_JITS: dict[str, dict[str, str | None]] = {
         "self._draft_prefill_fn": "draft_prefill",
         "self._swap_fn": "swap",
         "self._quantize_fn": "quantize",  # int8 weight-only path
+        # disaggregated prefill/decode block transport (one trace per
+        # pool geometry: target + optional draft pool)
+        "self._gather_fn": "kv_gather",
+        "self._scatter_block_fn": "kv_scatter",
     },
     LOOP: {
         "fuse_steps": "dispatch",       # factory: returns the fused jit
@@ -167,6 +180,13 @@ LOCKS: dict[str, dict[str, LockSpec]] = {
         "self._router.refresh_lock": LockSpec(
             "serve.handle.refresh", blocking_ok=True),
         "self._mu": LockSpec("serve.handle.stats"),
+    },
+    DISAGG: {
+        # parked-handoff map / pull-stats state on both replica roles
+        "self._lock": LockSpec("serve.disagg.state"),
+        # serializes pull exchanges on the shared netaddr connection;
+        # its whole job is to hold blocking wire recvs away from state
+        "self._pull_mu": LockSpec("serve.disagg.pull", blocking_ok=True),
     },
     TELEMETRY: {
         "_lock": LockSpec("telemetry.registry"),

@@ -230,6 +230,56 @@ class FlightRecorder:
         if tr is not None:
             tr["extra"].append(self._instant(tr, "swap_crossing", rid))
 
+    def _on_kv_transfer(self, name: str, metric: str, rid: int,
+                        blocks: int, nbytes: int, dur_s: float) -> None:
+        """Shared body for the disaggregation transfer hooks: one
+        `kv_export`/`kv_import` span under the request root plus a
+        tagged latency histogram — `kv_transfer_ms` on the merged
+        timeline is the pair's union."""
+        h = _metric(_metrics.Histogram, metric,
+                    "paged KV block transfer (one handoff side), ms",
+                    boundaries=_MS_BOUNDARIES)
+        if h is not None:
+            h.observe(dur_s * 1e3, tags={"source": self.name})
+        tr = self._live.get(rid)
+        if tr is None or len(tr["extra"]) >= MAX_CHUNKS_PER_REQUEST:
+            return
+        end = _now_ns()
+        root = tr["root"]
+        s = self._span(name, root["trace_id"], root["span_id"],
+                       end - int(dur_s * 1e9),
+                       {"rid": rid, "blocks": int(blocks),
+                        "bytes": int(nbytes)})
+        s["end_ns"] = end
+        tr["extra"].append(s)
+
+    def on_kv_export(self, rid: int, blocks: int, nbytes: int,
+                     dur_s: float) -> None:
+        """Prefill-role engine gathered `blocks` KV blocks to host for
+        a handoff (device->host side of kv_transfer_ms)."""
+        self._on_kv_transfer("kv_export", "engine_kv_export_ms", rid,
+                             blocks, nbytes, dur_s)
+
+    def on_kv_import(self, rid: int, blocks: int, nbytes: int,
+                     dur_s: float) -> None:
+        """Decode-role engine scattered a handoff's blocks into its
+        pool (host->device side of kv_transfer_ms)."""
+        self._on_kv_transfer("kv_import", "engine_kv_import_ms", rid,
+                             blocks, nbytes, dur_s)
+
+    def on_handoff(self, rid: int, dur_s: float) -> None:
+        """End-to-end prefill->decode handoff latency (export + wire +
+        import), recorded by whichever layer drove the transfer — the
+        serve DisaggHandle or an engine-level test harness."""
+        h = _metric(_metrics.Histogram, "serve_handoff_ms",
+                    "prefill->decode handoff, end to end, ms",
+                    boundaries=_MS_BOUNDARIES)
+        if h is not None:
+            h.observe(dur_s * 1e3, tags={"source": self.name})
+        tr = self._live.get(rid)
+        if tr is not None:
+            tr["extra"].append(self._instant(tr, "handoff", rid))
+
     def on_finish(self, rid: int, outcome: str) -> None:
         tr = self._live.pop(rid, None)
         if tr is None:
@@ -474,6 +524,12 @@ COUNTER_KEYS = frozenset({
     # priority/preemption plane (engine + per_class sub-dicts)
     "preemptions", "reprefill_blocks", "aging_promotions",
     "submitted", "completed",
+    # disaggregated prefill/decode (engine handoff plane + the proxy's
+    # SLO admission verdicts)
+    "handoffs", "imports", "handoffs_abandoned",
+    "kv_blocks_exported", "kv_blocks_imported",
+    "kv_export_bytes", "kv_import_bytes",
+    "slo_sheds", "slo_queued",
 })
 
 _sources: dict[str, tuple] = {}          # name -> (weakref, kind)

@@ -1,0 +1,441 @@
+"""Disaggregated prefill/decode serving (ISSUE 20 tentpole): the
+KV-block handoff between role-specialized engines is greedy
+token-identical to a colocated engine across every decode backend
+(plain / shared-prefix / n-gram spec / draft spec / int8 KV+weights),
+cancellation frees paged blocks on BOTH sides, the netaddr-streamed
+serve path (`run_disagg`) matches local decode, an unreachable or
+killed prefill replica fails over (decode-side re-prefill fallback and
+the handle retry path respectively), the proxy sheds/queues on
+per-request SLO targets, and the decode pool autoscales on stream
+occupancy."""
+
+import concurrent.futures
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import gpt
+from ray_tpu.serve.engine import InferenceEngine, InferenceReplica
+from ray_tpu.serve.handle import HANDLE_STATS
+from ray_tpu.util.faults import FaultPlan
+
+CFG = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+           d_ff=64, max_seq_len=128)
+
+PROMPTS = [[5, 9, 3, 17, 2, 88, 41, 7, 19, 23, 55, 1, 4, 9],
+           [5, 9, 3, 17, 2, 88, 41, 7, 100, 101],
+           [7] * 37,
+           [1, 2, 3]]
+
+
+@pytest.fixture
+def serve_session(ray_session):
+    yield serve
+    serve.shutdown()
+
+
+def _controller():
+    from ray_tpu.serve.controller import get_controller
+    return get_controller()
+
+
+def _replicas(dep, app):
+    c = _controller()
+    _, reps = ray_tpu.get(c.get_replicas.remote(dep, app, -1), timeout=30)
+    return reps
+
+
+def _cfg(**kw):
+    return gpt.small(**CFG, **kw)
+
+
+def _params(cfg, seed=0):
+    return gpt.init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _engine(cfg, params, role=None, **ek):
+    kw = dict(slots=2, max_len=128, block_size=8)
+    if role:
+        kw["role"] = role
+    return InferenceEngine(params, cfg, **kw, **ek)
+
+
+def _disagg_generate(pre, dec, prompt, n):
+    blob = pre.handoff_for(pre.submit(list(prompt), max_new_tokens=n))
+    return [int(t) for t in dec.tokens_for(dec.import_handoff(blob))]
+
+
+# ---------------------------------------------------------------------------
+# tentpole proof: token identity across the decode-backend matrix
+# ---------------------------------------------------------------------------
+
+MATRIX = [
+    ("plain", {}, {}),
+    ("ngram", {"spec": "ngram"}, {}),
+    ("draft", "draft", {}),
+    ("int8", {}, {"kv_dtype": "int8", "weight_dtype": "int8"}),
+]
+
+
+@pytest.mark.parametrize("label,ek,cfg_kw",
+                         MATRIX, ids=[m[0] for m in MATRIX])
+def test_disagg_token_identity_matrix(label, ek, cfg_kw):
+    """prefill-role export -> decode-role import must reproduce the
+    colocated greedy stream exactly, for every decode backend — the
+    handoff carries the parked first token (and its logprob/version),
+    so the decode engine continues rather than re-samples."""
+    cfg = _cfg()
+    if cfg_kw:
+        cfg = dataclasses.replace(cfg, **cfg_kw)
+    params = _params(cfg)
+    if ek == "draft":
+        dcfg = dataclasses.replace(cfg, n_layers=1)
+        ek = {"spec": "draft", "draft_cfg": dcfg,
+              "draft_params": _params(dcfg, seed=1)}
+    col = _engine(cfg, params, **ek)
+    expected = [[int(t) for t in col.generate(list(p), max_new_tokens=12)]
+                for p in PROMPTS]
+    col.check_invariants()
+
+    pre = _engine(cfg, params, role="prefill", **ek)
+    dec = _engine(cfg, params, role="decode", **ek)
+    got = [_disagg_generate(pre, dec, p, 12) for p in PROMPTS]
+    assert got == expected
+    pre.check_invariants()
+    dec.check_invariants()
+    ps, ds = pre.stats(), dec.stats()
+    assert ps["role"] == "prefill" and ds["role"] == "decode"
+    assert ps["handoffs"] == len(PROMPTS)
+    assert ds["imports"] == len(PROMPTS)
+    assert ps["decode_steps"] == 0, "a prefill-role engine decoded"
+    assert ps["kv_blocks_exported"] > 0
+    assert ps["kv_export_bytes"] > 0 and ds["kv_import_bytes"] > 0
+
+
+def test_disagg_shared_prefix_token_identity():
+    """Prompts sharing a long prefix: the decode pool recognizes the
+    radix-cached full blocks at import (matching params_version) and
+    shares them by reference instead of re-scattering — fewer blocks
+    imported than exported, same tokens."""
+    cfg = _cfg()
+    params = _params(cfg)
+    shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]  # 2 blocks
+    prompts = [shared + [40 + i, 41 + i] for i in range(3)]
+    col = _engine(cfg, params)
+    expected = [[int(t) for t in col.generate(list(p), max_new_tokens=10)]
+                for p in prompts]
+    pre = _engine(cfg, params, role="prefill")
+    dec = _engine(cfg, params, role="decode")
+    got = [_disagg_generate(pre, dec, p, 10) for p in prompts]
+    assert got == expected
+    pre.check_invariants()
+    dec.check_invariants()
+    assert dec.stats()["kv_blocks_imported"] < \
+        pre.stats()["kv_blocks_exported"], \
+        "shared full prefix blocks should be ref'd, not re-scattered"
+
+
+# ---------------------------------------------------------------------------
+# satellites: cancellation frees both pools, imports validate blobs
+# ---------------------------------------------------------------------------
+
+def test_disagg_cancel_frees_blocks_both_sides():
+    cfg = _cfg()
+    params = _params(cfg)
+    pre = _engine(cfg, params, role="prefill")
+    dec = _engine(cfg, params, role="decode")
+    free_pre, free_dec = pre._alloc.free, dec._alloc.free
+
+    def drained(eng, baseline):
+        # a cancel may legitimately park full prefix blocks in the
+        # radix cache (evictable, refcounted — cache, not leak); what
+        # "freed" means is that evicting the cache restores the pool
+        if eng._tree is not None:
+            eng._tree.evict(10 ** 6)
+        return eng._alloc.free == baseline
+
+    # (a) cancelled while still queued (a prefill-role tick runs ALL
+    # pending prefill work — nothing decodes — so "mid-prefill" on this
+    # role means before its tick): nothing allocated, nothing leaked
+    rid = pre.submit([9] * 30, max_new_tokens=8)
+    assert pre.cancel(rid)
+    assert drained(pre, free_pre)
+    with pytest.raises(KeyError):
+        pre.handoff_for(rid)
+
+    # (b) exported but never collected: device blocks were freed at
+    # export; cancel drops the parked host blob and counts the abandon
+    blob = pre.handoff_for(pre.submit([8] * 20, max_new_tokens=8))
+    rid3 = pre.submit([4] * 20, max_new_tokens=8)
+    while rid3 not in pre._handoffs:    # pump until parked, don't pop
+        pre.step()
+    assert pre.cancel(rid3)
+    assert pre.take_handoff(rid3) is None
+    assert pre.stats()["handoffs_abandoned"] == 1
+    assert drained(pre, free_pre)
+
+    # (c) imported and cancelled mid-stream: decode pool restored
+    drid = dec.import_handoff(blob)
+    it = dec.tokens_for(drid)
+    assert next(it) is not None
+    assert dec.cancel(drid)
+    it.close()
+    assert drained(dec, free_dec)
+    pre.check_invariants()
+    dec.check_invariants()
+
+
+def test_import_rejects_mismatched_blob():
+    cfg = _cfg()
+    params = _params(cfg)
+    pre = _engine(cfg, params, role="prefill")
+    dec = _engine(cfg, params, role="decode")
+    blob = pre.handoff_for(pre.submit([1, 2, 3, 4], max_new_tokens=4))
+    with pytest.raises(ValueError, match="block_size"):
+        dec.import_handoff(dict(blob, block_size=blob["block_size"] * 2))
+    with pytest.raises(ValueError, match="max_len"):
+        dec.import_handoff(dict(blob, max_new_tokens=10_000))
+    with pytest.raises(ValueError, match="priority"):
+        dec.import_handoff(dict(blob, priority=99))
+    with pytest.raises(RuntimeError):
+        pre.import_handoff(blob)
+    with pytest.raises(RuntimeError):
+        dec.handoff_for(0)
+    # the untouched blob still imports cleanly after the rejections
+    assert len(list(dec.tokens_for(dec.import_handoff(blob)))) == 4
+    dec.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# serve layer: netaddr-streamed handoff parity, fallback, failover
+# ---------------------------------------------------------------------------
+
+def test_run_disagg_parity_and_transfer_stats(serve_session):
+    """`run_disagg` 1+1: prompts prefill on one replica, the KV blob
+    streams over netaddr to the decode replica, and the stream is
+    token-identical to a local colocated replica of the same seed."""
+    h = serve.run_disagg(name="t_dz", slots=4, max_len=64, seed=0)
+    local = InferenceReplica(slots=4, max_len=64, seed=0)
+    for p in ([1, 2, 3, 4], [7, 5, 3], [1, 2, 3, 9, 9]):
+        got = [int(t) for t in h.generate(list(p), max_new_tokens=8)]
+        want = [int(t) for t in local(list(p), max_new_tokens=8)]
+        assert got == want, p
+
+    # an abandoned stream releases the decode replica's registered
+    # stream (and with it the engine request) — no leak across the wire
+    s = h.stream([4, 4, 4], max_new_tokens=8)
+    assert next(s) is not None
+    s.close()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if sum(ray_tpu.get(r.stats.remote(), timeout=30)
+               .get("streams", 0)
+               for r in _replicas("decode", "t_dz")) == 0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("decode replica still holds the abandoned stream")
+
+    dh = serve.get_deployment_handle("decode", "t_dz")
+    ds = ray_tpu.get(dh.stats.remote(), timeout=30)
+    assert ds["imports"] >= 3
+    assert ds["kv_pulled_bytes"] > 0
+    assert ds["kv_transfer_gbps"] > 0
+    assert ds["handoff_pull_ms_p99"] >= ds["handoff_pull_ms_p50"] > 0
+    assert ds["handoff_fallbacks"] == 0
+    ph = serve.get_deployment_handle("prefill", "t_dz")
+    ps = ray_tpu.get(ph.stats.remote(), timeout=30)
+    assert ps["handoffs"] >= 3 and ps["decode_steps"] == 0
+
+
+def test_decode_fallback_when_prefill_unreachable(ray_session):
+    """A descriptor whose source replica died before the KV pull: the
+    decode replica falls back to a full local re-prefill — slower, but
+    token-identical and counted."""
+    from ray_tpu.serve.disagg import DecodeReplica
+    dec = DecodeReplica(slots=2, max_len=64, seed=0)
+    local = InferenceReplica(slots=2, max_len=64, seed=0)
+    desc = {"handoff_addr": "127.0.0.1:9", "handoff_key": "00" * 16,
+            "handoff_id": 1, "prompt": [5, 9, 3], "max_new_tokens": 8,
+            "temperature": 0.0, "priority": 0, "kv_bytes": 0}
+    got = [int(t) for t in dec(desc)]
+    want = [int(t) for t in local([5, 9, 3], max_new_tokens=8)]
+    assert got == want
+    assert dec.stats()["handoff_fallbacks"] == 1
+    dec.engine.check_invariants()
+
+
+def test_prefill_kill_mid_handoff_fails_over(serve_session):
+    """Seeded chaos: one of two prefill replicas dies at its next
+    engine tick (mid-handoff, inside `handoff_for`'s pump). The
+    deployment handle must retry the call on the survivor — every
+    stream completes token-identical, none error out."""
+    h = serve.run_disagg(name="t_dzkill", prefill_replicas=2,
+                         decode_replicas=1, slots=2, max_len=64, seed=0)
+    expected = [int(t) for t in h.generate([5, 9, 3], max_new_tokens=8)]
+    reps = _replicas("prefill", "t_dzkill")
+    assert len(reps) == 2
+    ray_tpu.get(reps[0].install_faults.remote(
+        FaultPlan(seed=20).kill("engine.tick", at=0)), timeout=30)
+    before = HANDLE_STATS.stats()["retries"]
+    # power-of-two routing picks per call: keep issuing until the
+    # faulted replica is hit (its death must be invisible to callers)
+    for _ in range(20):
+        assert [int(t) for t in
+                h.generate([5, 9, 3], max_new_tokens=8)] == expected
+        if HANDLE_STATS.stats()["retries"] > before:
+            break
+    else:
+        pytest.fail("the faulted prefill replica never took a call")
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission at the proxy
+# ---------------------------------------------------------------------------
+
+def test_proxy_slo_admission_sheds_and_queues(serve_session):
+    """A deployment reporting fixed latency histograms: requests whose
+    SLO targets the live p99s already violate are 429-shed at the
+    lowest priority class and queued-then-admitted at higher classes,
+    with both counters on the proxy's stats source."""
+    @serve.deployment(num_replicas=1)
+    class FixedLatency:
+        def __call__(self, req):
+            return "ok"
+
+        def stats(self):
+            return {"ttft_ms_p99": 50.0, "p99_token_latency_ms": 5.0}
+
+    serve.run(FixedLatency.bind(), name="t_slo")
+    proxy = serve.start(http_options={"port": 0})
+    info = ray_tpu.get(proxy.ready.remote(), timeout=30)
+    serve.set_route("/slo", "FixedLatency", "t_slo")
+    base = f"http://127.0.0.1:{info['port']}/slo"
+
+    # wait for the controller scrape to publish the latency snapshot
+    c = _controller()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        snap = ray_tpu.get(c.get_slo_snapshot.remote(), timeout=30)
+        if snap.get("t_slo:FixedLatency", {}).get("ttft_ms_p99") == 50.0:
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail(f"controller never published an SLO snapshot: "
+                    f"{ray_tpu.get(c.get_slo_snapshot.remote(), timeout=30)}")
+
+    def get(url, headers=None):
+        req = urllib.request.Request(url, headers=headers or {})
+        return urllib.request.urlopen(req, timeout=30)
+
+    # satisfiable targets admit
+    assert get(base, {"X-SLO-TTFT-MS": "1000",
+                      "X-SLO-TPOT-MS": "100"}).status == 200
+    # unsatisfiable target, lowest class: immediate shed
+    try:
+        get(base, {"X-SLO-TTFT-MS": "1"})
+        pytest.fail("expected HTTP 429")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+        assert e.headers.get("Retry-After") == "1"
+        assert json.loads(e.read())["error"] == "slo_shed"
+    # unsatisfiable TPOT target via query params: same shed
+    try:
+        get(base + "?slo_tpot_ms=0.001")
+        pytest.fail("expected HTTP 429")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+    # malformed target: 400, not a shed
+    try:
+        get(base, {"X-SLO-TTFT-MS": "fast"})
+        pytest.fail("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # unsatisfiable but priority class 1: queued briefly, then admitted
+    t0 = time.time()
+    assert get(base, {"X-SLO-TTFT-MS": "1",
+                      "X-Serve-Priority": "1"}).status == 200
+    assert time.time() - t0 >= 0.2, "high class should queue, not sail"
+
+    st = ray_tpu.get(proxy.stats.remote(), timeout=30)
+    assert st["slo_sheds"] >= 2
+    assert st["slo_queued"] >= 1
+    assert st["routes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# per-role autoscaling: the decode pool scales on stream occupancy
+# ---------------------------------------------------------------------------
+
+def test_decode_pool_autoscales_on_streams(serve_session):
+    """Decode replicas carry long-lived token streams, not short calls —
+    `demand_signal: "streams"` scales the pool on live stream count.
+    Four concurrent streams against a throttled 1-per-replica target
+    must grow the decode pool; the prefill pool (no backlog) stays
+    put."""
+    from ray_tpu.serve.disagg import DecodeReplica, PrefillReplica
+
+    class SlowDecode(DecodeReplica):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            orig = self.engine.step
+
+            def slow_step():
+                time.sleep(0.04)
+                return orig()
+
+            self.engine.step = slow_step
+
+    pre_app = serve.deployment(
+        PrefillReplica, num_replicas=1).bind(None, slots=2, max_len=64,
+                                             seed=0)
+    dec_app = serve.deployment(
+        SlowDecode,
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": 2,
+            "target_num_ongoing_requests_per_replica": 1,
+            "downscale_delay_s": 30.0,
+            "demand_signal": "streams",
+        },
+    ).bind(None, slots=2, max_len=64, seed=0)
+    serve.run(pre_app, name="t_dzpre")
+    serve.run(dec_app, name="t_dzdec")
+    from ray_tpu.serve.disagg import DisaggHandle
+    h = DisaggHandle(serve.get_deployment_handle("PrefillReplica",
+                                                 "t_dzpre"),
+                     serve.get_deployment_handle("SlowDecode",
+                                                 "t_dzdec"))
+    warm = [int(t) for t in h.generate([5, 9, 3], max_new_tokens=4)]
+    assert len(warm) == 4
+
+    def one(_):
+        return [int(t) for t in h.generate([5, 9, 3],
+                                           max_new_tokens=48)]
+
+    grew = False
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        futs = [pool.submit(one, i) for i in range(4)]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = serve.status().get("t_dzdec:SlowDecode", {})
+            if st.get("target_replicas", 1) >= 2 and \
+                    st.get("replicas", 1) >= 2:
+                grew = True
+                break
+            time.sleep(0.2)
+        outs = [f.result(timeout=120) for f in futs]
+    assert grew, f"decode pool never scaled on streams: " \
+                 f"{serve.status().get('t_dzdec:SlowDecode')}"
+    # no stream was truncated by the scaling event, and all replicas
+    # decode greedily from the same seed
+    assert all(len(o) == 48 for o in outs)
+    assert all(o == outs[0] for o in outs)
+    # the prefill pool (fixed size, no autoscaling config) is untouched
+    assert serve.status()["t_dzpre:PrefillReplica"]["replicas"] == 1
